@@ -1,0 +1,20 @@
+"""gatekeeper_tpu — a TPU-native policy-enforcement framework.
+
+A from-scratch re-design of OPA Gatekeeper's capability surface
+(reference: /root/reference, OPA Gatekeeper v3.1.0-rc.1) built TPU-first:
+ConstraintTemplates (Rego policies) compile through a relational IR into
+vectorized JAX/XLA programs; admission reviews micro-batch and audit sweeps
+run as single constraints x resources evaluations on device.
+
+Layers (mirroring SURVEY.md section 1, re-architected):
+  rego/     Rego frontend: scanner, parser, AST, compile-time validation
+  engine/   reference interpreter (correctness oracle) + builtin registry
+  ops/      columnar feature extraction + vectorized JAX kernels (the TPU path)
+  parallel/ device-mesh sharding of the resource axis (ICI collectives)
+  client/   constraint-framework client surface + Driver seam
+  target/   K8s validation target: data layout, review shaping, match schema
+  webhook/  admission handler with micro-batching
+  audit/    full-inventory audit sweeps with violation caps + status
+"""
+
+__version__ = "0.1.0"

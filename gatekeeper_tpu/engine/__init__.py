@@ -1,0 +1,1 @@
+from .value import UNDEFINED, FrozenDict, RSet, freeze, thaw  # noqa: F401

@@ -1,0 +1,492 @@
+"""Builtin function registry for the Rego subset.
+
+Covers the builtin surface used by the reference's policy corpus
+(SURVEY.md section 2.3: sprintf, count, concat, substring, replace, re_match,
+endswith, startswith, to_number, is_*, split, contains, any/all, array.concat,
+trim, sort) plus a few neighbours that cost nothing to support.
+
+Builtin errors (bad types, division by zero, ...) make the calling expression
+undefined, matching OPA's non-strict topdown behavior: raise BuiltinError and
+the interpreter converts it into evaluation failure.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Dict
+
+from .value import FrozenDict, RSet, UNDEFINED, compare, format_value, is_number
+
+
+class BuiltinError(Exception):
+    pass
+
+
+REGISTRY: Dict[tuple, Callable] = {}
+
+
+def builtin(*path: str):
+    def deco(fn):
+        REGISTRY[path] = fn
+        return fn
+
+    return deco
+
+
+def _need(cond: bool, msg: str):
+    if not cond:
+        raise BuiltinError(msg)
+
+
+# --------------------------------------------------------------------------
+# Strings
+# --------------------------------------------------------------------------
+
+
+@builtin("sprintf")
+def _sprintf(fmt: Any, args: Any):
+    _need(isinstance(fmt, str), "sprintf: format must be string")
+    _need(isinstance(args, tuple), "sprintf: args must be array")
+    out = []
+    ai = 0
+    i, n = 0, len(fmt)
+    while i < n:
+        c = fmt[i]
+        if c != "%":
+            out.append(c)
+            i += 1
+            continue
+        i += 1
+        if i < n and fmt[i] == "%":
+            out.append("%")
+            i += 1
+            continue
+        # skip flags/width/precision
+        j = i
+        while j < n and fmt[j] in "+-# 0123456789.":
+            j += 1
+        if j >= n:
+            raise BuiltinError("sprintf: bad format")
+        verb = fmt[j]
+        spec = fmt[i:j]
+        i = j + 1
+        if ai >= len(args):
+            out.append("%!" + verb + "(MISSING)")
+            continue
+        arg = args[ai]
+        ai += 1
+        if verb == "v" or verb == "s":
+            out.append(format_value(arg))
+        elif verb == "d":
+            _need(is_number(arg), "sprintf: %d expects number")
+            out.append(("%" + spec + "d") % int(arg))
+        elif verb in "feg":
+            _need(is_number(arg), "sprintf: %f expects number")
+            out.append(("%" + spec + verb) % float(arg))
+        elif verb == "x":
+            out.append(("%" + spec + "x") % int(arg))
+        elif verb == "t":
+            out.append("true" if arg is True else "false")
+        else:
+            out.append(format_value(arg))
+    return "".join(out)
+
+
+@builtin("concat")
+def _concat(delim: Any, coll: Any):
+    _need(isinstance(delim, str), "concat: delimiter must be string")
+    _need(isinstance(coll, (tuple, RSet)), "concat: collection must be array/set")
+    items = list(coll)
+    _need(all(isinstance(x, str) for x in items), "concat: elements must be strings")
+    return delim.join(items)
+
+
+@builtin("substring")
+def _substring(s: Any, start: Any, length: Any):
+    _need(isinstance(s, str), "substring: not a string")
+    _need(is_number(start) and is_number(length), "substring: bad offsets")
+    start, length = int(start), int(length)
+    _need(start >= 0, "substring: negative start")
+    if length < 0:
+        return s[start:]
+    return s[start : start + length]
+
+
+@builtin("replace")
+def _replace(s: Any, old: Any, new: Any):
+    _need(
+        isinstance(s, str) and isinstance(old, str) and isinstance(new, str),
+        "replace: args must be strings",
+    )
+    return s.replace(old, new)
+
+
+@builtin("trim")
+def _trim(s: Any, cutset: Any):
+    _need(isinstance(s, str) and isinstance(cutset, str), "trim: args must be strings")
+    return s.strip(cutset)
+
+
+@builtin("trim_left")
+def _trim_left(s, cutset):
+    _need(isinstance(s, str) and isinstance(cutset, str), "trim_left: strings")
+    return s.lstrip(cutset)
+
+
+@builtin("trim_right")
+def _trim_right(s, cutset):
+    _need(isinstance(s, str) and isinstance(cutset, str), "trim_right: strings")
+    return s.rstrip(cutset)
+
+
+@builtin("trim_prefix")
+def _trim_prefix(s, prefix):
+    _need(isinstance(s, str) and isinstance(prefix, str), "trim_prefix: strings")
+    return s[len(prefix) :] if s.startswith(prefix) else s
+
+
+@builtin("trim_suffix")
+def _trim_suffix(s, suffix):
+    _need(isinstance(s, str) and isinstance(suffix, str), "trim_suffix: strings")
+    return s[: -len(suffix)] if suffix and s.endswith(suffix) else s
+
+
+@builtin("split")
+def _split(s: Any, delim: Any):
+    _need(isinstance(s, str) and isinstance(delim, str), "split: args must be strings")
+    return tuple(s.split(delim))
+
+
+@builtin("contains")
+def _contains(s: Any, sub: Any):
+    _need(isinstance(s, str) and isinstance(sub, str), "contains: args must be strings")
+    return sub in s
+
+
+@builtin("startswith")
+def _startswith(s: Any, prefix: Any):
+    _need(isinstance(s, str) and isinstance(prefix, str), "startswith: strings")
+    return s.startswith(prefix)
+
+
+@builtin("endswith")
+def _endswith(s: Any, suffix: Any):
+    _need(isinstance(s, str) and isinstance(suffix, str), "endswith: strings")
+    return s.endswith(suffix)
+
+
+@builtin("lower")
+def _lower(s: Any):
+    _need(isinstance(s, str), "lower: not a string")
+    return s.lower()
+
+
+@builtin("upper")
+def _upper(s: Any):
+    _need(isinstance(s, str), "upper: not a string")
+    return s.upper()
+
+
+@builtin("format_int")
+def _format_int(x: Any, base: Any):
+    _need(is_number(x) and is_number(base), "format_int: numbers")
+    digits = "0123456789abcdef"
+    base = int(base)
+    _need(base in (2, 8, 10, 16), "format_int: bad base")
+    v = int(x)
+    if v == 0:
+        return "0"
+    neg = v < 0
+    v = abs(v)
+    out = []
+    while v:
+        out.append(digits[v % base])
+        v //= base
+    return ("-" if neg else "") + "".join(reversed(out))
+
+
+@builtin("indexof")
+def _indexof(s: Any, sub: Any):
+    _need(isinstance(s, str) and isinstance(sub, str), "indexof: strings")
+    return s.find(sub)
+
+
+# --------------------------------------------------------------------------
+# Regex (Go RE2 syntax; Python re is a close superset for the corpus)
+# --------------------------------------------------------------------------
+
+
+def _compile_re(pattern: str):
+    try:
+        return re.compile(pattern)
+    except re.error as e:
+        raise BuiltinError(f"re_match: bad pattern: {e}")
+
+
+@builtin("re_match")
+@builtin("regex", "match")
+def _re_match(pattern: Any, value: Any):
+    _need(isinstance(pattern, str) and isinstance(value, str), "re_match: strings")
+    return _compile_re(pattern).search(value) is not None
+
+
+@builtin("regex", "split")
+def _regex_split(pattern: Any, value: Any):
+    _need(isinstance(pattern, str) and isinstance(value, str), "regex.split: strings")
+    return tuple(_compile_re(pattern).split(value))
+
+
+# --------------------------------------------------------------------------
+# Aggregates
+# --------------------------------------------------------------------------
+
+
+@builtin("count")
+def _count(x: Any):
+    if isinstance(x, (str, tuple, RSet, FrozenDict)):
+        return len(x)
+    raise BuiltinError("count: not a collection or string")
+
+
+@builtin("sum")
+def _sum(x: Any):
+    _need(isinstance(x, (tuple, RSet)), "sum: not a collection")
+    items = list(x)
+    _need(all(is_number(i) for i in items), "sum: non-numeric element")
+    return sum(items)
+
+
+@builtin("product")
+def _product(x: Any):
+    _need(isinstance(x, (tuple, RSet)), "product: not a collection")
+    out = 1
+    for i in x:
+        _need(is_number(i), "product: non-numeric element")
+        out *= i
+    return out
+
+
+@builtin("max")
+def _max(x: Any):
+    _need(isinstance(x, (tuple, RSet)) and len(x) > 0, "max: empty or not collection")
+    import functools
+
+    return sorted(x, key=functools.cmp_to_key(compare))[-1]
+
+
+@builtin("min")
+def _min(x: Any):
+    _need(isinstance(x, (tuple, RSet)) and len(x) > 0, "min: empty or not collection")
+    import functools
+
+    return sorted(x, key=functools.cmp_to_key(compare))[0]
+
+
+@builtin("sort")
+def _sort(x: Any):
+    _need(isinstance(x, (tuple, RSet)), "sort: not a collection")
+    import functools
+
+    return tuple(sorted(x, key=functools.cmp_to_key(compare)))
+
+
+@builtin("all")
+def _all(x: Any):
+    _need(isinstance(x, (tuple, RSet)), "all: not a collection")
+    return all(v is True for v in x)
+
+
+@builtin("any")
+def _any(x: Any):
+    _need(isinstance(x, (tuple, RSet)), "any: not a collection")
+    return any(v is True for v in x)
+
+
+@builtin("abs")
+def _abs(x: Any):
+    _need(is_number(x), "abs: not a number")
+    return abs(x)
+
+
+@builtin("round")
+def _round(x: Any):
+    _need(is_number(x), "round: not a number")
+    import math
+
+    return int(math.floor(x + 0.5))
+
+
+# --------------------------------------------------------------------------
+# Types / conversion
+# --------------------------------------------------------------------------
+
+
+@builtin("to_number")
+def _to_number(x: Any):
+    if x is None:
+        return 0
+    if x is True:
+        return 1
+    if x is False:
+        return 0
+    if is_number(x):
+        return x
+    if isinstance(x, str):
+        try:
+            if re.fullmatch(r"-?\d+", x):
+                return int(x)
+            v = float(x)
+            return int(v) if v.is_integer() else v
+        except ValueError:
+            raise BuiltinError(f"to_number: invalid {x!r}")
+    raise BuiltinError("to_number: bad type")
+
+
+@builtin("is_number")
+def _is_number(x: Any):
+    return is_number(x)
+
+
+@builtin("is_string")
+def _is_string(x: Any):
+    return isinstance(x, str)
+
+
+@builtin("is_boolean")
+def _is_boolean(x: Any):
+    return isinstance(x, bool)
+
+
+@builtin("is_array")
+def _is_array(x: Any):
+    return isinstance(x, tuple)
+
+
+@builtin("is_object")
+def _is_object(x: Any):
+    return isinstance(x, FrozenDict)
+
+
+@builtin("is_set")
+def _is_set(x: Any):
+    return isinstance(x, RSet)
+
+
+@builtin("is_null")
+def _is_null(x: Any):
+    return x is None
+
+
+@builtin("type_name")
+def _type_name(x: Any):
+    if x is None:
+        return "null"
+    if isinstance(x, bool):
+        return "boolean"
+    if is_number(x):
+        return "number"
+    if isinstance(x, str):
+        return "string"
+    if isinstance(x, tuple):
+        return "array"
+    if isinstance(x, FrozenDict):
+        return "object"
+    if isinstance(x, RSet):
+        return "set"
+    raise BuiltinError("type_name: unknown")
+
+
+# --------------------------------------------------------------------------
+# Arrays / objects / sets
+# --------------------------------------------------------------------------
+
+
+@builtin("array", "concat")
+def _array_concat(a: Any, b: Any):
+    _need(isinstance(a, tuple) and isinstance(b, tuple), "array.concat: arrays")
+    return a + b
+
+
+@builtin("array", "slice")
+def _array_slice(a: Any, start: Any, stop: Any):
+    _need(isinstance(a, tuple), "array.slice: not an array")
+    start = max(0, int(start))
+    stop = min(len(a), int(stop))
+    return a[start:stop] if start <= stop else ()
+
+
+@builtin("object", "get")
+def _object_get(obj: Any, key: Any, default: Any):
+    _need(isinstance(obj, FrozenDict), "object.get: not an object")
+    return obj.get(key, default)
+
+
+@builtin("intersection")
+def _intersection(xs: Any):
+    _need(isinstance(xs, RSet) and len(xs) > 0, "intersection: set of sets")
+    items = list(xs)
+    out = items[0]
+    for s in items[1:]:
+        _need(isinstance(s, RSet), "intersection: set of sets")
+        out = out.intersection(s)
+    return out
+
+
+@builtin("union")
+def _union(xs: Any):
+    _need(isinstance(xs, RSet), "union: set of sets")
+    out = RSet()
+    for s in xs:
+        _need(isinstance(s, RSet), "union: set of sets")
+        out = out.union(s)
+    return out
+
+
+# --------------------------------------------------------------------------
+# JSON / encoding
+# --------------------------------------------------------------------------
+
+
+@builtin("json", "marshal")
+def _json_marshal(x: Any):
+    import json
+
+    from .value import thaw
+
+    return json.dumps(thaw(x), separators=(",", ":"), sort_keys=True)
+
+
+@builtin("json", "unmarshal")
+def _json_unmarshal(s: Any):
+    import json
+
+    from .value import freeze
+
+    _need(isinstance(s, str), "json.unmarshal: not a string")
+    try:
+        return freeze(json.loads(s))
+    except json.JSONDecodeError as e:
+        raise BuiltinError(f"json.unmarshal: {e}")
+
+
+@builtin("base64", "encode")
+def _b64_encode(s: Any):
+    import base64
+
+    _need(isinstance(s, str), "base64.encode: not a string")
+    return base64.b64encode(s.encode()).decode()
+
+
+@builtin("base64", "decode")
+def _b64_decode(s: Any):
+    import base64
+
+    _need(isinstance(s, str), "base64.decode: not a string")
+    try:
+        return base64.b64decode(s.encode()).decode()
+    except Exception as e:
+        raise BuiltinError(f"base64.decode: {e}")
+
+
+def lookup(path: tuple):
+    return REGISTRY.get(path)

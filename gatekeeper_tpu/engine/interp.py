@@ -1,0 +1,799 @@
+"""Reference interpreter for the Rego subset — the correctness oracle.
+
+This replaces the reference's vendored topdown interpreter
+(vendor/github.com/open-policy-agent/opa/topdown/query.go:319) for the
+template-policy subset this framework compiles.  The TPU vectorized path
+(gatekeeper_tpu.ops) is validated cell-by-cell against this engine.
+
+Evaluation model: generator-based backtracking search.  Bindings are
+immutable dicts threaded through generators; every generator yields
+`(value, bindings)` (terms) or `bindings` (bodies), so no undo-trail is
+needed and early exits are always safe.
+
+Undefined propagation follows OPA: an expression that evaluates to undefined
+(missing key, failed builtin, no function clause) fails the body; `not`
+succeeds exactly when its operand has no solutions; bodies that evaluate to
+`false` fail.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from ..rego.ast import (
+    ArrayCompr,
+    ArrayTerm,
+    BinOp,
+    Body,
+    Call,
+    Expr,
+    Module,
+    Node,
+    ObjectCompr,
+    ObjectTerm,
+    Ref,
+    RegoCompileError,
+    Rule,
+    Scalar,
+    SetCompr,
+    SetTerm,
+    UnaryMinus,
+    Var,
+)
+from ..rego.parser import parse_module
+from . import builtins as bi
+from .value import (
+    FrozenDict,
+    RSet,
+    UNDEFINED,
+    compare,
+    freeze,
+    is_number,
+    thaw,
+    values_equal,
+)
+
+Bindings = Dict[str, Any]
+
+
+class RegoEvalError(Exception):
+    pass
+
+
+class CompiledModule:
+    __slots__ = ("module", "rules")
+
+    def __init__(self, module: Module):
+        self.module = module
+        self.rules: Dict[str, List[Rule]] = {}
+        for r in module.rules:
+            self.rules.setdefault(r.name, []).append(r)
+
+
+class TemplatePolicy:
+    """A compiled ConstraintTemplate policy: the entry module (which must
+    define `violation`, mirroring createTemplateArtifacts at
+    vendored client/client.go:312-316) plus its libs (packages under lib.*,
+    as enforced by the reference's regorewriter)."""
+
+    def __init__(self, main: CompiledModule, libs: Dict[Tuple[str, ...], CompiledModule]):
+        self.main = main
+        self.libs = libs
+
+    # -- compile ------------------------------------------------------------
+
+    @staticmethod
+    def compile(rego_src: str, lib_srcs: Tuple[str, ...] = (), entry: str = "violation") -> "TemplatePolicy":
+        main = CompiledModule(parse_module(rego_src))
+        if entry not in main.rules:
+            raise RegoCompileError(f"template must define a '{entry}' rule")
+        libs: Dict[Tuple[str, ...], CompiledModule] = {}
+        for src in lib_srcs:
+            cm = CompiledModule(parse_module(src))
+            if not cm.module.package or cm.module.package[0] != "lib":
+                raise RegoCompileError(
+                    f"lib package must begin with 'lib', got {'.'.join(cm.module.package)}"
+                )
+            libs[cm.module.package] = cm
+        pol = TemplatePolicy(main, libs)
+        pol._validate()
+        return pol
+
+    def _validate(self):
+        # data refs may only touch data.inventory / data.lib (the reference
+        # enforces this via regorewriter externs, client.go:291-299).
+        for cm in [self.main, *self.libs.values()]:
+            for r in cm.module.rules:
+                for node in _walk_rule(r):
+                    if isinstance(node, Ref) and isinstance(node.head, Var) and node.head.name == "data":
+                        if not node.operands:
+                            raise RegoCompileError("bare 'data' reference not allowed")
+                        first = node.operands[0]
+                        if not (isinstance(first, Scalar) and first.value in ("inventory", "lib")):
+                            raise RegoCompileError(
+                                "data references are restricted to data.inventory and data.lib"
+                            )
+        self._check_recursion()
+
+    def _check_recursion(self):
+        # Rule-name call graph (module-local names + data.lib refs), DFS.
+        graph: Dict[Tuple[int, str], set] = {}
+
+        def key(cm: CompiledModule, name: str):
+            return (id(cm), name)
+
+        def deps(cm: CompiledModule, r: Rule):
+            out = set()
+            for node in _walk_rule(r):
+                if isinstance(node, Var) and node.name in cm.rules:
+                    out.add(key(cm, node.name))
+                elif isinstance(node, Ref) and isinstance(node.head, Var):
+                    if node.head.name in cm.rules:
+                        out.add(key(cm, node.head.name))
+                    elif node.head.name == "data":
+                        t = self._lib_target(node.operands)
+                        if t:
+                            out.add(key(*t))
+                elif isinstance(node, Call):
+                    if len(node.path) == 1 and node.path[0] in cm.rules:
+                        out.add(key(cm, node.path[0]))
+                    elif node.path[0] == "data":
+                        t = self._lib_target(tuple(Scalar(p) for p in node.path[1:]))
+                        if t:
+                            out.add(key(*t))
+            return out
+
+        index: Dict[Tuple[int, str], Tuple[CompiledModule, str]] = {}
+        for cm in [self.main, *self.libs.values()]:
+            for name, rules in cm.rules.items():
+                k = key(cm, name)
+                index[k] = (cm, name)
+                graph[k] = set()
+                for r in rules:
+                    graph[k] |= deps(cm, r)
+
+        WHITE, GREY, BLACK = 0, 1, 2
+        color = {k: WHITE for k in graph}
+
+        def dfs(k, stack):
+            color[k] = GREY
+            for d in graph.get(k, ()):
+                if color.get(d, BLACK) == GREY:
+                    cyc = " -> ".join(index[x][1] for x in stack + [k, d])
+                    raise RegoCompileError(f"rego_recursion_error: {cyc}")
+                if color.get(d, BLACK) == WHITE:
+                    dfs(d, stack + [k])
+            color[k] = BLACK
+
+        for k in graph:
+            if color[k] == WHITE:
+                dfs(k, [])
+
+    def _lib_target(self, operands) -> Optional[Tuple[CompiledModule, str]]:
+        # data.lib.<pkg...>.<rule> -> (module, rule)
+        parts = []
+        for op in operands:
+            if isinstance(op, Scalar) and isinstance(op.value, str):
+                parts.append(op.value)
+            else:
+                break
+        if not parts or parts[0] != "lib":
+            return None
+        for cut in range(len(parts), 0, -1):
+            pkg = tuple(parts[:cut])
+            if pkg in self.libs and cut < len(parts):
+                return (self.libs[pkg], parts[cut])
+        return None
+
+    # -- public evaluation API ---------------------------------------------
+
+    def eval_violations(self, review: Any, parameters: Any, inventory: Any) -> List[Any]:
+        """Evaluate the template's `violation` rule with
+        input={"review": ..., "parameters": ...} and data.inventory bound,
+        mirroring the hook shim (vendored client/regolib/src.go:23-41).
+        Returns thawed violation objects (dicts with at least "msg")."""
+        inp = freeze({"review": review, "parameters": parameters})
+        ctx = QueryContext(self, inp, freeze(inventory) if not _is_frozen(inventory) else inventory)
+        ext = ctx.partial_set_extent(self.main, "violation")
+        return [thaw(v) for v in ext]
+
+    def eval_rule(self, name: str, input_value: Any, inventory: Any = None) -> Any:
+        """Generic entry for tests: returns a complete rule's value or a
+        partial set rule's extent (thawed)."""
+        ctx = QueryContext(self, freeze(input_value), freeze(inventory))
+        rules = self.main.rules.get(name)
+        if not rules:
+            return UNDEFINED
+        if rules[0].is_partial_set:
+            return thaw(ctx.partial_set_extent(self.main, name))
+        v = ctx.complete_value(self.main, name)
+        return thaw(v) if v is not UNDEFINED else UNDEFINED
+
+
+def _is_frozen(v):
+    return v is None or isinstance(v, (bool, int, float, str, tuple, FrozenDict, RSet))
+
+
+def _walk_rule(r: Rule):
+    stack: List[Node] = []
+    if r.args:
+        stack.extend(r.args)
+    if r.key is not None:
+        stack.append(r.key)
+    if r.value is not None:
+        stack.append(r.value)
+    for e in r.body:
+        stack.append(e)  # type: ignore[arg-type]
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, Expr):
+            stack.extend(n.terms)  # type: ignore[arg-type]
+        elif isinstance(n, Ref):
+            stack.append(n.head)
+            stack.extend(n.operands)
+        elif isinstance(n, Call):
+            stack.extend(n.args)
+        elif isinstance(n, (ArrayTerm, SetTerm)):
+            stack.extend(n.items)
+        elif isinstance(n, ObjectTerm):
+            for k, v in n.pairs:
+                stack.append(k)
+                stack.append(v)
+        elif isinstance(n, (ArrayCompr, SetCompr)):
+            stack.append(n.head)
+            stack.extend(n.body)  # type: ignore[arg-type]
+        elif isinstance(n, ObjectCompr):
+            stack.append(n.key)
+            stack.append(n.value)
+            stack.extend(n.body)  # type: ignore[arg-type]
+        elif isinstance(n, BinOp):
+            stack.append(n.lhs)
+            stack.append(n.rhs)
+        elif isinstance(n, UnaryMinus):
+            stack.append(n.operand)
+
+
+class QueryContext:
+    """Per-query evaluation state: input document, data.inventory, and
+    memoization caches (complete-rule values, partial extents, function
+    results) — the analogue of one topdown Query."""
+
+    __slots__ = ("policy", "input", "inventory", "_complete", "_extent", "_func", "_depth")
+
+    MAX_DEPTH = 256
+
+    def __init__(self, policy: TemplatePolicy, input_value: Any, inventory: Any):
+        self.policy = policy
+        self.input = input_value
+        self.inventory = inventory if inventory is not None else UNDEFINED
+        self._complete: Dict[Tuple[int, str], Any] = {}
+        self._extent: Dict[Tuple[int, str], Any] = {}
+        self._func: Dict[Tuple[int, str, Tuple], Any] = {}
+        self._depth = 0
+
+    # ---- rule evaluation --------------------------------------------------
+
+    def complete_value(self, cm: CompiledModule, name: str) -> Any:
+        key = (id(cm), name)
+        if key in self._complete:
+            return self._complete[key]
+        self._complete[key] = UNDEFINED  # recursion guard (compile also checks)
+        result = UNDEFINED
+        default = UNDEFINED
+        for r in cm.rules[name]:
+            if r.is_default:
+                default = next(self.eval_term(cm, r.value, {}))[0]
+                continue
+            for b in self.eval_body(cm, r.body, 0, {}):
+                val = True
+                if r.value is not None:
+                    got = next(self.eval_term(cm, r.value, b), None)
+                    if got is None:
+                        continue
+                    val = got[0]
+                result = val
+                break
+            if result is not UNDEFINED:
+                break
+        if result is UNDEFINED:
+            result = default
+        self._complete[key] = result
+        return result
+
+    def partial_set_extent(self, cm: CompiledModule, name: str) -> RSet:
+        key = (id(cm), name)
+        if key in self._extent:
+            return self._extent[key]
+        items = set()
+        for r in cm.rules[name]:
+            if not r.is_partial_set:
+                continue
+            for b in self.eval_body(cm, r.body, 0, {}):
+                for v, _b2 in self.eval_term(cm, r.key, b):
+                    items.add(v)
+        ext = RSet(items)
+        self._extent[key] = ext
+        return ext
+
+    def partial_object_extent(self, cm: CompiledModule, name: str) -> FrozenDict:
+        key = (id(cm), "obj:" + name)
+        if key in self._extent:
+            return self._extent[key]
+        out: Dict[Any, Any] = {}
+        for r in cm.rules[name]:
+            if not r.is_partial_object:
+                continue
+            for b in self.eval_body(cm, r.body, 0, {}):
+                for k, b2 in self.eval_term(cm, r.key, b):
+                    for v, _ in self.eval_term(cm, r.value, b2):
+                        out[k] = v
+        ext = FrozenDict(out)
+        self._extent[key] = ext
+        return ext
+
+    def rule_document(self, cm: CompiledModule, name: str) -> Any:
+        """Value of a rule as a document: complete value, set extent, or
+        object extent."""
+        rules = cm.rules[name]
+        r0 = rules[0]
+        if r0.is_partial_set:
+            return self.partial_set_extent(cm, name)
+        if r0.is_partial_object:
+            return self.partial_object_extent(cm, name)
+        if r0.is_function:
+            raise RegoEvalError(f"function '{name}' used as a document")
+        return self.complete_value(cm, name)
+
+    def call_function(self, cm: CompiledModule, name: str, args: Tuple[Any, ...]) -> Any:
+        key = (id(cm), name, args)
+        if key in self._func:
+            return self._func[key]
+        result = UNDEFINED
+        for r in cm.rules[name]:
+            if not r.is_function or len(r.args) != len(args):
+                continue
+            for b in self._unify_params(cm, r.args, args, {}):
+                done = False
+                for b2 in self.eval_body(cm, r.body, 0, b):
+                    if r.value is None:
+                        result = True
+                        done = True
+                        break
+                    got = next(self.eval_term(cm, r.value, b2), None)
+                    if got is not None:
+                        result = got[0]
+                        done = True
+                        break
+                if done:
+                    break
+            if result is not UNDEFINED:
+                break
+        self._func[key] = result
+        return result
+
+    def _unify_params(self, cm, params, args, b) -> Iterator[Bindings]:
+        def go(i, b):
+            if i == len(params):
+                yield b
+                return
+            for b2 in self.unify_pattern(cm, params[i], args[i], b):
+                yield from go(i + 1, b2)
+
+        yield from go(0, b)
+
+    # ---- body / expression evaluation -------------------------------------
+
+    def eval_body(self, cm: CompiledModule, body: Body, i: int, b: Bindings) -> Iterator[Bindings]:
+        if i == len(body):
+            yield b
+            return
+        for b2 in self.eval_expr(cm, body[i], b):
+            yield from self.eval_body(cm, body, i + 1, b2)
+
+    def eval_expr(self, cm: CompiledModule, e: Expr, b: Bindings) -> Iterator[Bindings]:
+        if e.kind == "some":
+            yield b
+            return
+        if e.kind == "not":
+            inner = e.terms[0]
+            for _ in self.eval_expr(cm, inner, b):
+                return
+            yield b
+            return
+        if e.kind in ("unify", "assign"):
+            yield from self.unify(cm, e.terms[0], e.terms[1], b)
+            return
+        # plain term: defined and not false
+        for v, b2 in self.eval_term(cm, e.terms[0], b):
+            if v is not False and v is not UNDEFINED:
+                yield b2
+
+    # ---- unification ------------------------------------------------------
+
+    def unify(self, cm: CompiledModule, ta: Node, tb: Node, b: Bindings) -> Iterator[Bindings]:
+        if isinstance(ta, Var) and ta.name not in b and not self._is_rule_var(cm, ta):
+            for v, b2 in self.eval_term(cm, tb, b):
+                yield self._bind(b2, ta, v)
+            return
+        if isinstance(tb, Var) and tb.name not in b and not self._is_rule_var(cm, tb):
+            for v, b2 in self.eval_term(cm, ta, b):
+                yield self._bind(b2, tb, v)
+            return
+        if isinstance(ta, (ArrayTerm, ObjectTerm)) and self._has_unbound(cm, ta, b):
+            for v, b2 in self.eval_term(cm, tb, b):
+                yield from self.unify_pattern(cm, ta, v, b2)
+            return
+        if isinstance(tb, (ArrayTerm, ObjectTerm)) and self._has_unbound(cm, tb, b):
+            for v, b2 in self.eval_term(cm, ta, b):
+                yield from self.unify_pattern(cm, tb, v, b2)
+            return
+        for va, b2 in self.eval_term(cm, ta, b):
+            for vb, b3 in self.eval_term(cm, tb, b2):
+                if values_equal(va, vb):
+                    yield b3
+
+    def unify_pattern(self, cm: CompiledModule, pat: Node, value: Any, b: Bindings) -> Iterator[Bindings]:
+        """Unify a term pattern against a concrete value."""
+        if isinstance(pat, Var):
+            if pat.is_wildcard:
+                yield b
+                return
+            if pat.name in b:
+                if values_equal(b[pat.name], value):
+                    yield b
+                return
+            if self._is_rule_var(cm, pat):
+                doc = self.rule_document(cm, pat.name)
+                if doc is not UNDEFINED and values_equal(doc, value):
+                    yield b
+                return
+            yield self._bind(b, pat, value)
+            return
+        if isinstance(pat, Scalar):
+            if values_equal(freeze(pat.value), value):
+                yield b
+            return
+        if isinstance(pat, ArrayTerm):
+            if not isinstance(value, tuple) or len(value) != len(pat.items):
+                return
+
+            def go_arr(i, b):
+                if i == len(pat.items):
+                    yield b
+                    return
+                for b2 in self.unify_pattern(cm, pat.items[i], value[i], b):
+                    yield from go_arr(i + 1, b2)
+
+            yield from go_arr(0, b)
+            return
+        if isinstance(pat, ObjectTerm):
+            if not isinstance(value, FrozenDict) or len(value) != len(pat.pairs):
+                return
+
+            def go_obj(i, b):
+                if i == len(pat.pairs):
+                    yield b
+                    return
+                kt, vt = pat.pairs[i]
+                got = next(self.eval_term(cm, kt, b), None)
+                if got is None:
+                    return
+                k, b2 = got
+                if k not in value:
+                    return
+                for b3 in self.unify_pattern(cm, vt, value[k], b2):
+                    yield from go_obj(i + 1, b3)
+
+            yield from go_obj(0, b)
+            return
+        # evaluable pattern (ref/call/binop/set/scalar composite)
+        for v, b2 in self.eval_term(cm, pat, b):
+            if values_equal(v, value):
+                yield b2
+
+    def _bind(self, b: Bindings, var: Var, val: Any) -> Bindings:
+        if var.is_wildcard:
+            return b
+        b2 = dict(b)
+        b2[var.name] = val
+        return b2
+
+    def _is_rule_var(self, cm: CompiledModule, v: Var) -> bool:
+        return v.name in cm.rules
+
+    def _has_unbound(self, cm: CompiledModule, t: Node, b: Bindings) -> bool:
+        if isinstance(t, Var):
+            return (
+                t.name not in b
+                and t.name not in ("input", "data")
+                and not self._is_rule_var(cm, t)
+            )
+        if isinstance(t, ArrayTerm) or isinstance(t, SetTerm):
+            return any(self._has_unbound(cm, x, b) for x in t.items)
+        if isinstance(t, ObjectTerm):
+            return any(
+                self._has_unbound(cm, k, b) or self._has_unbound(cm, v, b)
+                for k, v in t.pairs
+            )
+        return False
+
+    # ---- term evaluation --------------------------------------------------
+
+    def eval_term(self, cm: CompiledModule, t: Node, b: Bindings) -> Iterator[Tuple[Any, Bindings]]:
+        if isinstance(t, Scalar):
+            yield freeze(t.value), b
+            return
+        if isinstance(t, Var):
+            if t.name in b:
+                yield b[t.name], b
+                return
+            if t.name == "input":
+                if self.input is not UNDEFINED:
+                    yield self.input, b
+                return
+            if self._is_rule_var(cm, t):
+                doc = self.rule_document(cm, t.name)
+                if doc is not UNDEFINED:
+                    yield doc, b
+                return
+            raise RegoEvalError(f"unsafe variable: {t.name}")
+        if isinstance(t, Ref):
+            yield from self._eval_ref(cm, t, b)
+            return
+        if isinstance(t, Call):
+            yield from self._eval_call(cm, t, b)
+            return
+        if isinstance(t, BinOp):
+            yield from self._eval_binop(cm, t, b)
+            return
+        if isinstance(t, UnaryMinus):
+            for v, b2 in self.eval_term(cm, t.operand, b):
+                if is_number(v):
+                    yield -v, b2
+            return
+        if isinstance(t, ArrayTerm):
+            yield from self._eval_product(cm, t.items, b, lambda vs: tuple(vs))
+            return
+        if isinstance(t, SetTerm):
+            yield from self._eval_product(cm, t.items, b, lambda vs: RSet(vs))
+            return
+        if isinstance(t, ObjectTerm):
+            flat: List[Node] = []
+            for k, v in t.pairs:
+                flat.append(k)
+                flat.append(v)
+
+            def mk_obj(vs):
+                d = {}
+                for i in range(0, len(vs), 2):
+                    d[vs[i]] = vs[i + 1]
+                return FrozenDict(d)
+
+            yield from self._eval_product(cm, tuple(flat), b, mk_obj)
+            return
+        if isinstance(t, ArrayCompr):
+            out = []
+            for b2 in self.eval_body(cm, t.body, 0, b):
+                got = next(self.eval_term(cm, t.head, b2), None)
+                if got is not None:
+                    out.append(got[0])
+            yield tuple(out), b
+            return
+        if isinstance(t, SetCompr):
+            items = set()
+            for b2 in self.eval_body(cm, t.body, 0, b):
+                got = next(self.eval_term(cm, t.head, b2), None)
+                if got is not None:
+                    items.add(got[0])
+            yield RSet(items), b
+            return
+        if isinstance(t, ObjectCompr):
+            d: Dict[Any, Any] = {}
+            for b2 in self.eval_body(cm, t.body, 0, b):
+                gk = next(self.eval_term(cm, t.key, b2), None)
+                if gk is None:
+                    continue
+                gv = next(self.eval_term(cm, t.value, gk[1]), None)
+                if gv is None:
+                    continue
+                d[gk[0]] = gv[0]
+            yield FrozenDict(d), b
+            return
+        raise RegoEvalError(f"cannot evaluate {type(t).__name__}")
+
+    def _eval_product(self, cm, terms, b, mk):
+        def go(i, acc, b):
+            if i == len(terms):
+                yield mk(acc), b
+                return
+            for v, b2 in self.eval_term(cm, terms[i], b):
+                yield from go(i + 1, acc + [v], b2)
+
+        yield from go(0, [], b)
+
+    # ---- refs -------------------------------------------------------------
+
+    def _eval_ref(self, cm: CompiledModule, t: Ref, b: Bindings) -> Iterator[Tuple[Any, Bindings]]:
+        head = t.head
+        if isinstance(head, Var):
+            name = head.name
+            if name in b:
+                yield from self._walk(cm, b[name], t.operands, 0, b)
+                return
+            if name == "input":
+                if self.input is UNDEFINED:
+                    return
+                yield from self._walk(cm, self.input, t.operands, 0, b)
+                return
+            if name == "data":
+                yield from self._eval_data_ref(cm, t.operands, b)
+                return
+            if self._is_rule_var(cm, head):
+                doc = self.rule_document(cm, name)
+                if doc is UNDEFINED:
+                    return
+                yield from self._walk(cm, doc, t.operands, 0, b)
+                return
+            raise RegoEvalError(f"unsafe variable: {name}")
+        # head is itself a term (call result / literal being indexed)
+        for base, b2 in self.eval_term(cm, head, b):
+            yield from self._walk(cm, base, t.operands, 0, b2)
+
+    def _eval_data_ref(self, cm: CompiledModule, operands, b) -> Iterator[Tuple[Any, Bindings]]:
+        if not operands:
+            return
+        first = operands[0]
+        if isinstance(first, Scalar) and first.value == "inventory":
+            if self.inventory is UNDEFINED:
+                return
+            yield from self._walk(cm, self.inventory, operands[1:], 0, b)
+            return
+        if isinstance(first, Scalar) and first.value == "lib":
+            parts = []
+            for op in operands:
+                if isinstance(op, Scalar) and isinstance(op.value, str):
+                    parts.append(op.value)
+                else:
+                    break
+            for cut in range(len(parts), 0, -1):
+                pkg = tuple(parts[:cut])
+                libm = self.policy.libs.get(pkg)
+                if libm is None:
+                    continue
+                if cut >= len(operands):
+                    return  # bare package reference: not a document
+                rule_name = parts[cut] if cut < len(parts) else None
+                if rule_name is None or rule_name not in libm.rules:
+                    return
+                doc = self.rule_document(libm, rule_name)
+                if doc is UNDEFINED:
+                    return
+                yield from self._walk(cm, doc, operands[cut + 1 :], 0, b)
+                return
+            return
+        return  # other data roots are undefined (compile blocks them anyway)
+
+    def _walk(self, cm, value, operands, i, b) -> Iterator[Tuple[Any, Bindings]]:
+        if value is UNDEFINED:
+            return
+        if i == len(operands):
+            yield value, b
+            return
+        op = operands[i]
+        is_pattern = self._has_unbound(cm, op, b)
+        if isinstance(value, FrozenDict):
+            if is_pattern:
+                for k in value.sorted_keys():
+                    for b2 in self.unify_pattern(cm, op, k, b):
+                        yield from self._walk(cm, value[k], operands, i + 1, b2)
+            else:
+                for k, b2 in self.eval_term(cm, op, b):
+                    if k in value:
+                        yield from self._walk(cm, value[k], operands, i + 1, b2)
+            return
+        if isinstance(value, tuple):
+            if is_pattern:
+                for idx, item in enumerate(value):
+                    for b2 in self.unify_pattern(cm, op, idx, b):
+                        yield from self._walk(cm, item, operands, i + 1, b2)
+            else:
+                for k, b2 in self.eval_term(cm, op, b):
+                    if is_number(k) and not isinstance(k, bool):
+                        idx = int(k)
+                        if 0 <= idx < len(value):
+                            yield from self._walk(cm, value[idx], operands, i + 1, b2)
+            return
+        if isinstance(value, RSet):
+            if is_pattern:
+                for item in value.sorted_items():
+                    for b2 in self.unify_pattern(cm, op, item, b):
+                        yield from self._walk(cm, item, operands, i + 1, b2)
+            else:
+                for k, b2 in self.eval_term(cm, op, b):
+                    if k in value:
+                        yield from self._walk(cm, k, operands, i + 1, b2)
+            return
+        return  # scalars are not indexable -> undefined
+
+    # ---- calls ------------------------------------------------------------
+
+    def _eval_call(self, cm: CompiledModule, t: Call, b: Bindings) -> Iterator[Tuple[Any, Bindings]]:
+        self._depth += 1
+        try:
+            if self._depth > self.MAX_DEPTH:
+                raise RegoEvalError("max evaluation depth exceeded")
+            for argv, b2 in self._eval_product(cm, t.args, b, lambda vs: tuple(vs)):
+                result = self._dispatch_call(cm, t.path, argv)
+                if result is not UNDEFINED:
+                    yield result, b2
+        finally:
+            self._depth -= 1
+
+    def _dispatch_call(self, cm: CompiledModule, path: Tuple[str, ...], args: Tuple[Any, ...]) -> Any:
+        if len(path) == 1 and path[0] in cm.rules:
+            return self.call_function(cm, path[0], args)
+        if path[0] == "data":
+            if len(path) > 2 and path[1] == "lib":
+                parts = path[1:]
+                for cut in range(len(parts) - 1, 0, -1):
+                    pkg = tuple(parts[:cut])
+                    libm = self.policy.libs.get(pkg)
+                    if libm is not None and parts[cut] in libm.rules:
+                        return self.call_function(libm, parts[cut], args)
+            return UNDEFINED
+        fn = bi.lookup(path)
+        if fn is None:
+            raise RegoEvalError(f"unknown function {'.'.join(path)}")
+        try:
+            out = fn(*args)
+        except bi.BuiltinError:
+            return UNDEFINED
+        except (TypeError, ValueError, ZeroDivisionError):
+            return UNDEFINED
+        return freeze(out) if isinstance(out, (list, dict, set)) else out
+
+    # ---- operators --------------------------------------------------------
+
+    def _eval_binop(self, cm: CompiledModule, t: BinOp, b: Bindings) -> Iterator[Tuple[Any, Bindings]]:
+        op = t.op
+        for va, b2 in self.eval_term(cm, t.lhs, b):
+            for vb, b3 in self.eval_term(cm, t.rhs, b2):
+                r = _apply_binop(op, va, vb)
+                if r is not UNDEFINED:
+                    yield r, b3
+
+
+def _apply_binop(op: str, a: Any, b: Any) -> Any:
+    if op == "==":
+        return values_equal(a, b)
+    if op == "!=":
+        return not values_equal(a, b)
+    if op in ("<", "<=", ">", ">="):
+        c = compare(a, b)
+        return {"<": c < 0, "<=": c <= 0, ">": c > 0, ">=": c >= 0}[op]
+    if isinstance(a, RSet) and isinstance(b, RSet):
+        if op == "-":
+            return a.difference(b)
+        if op == "|":
+            return a.union(b)
+        if op == "&":
+            return a.intersection(b)
+        return UNDEFINED
+    if is_number(a) and is_number(b):
+        if op == "+":
+            return a + b
+        if op == "-":
+            return a - b
+        if op == "*":
+            return a * b
+        if op == "/":
+            if b == 0:
+                return UNDEFINED
+            r = a / b
+            return int(r) if isinstance(r, float) and r.is_integer() else r
+        if op == "%":
+            if b == 0 or isinstance(a, float) or isinstance(b, float):
+                return UNDEFINED
+            return a % b
+    return UNDEFINED

@@ -1,0 +1,293 @@
+"""Rego value model.
+
+Values are represented as immutable ("frozen") Python objects so that they can
+be hashed (set members, object keys) and unified structurally:
+
+  null    -> None
+  boolean -> bool
+  number  -> int | float  (arbitrary-precision ints preserved, matching OPA's
+             json.Number semantics; see the 10**21 literals in the
+             k8scontainerlimits corpus template, reference
+             demo/agilebank/templates/k8scontainterlimits_template.yaml)
+  string  -> str
+  array   -> tuple
+  object  -> FrozenDict (key-sorted canonical iteration order)
+  set     -> RSet (canonically ordered frozen set)
+
+`UNDEFINED` is the out-of-band marker for undefined expressions; it never
+appears inside a frozen document.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Iterator
+
+
+class _Undefined:
+    """Singleton marking an undefined Rego value."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self):
+        return "<undefined>"
+
+    def __bool__(self):
+        return False
+
+
+UNDEFINED = _Undefined()
+
+
+def _type_rank(v: Any) -> int:
+    # Canonical sort order across types, mirroring OPA's ast.Compare:
+    # null < false < true < number < string < array < object < set
+    if v is None:
+        return 0
+    if isinstance(v, bool):
+        return 1
+    if isinstance(v, (int, float)):
+        return 2
+    if isinstance(v, str):
+        return 3
+    if isinstance(v, tuple):
+        return 4
+    if isinstance(v, FrozenDict):
+        return 5
+    if isinstance(v, RSet):
+        return 6
+    raise TypeError(f"not a rego value: {type(v)!r}")
+
+
+def compare(a: Any, b: Any) -> int:
+    """Total order over frozen values (OPA ast.Compare semantics)."""
+    ra, rb = _type_rank(a), _type_rank(b)
+    if ra != rb:
+        return -1 if ra < rb else 1
+    if ra == 0:
+        return 0
+    if ra == 1:
+        return (a > b) - (a < b)
+    if ra == 2:
+        return (a > b) - (a < b)
+    if ra == 3:
+        return (a > b) - (a < b)
+    if ra == 4:
+        for x, y in zip(a, b):
+            c = compare(x, y)
+            if c:
+                return c
+        return (len(a) > len(b)) - (len(a) < len(b))
+    if ra == 5:
+        ka, kb = a.sorted_keys(), b.sorted_keys()
+        for x, y in zip(ka, kb):
+            c = compare(x, y)
+            if c:
+                return c
+            c = compare(a[x], b[y])
+            if c:
+                return c
+        return (len(ka) > len(kb)) - (len(ka) < len(kb))
+    # set
+    ea, eb = a.sorted_items(), b.sorted_items()
+    for x, y in zip(ea, eb):
+        c = compare(x, y)
+        if c:
+            return c
+    return (len(ea) > len(eb)) - (len(ea) < len(eb))
+
+
+def values_equal(a: Any, b: Any) -> bool:
+    """Type-strict equality (true != 1, unlike raw Python)."""
+    if isinstance(a, bool) != isinstance(b, bool):
+        return False
+    if isinstance(a, (int, float)) and not isinstance(a, bool):
+        if not isinstance(b, (int, float)) or isinstance(b, bool):
+            return False
+        return a == b
+    if type(a) is not type(b) and not (
+        isinstance(a, FrozenDict) and isinstance(b, FrozenDict)
+    ):
+        return False
+    return a == b
+
+
+class FrozenDict:
+    """Immutable, hashable mapping with canonical (sorted) key order."""
+
+    __slots__ = ("_d", "_hash", "_sorted")
+
+    def __init__(self, d: dict):
+        self._d = d
+        self._hash = None
+        self._sorted = None
+
+    def __getitem__(self, k):
+        return self._d[k]
+
+    def get(self, k, default=None):
+        return self._d.get(k, default)
+
+    def __contains__(self, k):
+        return k in self._d
+
+    def __len__(self):
+        return len(self._d)
+
+    def sorted_keys(self):
+        if self._sorted is None:
+            import functools
+
+            self._sorted = sorted(self._d.keys(), key=functools.cmp_to_key(compare))
+        return self._sorted
+
+    def __iter__(self) -> Iterator:
+        return iter(self.sorted_keys())
+
+    def items(self):
+        for k in self.sorted_keys():
+            yield k, self._d[k]
+
+    def keys(self):
+        return self.sorted_keys()
+
+    def values(self):
+        for k in self.sorted_keys():
+            yield self._d[k]
+
+    def __eq__(self, other):
+        if isinstance(other, FrozenDict):
+            return self._d == other._d
+        return NotImplemented
+
+    def __hash__(self):
+        if self._hash is None:
+            self._hash = hash(frozenset(self._d.items()))
+        return self._hash
+
+    def __repr__(self):
+        return "FrozenDict(%r)" % (self._d,)
+
+
+class RSet:
+    """Immutable Rego set with canonical (sorted) iteration order."""
+
+    __slots__ = ("_s", "_hash", "_sorted")
+
+    def __init__(self, items: Iterable = ()):
+        self._s = frozenset(items)
+        self._hash = None
+        self._sorted = None
+
+    def sorted_items(self):
+        if self._sorted is None:
+            import functools
+
+            self._sorted = sorted(self._s, key=functools.cmp_to_key(compare))
+        return self._sorted
+
+    def __iter__(self):
+        return iter(self.sorted_items())
+
+    def __len__(self):
+        return len(self._s)
+
+    def __contains__(self, v):
+        return v in self._s
+
+    def union(self, other: "RSet") -> "RSet":
+        return RSet(self._s | other._s)
+
+    def intersection(self, other: "RSet") -> "RSet":
+        return RSet(self._s & other._s)
+
+    def difference(self, other: "RSet") -> "RSet":
+        return RSet(self._s - other._s)
+
+    def __eq__(self, other):
+        if isinstance(other, RSet):
+            return self._s == other._s
+        return NotImplemented
+
+    def __hash__(self):
+        if self._hash is None:
+            self._hash = hash(self._s)
+        return self._hash
+
+    def __repr__(self):
+        return "RSet(%r)" % (self.sorted_items(),)
+
+
+def freeze(v: Any) -> Any:
+    """JSON-like Python value -> frozen Rego value."""
+    if v is None or isinstance(v, (bool, str)):
+        return v
+    if isinstance(v, float):
+        # Canonicalize integral floats (JSON "1.0") to ints like OPA's
+        # json.Number round-trip does for arithmetic purposes.
+        if v.is_integer():
+            return int(v)
+        return v
+    if isinstance(v, int):
+        return v
+    if isinstance(v, (list, tuple)):
+        return tuple(freeze(x) for x in v)
+    if isinstance(v, (dict, FrozenDict)):
+        items = v.items() if isinstance(v, FrozenDict) else v.items()
+        return FrozenDict({freeze(k): freeze(val) for k, val in items})
+    if isinstance(v, (set, frozenset, RSet)):
+        return RSet(freeze(x) for x in v)
+    raise TypeError(f"cannot freeze {type(v)!r}")
+
+
+def thaw(v: Any) -> Any:
+    """Frozen Rego value -> plain JSON-able Python value (sets -> sorted lists)."""
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    if isinstance(v, tuple):
+        return [thaw(x) for x in v]
+    if isinstance(v, FrozenDict):
+        return {thaw(k): thaw(val) for k, val in v.items()}
+    if isinstance(v, RSet):
+        return [thaw(x) for x in v.sorted_items()]
+    raise TypeError(f"cannot thaw {type(v)!r}")
+
+
+def is_number(v: Any) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def format_value(v: Any) -> str:
+    """OPA-style rendering used by sprintf %v (topdown builtin semantics):
+    top-level strings print raw; strings nested in composites print quoted."""
+    return _fmt(v, top=True)
+
+
+def _fmt(v: Any, top: bool = False) -> str:
+    if v is None:
+        return "null"
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if is_number(v):
+        if isinstance(v, float):
+            return repr(v)
+        return str(v)
+    if isinstance(v, str):
+        if top:
+            return v
+        import json
+
+        return json.dumps(v)
+    if isinstance(v, tuple):
+        return "[" + ", ".join(_fmt(x) for x in v) + "]"
+    if isinstance(v, FrozenDict):
+        return "{" + ", ".join(f"{_fmt(k)}: {_fmt(val)}" for k, val in v.items()) + "}"
+    if isinstance(v, RSet):
+        if len(v) == 0:
+            return "set()"
+        return "{" + ", ".join(_fmt(x) for x in v) + "}"
+    raise TypeError(f"cannot format {type(v)!r}")

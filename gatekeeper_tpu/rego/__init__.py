@@ -1,0 +1,8 @@
+from .ast import (  # noqa: F401
+    Module,
+    RegoCompileError,
+    RegoError,
+    RegoParseError,
+    Rule,
+)
+from .parser import parse_module  # noqa: F401

@@ -1,0 +1,168 @@
+"""AST for the Rego subset compiled by this framework.
+
+The grammar covers the policy corpus shipped with the reference
+(demo/, library/, pkg/webhook/testdata/, test/bats/tests/ under
+/root/reference): multi-clause rules, functions (including constant-argument
+clauses), partial set/object rules, array/set/object comprehensions, negation,
+refs with variable operands, infix arithmetic/comparison/set operators, and
+`some` declarations.  `with` modifiers and `else` are intentionally out of
+scope: the hook shim and constraint-matching library that need them in the
+reference (vendored regolib/src.go, pkg/target/target_template_source.go) are
+implemented natively in gatekeeper_tpu.target / gatekeeper_tpu.client.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional, Tuple
+
+
+class Node:
+    __slots__ = ()
+
+
+# --------------------------------------------------------------------------
+# Terms
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Scalar(Node):
+    value: Any  # None | bool | int | float | str
+
+
+@dataclass(frozen=True)
+class Var(Node):
+    name: str
+
+    @property
+    def is_wildcard(self) -> bool:
+        return self.name.startswith("$")
+
+
+@dataclass(frozen=True)
+class Ref(Node):
+    """head[op0][op1]... — head is a Var; dotted access is a Scalar operand."""
+
+    head: Var
+    operands: Tuple[Node, ...]
+
+
+@dataclass(frozen=True)
+class Call(Node):
+    """Function application: builtin (dotted path) or user function."""
+
+    path: Tuple[str, ...]
+    args: Tuple[Node, ...]
+
+
+@dataclass(frozen=True)
+class ArrayTerm(Node):
+    items: Tuple[Node, ...]
+
+
+@dataclass(frozen=True)
+class SetTerm(Node):
+    items: Tuple[Node, ...]
+
+
+@dataclass(frozen=True)
+class ObjectTerm(Node):
+    pairs: Tuple[Tuple[Node, Node], ...]
+
+
+@dataclass(frozen=True)
+class ArrayCompr(Node):
+    head: Node
+    body: "Body"
+
+
+@dataclass(frozen=True)
+class SetCompr(Node):
+    head: Node
+    body: "Body"
+
+
+@dataclass(frozen=True)
+class ObjectCompr(Node):
+    key: Node
+    value: Node
+    body: "Body"
+
+
+@dataclass(frozen=True)
+class BinOp(Node):
+    op: str  # == != < <= > >= + - * / % | &
+    lhs: Node
+    rhs: Node
+
+
+@dataclass(frozen=True)
+class UnaryMinus(Node):
+    operand: Node
+
+
+# --------------------------------------------------------------------------
+# Statements / rules
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Expr(Node):
+    """One body statement."""
+
+    kind: str  # "term" | "unify" | "assign" | "not" | "some"
+    terms: Tuple[Node, ...]  # term: (t,); unify/assign: (lhs, rhs); not: (Expr,)
+    loc: Tuple[int, int] = (0, 0)
+
+
+Body = Tuple[Expr, ...]
+
+
+@dataclass(frozen=True)
+class Rule(Node):
+    name: str
+    args: Optional[Tuple[Node, ...]]  # function params (terms; may be scalars)
+    key: Optional[Node]  # partial set/object key term
+    value: Optional[Node]  # head value term (None => true)
+    body: Body
+    is_default: bool = False
+    loc: Tuple[int, int] = (0, 0)
+
+    @property
+    def is_function(self) -> bool:
+        return self.args is not None
+
+    @property
+    def is_partial_set(self) -> bool:
+        return self.key is not None and self.value is None
+
+    @property
+    def is_partial_object(self) -> bool:
+        return self.key is not None and self.value is not None
+
+
+@dataclass
+class Module(Node):
+    package: Tuple[str, ...]  # e.g. ("k8srequiredlabels",) or ("lib", "helpers")
+    rules: Tuple[Rule, ...] = field(default_factory=tuple)
+    source: str = ""
+
+    def rules_named(self, name: str):
+        return [r for r in self.rules if r.name == name]
+
+
+class RegoError(Exception):
+    """Parse/compile error with location info."""
+
+    def __init__(self, msg: str, line: int = 0, col: int = 0):
+        self.line, self.col = line, col
+        super().__init__(f"{msg} (line {line}, col {col})" if line else msg)
+
+
+class RegoParseError(RegoError):
+    pass
+
+
+class RegoCompileError(RegoError):
+    pass

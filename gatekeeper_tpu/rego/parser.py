@@ -1,0 +1,429 @@
+"""Recursive-descent parser for the Rego subset (see ast.py for scope)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from .ast import (
+    ArrayCompr,
+    ArrayTerm,
+    BinOp,
+    Body,
+    Call,
+    Expr,
+    Module,
+    Node,
+    ObjectCompr,
+    ObjectTerm,
+    Ref,
+    RegoParseError,
+    Rule,
+    Scalar,
+    SetCompr,
+    SetTerm,
+    UnaryMinus,
+    Var,
+)
+from .scanner import Token, scan
+
+_REL_OPS = {"==", "!=", "<", "<=", ">", ">="}
+_ADD_OPS = {"+", "-"}
+_MUL_OPS = {"*", "/", "%"}
+
+
+class Parser:
+    def __init__(self, src: str):
+        self.toks: List[Token] = scan(src)
+        self.pos = 0
+        self._wild = 0
+        self.src = src
+
+    # ---- token helpers ----------------------------------------------------
+
+    def cur(self) -> Token:
+        return self.toks[self.pos]
+
+    def advance(self) -> Token:
+        t = self.toks[self.pos]
+        if t.kind != "eof":
+            self.pos += 1
+        return t
+
+    def at(self, kind: str, value=None) -> bool:
+        t = self.cur()
+        return t.kind == kind and (value is None or t.value == value)
+
+    def at_punct(self, *vals: str) -> bool:
+        t = self.cur()
+        return t.kind == "punct" and t.value in vals
+
+    def expect(self, kind: str, value=None) -> Token:
+        t = self.cur()
+        if t.kind != kind or (value is not None and t.value != value):
+            raise RegoParseError(
+                f"expected {value or kind}, got {t.value!r}", t.line, t.col
+            )
+        return self.advance()
+
+    def skip_nl(self):
+        while self.at("newline"):
+            self.advance()
+
+    def err(self, msg: str):
+        t = self.cur()
+        raise RegoParseError(msg, t.line, t.col)
+
+    def fresh_wild(self) -> Var:
+        self._wild += 1
+        return Var(f"$wild{self._wild}")
+
+    # ---- module -----------------------------------------------------------
+
+    def parse_module(self) -> Module:
+        self.skip_nl()
+        self.expect("kw", "package")
+        pkg = self.parse_package_path()
+        rules: List[Rule] = []
+        self.skip_nl()
+        while self.at("kw", "import"):
+            # imports recorded but unused: the corpus references libs via
+            # fully-qualified data.lib paths (enforced by compile validation).
+            self.advance()
+            self.parse_package_path()
+            self.skip_nl()
+        while not self.at("eof"):
+            rules.append(self.parse_rule())
+            self.skip_nl()
+        return Module(package=tuple(pkg), rules=tuple(rules), source=self.src)
+
+    def parse_package_path(self) -> List[str]:
+        parts = [self.expect("ident").value]
+        while True:
+            if self.at_punct("."):
+                self.advance()
+                parts.append(self.expect("ident").value)
+            elif self.at_punct("["):
+                self.advance()
+                parts.append(self.expect("string").value)
+                self.expect("punct", "]")
+            else:
+                break
+        return parts
+
+    # ---- rules ------------------------------------------------------------
+
+    def parse_rule(self) -> Rule:
+        loc = (self.cur().line, self.cur().col)
+        is_default = False
+        if self.at("kw", "default"):
+            is_default = True
+            self.advance()
+        name = self.expect("ident").value
+        args: Optional[Tuple[Node, ...]] = None
+        key: Optional[Node] = None
+        value: Optional[Node] = None
+        if self.at_punct("("):
+            self.advance()
+            self.skip_nl()
+            arglist = []
+            while not self.at_punct(")"):
+                arglist.append(self.parse_term())
+                self.skip_nl()
+                if self.at_punct(","):
+                    self.advance()
+                    self.skip_nl()
+            self.advance()
+            args = tuple(arglist)
+        elif self.at_punct("["):
+            self.advance()
+            self.skip_nl()
+            key = self.parse_term()
+            self.skip_nl()
+            self.expect("punct", "]")
+        if self.at_punct("=", ":="):
+            self.advance()
+            self.skip_nl()
+            value = self.parse_term()
+        if is_default:
+            if value is None:
+                self.err("default rule requires a value")
+            return Rule(name, None, None, value, (), is_default=True, loc=loc)
+        body: Body = ()
+        if self.at_punct("{"):
+            body = self.parse_body()
+        elif value is None:
+            # Only `name = value` / `f(x) = value` constants may omit the body.
+            self.err("rule requires a body or value")
+        if self.at("kw", "else"):
+            self.err("'else' is not supported by this Rego subset")
+        if key is not None and value is None and args is None:
+            # partial set rule
+            return Rule(name, None, key, None, body, loc=loc)
+        return Rule(name, args, key, value, body, loc=loc)
+
+    def parse_body(self) -> Body:
+        self.expect("punct", "{")
+        return self._parse_statements(closer="}")
+
+    def _parse_statements(self, closer: str) -> Body:
+        stmts: List[Expr] = []
+        self.skip_nl()
+        while not self.at_punct(closer):
+            stmts.append(self.parse_statement())
+            if self.at_punct(";"):
+                self.advance()
+                self.skip_nl()
+            elif self.at("newline"):
+                self.skip_nl()
+            elif not self.at_punct(closer):
+                self.err("expected end of statement")
+        self.advance()  # consume closer
+        return tuple(stmts)
+
+    def parse_statement(self) -> Expr:
+        t = self.cur()
+        loc = (t.line, t.col)
+        if self.at("kw", "some"):
+            self.advance()
+            names = [Var(self.expect("ident").value)]
+            while self.at_punct(","):
+                self.advance()
+                names.append(Var(self.expect("ident").value))
+            return Expr("some", tuple(names), loc)
+        if self.at("kw", "not"):
+            self.advance()
+            inner = self.parse_statement_core(loc)
+            return Expr("not", (inner,), loc)
+        if self.at("kw", "with"):
+            self.err("'with' is not supported by this Rego subset")
+        return self.parse_statement_core(loc)
+
+    def parse_statement_core(self, loc) -> Expr:
+        lhs = self.parse_term()
+        if self.at_punct("="):
+            self.advance()
+            self.skip_nl()
+            rhs = self.parse_term()
+            return Expr("unify", (lhs, rhs), loc)
+        if self.at_punct(":="):
+            self.advance()
+            self.skip_nl()
+            rhs = self.parse_term()
+            return Expr("assign", (lhs, rhs), loc)
+        if self.at("kw", "with"):
+            self.err("'with' is not supported by this Rego subset")
+        return Expr("term", (lhs,), loc)
+
+    # ---- terms (precedence climbing) --------------------------------------
+
+    def parse_term(self) -> Node:
+        return self.parse_or()
+
+    def _binop_chain(self, sub, ops):
+        lhs = sub()
+        while self.cur().kind == "punct" and self.cur().value in ops:
+            op = self.advance().value
+            self.skip_nl()
+            rhs = sub()
+            lhs = BinOp(op, lhs, rhs)
+        return lhs
+
+    def parse_or(self) -> Node:
+        return self._binop_chain(self.parse_and, {"|"})
+
+    def parse_and(self) -> Node:
+        return self._binop_chain(self.parse_rel, {"&"})
+
+    def parse_rel(self) -> Node:
+        lhs = self.parse_add()
+        if self.cur().kind == "punct" and self.cur().value in _REL_OPS:
+            op = self.advance().value
+            self.skip_nl()
+            rhs = self.parse_add()
+            return BinOp(op, lhs, rhs)
+        return lhs
+
+    def parse_add(self) -> Node:
+        return self._binop_chain(self.parse_mul, _ADD_OPS)
+
+    def parse_mul(self) -> Node:
+        return self._binop_chain(self.parse_unary, _MUL_OPS)
+
+    def parse_unary(self) -> Node:
+        if self.at_punct("-"):
+            self.advance()
+            operand = self.parse_unary()
+            if isinstance(operand, Scalar) and isinstance(operand.value, (int, float)):
+                return Scalar(-operand.value)
+            return UnaryMinus(operand)
+        return self.parse_postfix()
+
+    def parse_postfix(self) -> Node:
+        base = self.parse_primary()
+        # Collect a dotted/bracketed ref chain; a '(' turns the chain so far
+        # into a call (builtin dotted path or user function).
+        while True:
+            if self.at_punct("."):
+                self.advance()
+                fld = self.expect("ident").value
+                base = self._extend_ref(base, Scalar(fld))
+            elif self.at_punct("["):
+                self.advance()
+                self.skip_nl()
+                idx = self.parse_term()
+                self.skip_nl()
+                self.expect("punct", "]")
+                base = self._extend_ref(base, idx)
+            elif self.at_punct("("):
+                path = self._ref_to_path(base)
+                if path is None:
+                    self.err("cannot call a non-identifier term")
+                self.advance()
+                self.skip_nl()
+                args = []
+                while not self.at_punct(")"):
+                    args.append(self.parse_term())
+                    self.skip_nl()
+                    if self.at_punct(","):
+                        self.advance()
+                        self.skip_nl()
+                self.advance()
+                if path == ("set",) and not args:
+                    base = SetTerm(())
+                else:
+                    base = Call(tuple(path), tuple(args))
+            else:
+                break
+        return base
+
+    def _extend_ref(self, base: Node, operand: Node) -> Node:
+        if isinstance(base, Ref):
+            return Ref(base.head, base.operands + (operand,))
+        if isinstance(base, Var):
+            return Ref(base, (operand,))
+        if isinstance(base, (Call, ArrayTerm, ObjectTerm, SetTerm)):
+            # indexing a call result / literal: model as ref with synthetic head
+            return Ref(base, (operand,))  # type: ignore[arg-type]
+        self.err("cannot index this term")
+
+    @staticmethod
+    def _ref_to_path(base: Node) -> Optional[Tuple[str, ...]]:
+        if isinstance(base, Var):
+            return (base.name,)
+        if isinstance(base, Ref) and isinstance(base.head, Var):
+            parts = [base.head.name]
+            for op in base.operands:
+                if isinstance(op, Scalar) and isinstance(op.value, str):
+                    parts.append(op.value)
+                else:
+                    return None
+            return tuple(parts)
+        return None
+
+    def parse_primary(self) -> Node:
+        t = self.cur()
+        if t.kind == "number":
+            self.advance()
+            return Scalar(t.value)
+        if t.kind == "string":
+            self.advance()
+            return Scalar(t.value)
+        if t.kind == "kw" and t.value in ("true", "false", "null"):
+            self.advance()
+            return Scalar({"true": True, "false": False, "null": None}[t.value])
+        if t.kind == "ident":
+            self.advance()
+            if t.value == "_":
+                return self.fresh_wild()
+            return Var(t.value)
+        if self.at_punct("("):
+            self.advance()
+            self.skip_nl()
+            inner = self.parse_term()
+            self.skip_nl()
+            self.expect("punct", ")")
+            return inner
+        if self.at_punct("["):
+            return self.parse_array()
+        if self.at_punct("{"):
+            return self.parse_brace()
+        self.err(f"unexpected token {t.value!r}")
+
+    def parse_array(self) -> Node:
+        self.expect("punct", "[")
+        self.skip_nl()
+        if self.at_punct("]"):
+            self.advance()
+            return ArrayTerm(())
+        # Parse below '|' precedence: '|' here separates a comprehension head
+        # from its body, not a set union.
+        first = self.parse_and()
+        self.skip_nl()
+        if self.at_punct("|"):
+            self.advance()
+            body = self._parse_statements(closer="]")
+            return ArrayCompr(first, body)
+        items = [first]
+        while self.at_punct(","):
+            self.advance()
+            self.skip_nl()
+            if self.at_punct("]"):
+                break
+            items.append(self.parse_term())
+            self.skip_nl()
+        self.expect("punct", "]")
+        return ArrayTerm(tuple(items))
+
+    def parse_brace(self) -> Node:
+        self.expect("punct", "{")
+        self.skip_nl()
+        if self.at_punct("}"):
+            self.advance()
+            return ObjectTerm(())
+        # Parse below '|' precedence: '|' here separates a comprehension head
+        # from its body, not a set union.
+        first = self.parse_and()
+        self.skip_nl()
+        if self.at_punct(":"):
+            self.advance()
+            self.skip_nl()
+            val = self.parse_and()
+            self.skip_nl()
+            if self.at_punct("|"):
+                self.advance()
+                body = self._parse_statements(closer="}")
+                return ObjectCompr(first, val, body)
+            pairs = [(first, val)]
+            while self.at_punct(","):
+                self.advance()
+                self.skip_nl()
+                if self.at_punct("}"):
+                    break
+                k = self.parse_term()
+                self.skip_nl()
+                self.expect("punct", ":")
+                self.skip_nl()
+                v = self.parse_term()
+                pairs.append((k, v))
+                self.skip_nl()
+            self.expect("punct", "}")
+            return ObjectTerm(tuple(pairs))
+        if self.at_punct("|"):
+            self.advance()
+            body = self._parse_statements(closer="}")
+            return SetCompr(first, body)
+        items = [first]
+        while self.at_punct(","):
+            self.advance()
+            self.skip_nl()
+            if self.at_punct("}"):
+                break
+            items.append(self.parse_term())
+            self.skip_nl()
+        self.expect("punct", "}")
+        return SetTerm(tuple(items))
+
+
+def parse_module(src: str) -> Module:
+    """Parse Rego source into a Module."""
+    return Parser(src).parse_module()

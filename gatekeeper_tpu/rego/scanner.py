@@ -1,0 +1,183 @@
+"""Tokenizer for the Rego subset.
+
+Newlines are significant statement separators in rule bodies, so NEWLINE
+tokens are emitted; the parser decides where they matter.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, List
+
+from .ast import RegoParseError
+
+KEYWORDS = {
+    "package",
+    "import",
+    "default",
+    "not",
+    "true",
+    "false",
+    "null",
+    "as",
+    "with",
+    "some",
+    "else",
+    "set(",  # pseudo, never matched as ident
+}
+
+# Longest-match-first punctuation / operators.
+_PUNCT = [
+    ":=",
+    "==",
+    "!=",
+    "<=",
+    ">=",
+    "{",
+    "}",
+    "[",
+    "]",
+    "(",
+    ")",
+    ",",
+    ":",
+    ";",
+    ".",
+    "|",
+    "&",
+    "=",
+    "<",
+    ">",
+    "+",
+    "-",
+    "*",
+    "/",
+    "%",
+]
+
+
+@dataclass
+class Token:
+    kind: str  # ident kw number string punct newline eof
+    value: Any
+    line: int
+    col: int
+
+    def __repr__(self):
+        return f"Token({self.kind},{self.value!r}@{self.line}:{self.col})"
+
+
+def scan(src: str) -> List[Token]:
+    toks: List[Token] = []
+    i, line, col = 0, 1, 1
+    n = len(src)
+
+    def err(msg):
+        raise RegoParseError(msg, line, col)
+
+    while i < n:
+        c = src[i]
+        if c == "\n":
+            # collapse runs of newlines into one token
+            if toks and toks[-1].kind not in ("newline",):
+                toks.append(Token("newline", "\n", line, col))
+            i += 1
+            line += 1
+            col = 1
+            continue
+        if c in " \t\r":
+            i += 1
+            col += 1
+            continue
+        if c == "#":
+            while i < n and src[i] != "\n":
+                i += 1
+            continue
+        if c == "`":  # raw string
+            j = src.find("`", i + 1)
+            if j < 0:
+                err("unterminated raw string")
+            toks.append(Token("string", src[i + 1 : j], line, col))
+            col += j + 1 - i
+            line += src.count("\n", i, j + 1)
+            i = j + 1
+            continue
+        if c == '"':
+            j = i + 1
+            buf = []
+            while j < n and src[j] != '"':
+                if src[j] == "\\":
+                    if j + 1 >= n:
+                        err("unterminated string escape")
+                    esc = src[j + 1]
+                    mapping = {
+                        "n": "\n",
+                        "t": "\t",
+                        "r": "\r",
+                        '"': '"',
+                        "\\": "\\",
+                        "/": "/",
+                        "b": "\b",
+                        "f": "\f",
+                    }
+                    if esc == "u":
+                        if j + 6 > n:
+                            err("bad unicode escape")
+                        buf.append(chr(int(src[j + 2 : j + 6], 16)))
+                        j += 6
+                        continue
+                    if esc not in mapping:
+                        err(f"bad escape \\{esc}")
+                    buf.append(mapping[esc])
+                    j += 2
+                    continue
+                if src[j] == "\n":
+                    err("newline in string")
+                buf.append(src[j])
+                j += 1
+            if j >= n:
+                err("unterminated string")
+            toks.append(Token("string", "".join(buf), line, col))
+            col += j + 1 - i
+            i = j + 1
+            continue
+        if c.isdigit() or (c == "." and i + 1 < n and src[i + 1].isdigit()):
+            j = i
+            isfloat = False
+            while j < n and (src[j].isdigit() or src[j] in ".eE+-"):
+                if src[j] in "+-" and src[j - 1] not in "eE":
+                    break
+                if src[j] in ".eE":
+                    isfloat = True
+                j += 1
+            text = src[i:j]
+            try:
+                val = float(text) if isfloat else int(text)
+            except ValueError:
+                err(f"bad number {text!r}")
+            if isfloat and float(val).is_integer() and "e" not in text and "E" not in text:
+                val = int(val)
+            toks.append(Token("number", val, line, col))
+            col += j - i
+            i = j
+            continue
+        if c.isalpha() or c == "_":
+            j = i
+            while j < n and (src[j].isalnum() or src[j] == "_"):
+                j += 1
+            word = src[i:j]
+            kind = "kw" if word in KEYWORDS else "ident"
+            toks.append(Token(kind, word, line, col))
+            col += j - i
+            i = j
+            continue
+        for p in _PUNCT:
+            if src.startswith(p, i):
+                toks.append(Token("punct", p, line, col))
+                i += len(p)
+                col += len(p)
+                break
+        else:
+            err(f"unexpected character {c!r}")
+    toks.append(Token("eof", None, line, col))
+    return toks

@@ -1,0 +1,68 @@
+"""Helpers to load the reference's policy fixture corpus
+(templates/constraints/resources under /root/reference) for parity tests."""
+
+import glob
+import pathlib
+
+import yaml
+
+REF = pathlib.Path("/root/reference")
+
+TEMPLATE_GLOBS = [
+    "demo/**/*.yaml",
+    "test/bats/tests/**/*.yaml",
+    "pkg/webhook/testdata/**/*.yaml",
+    "example/**/*.yaml",
+]
+
+
+def iter_yaml_docs(globs=TEMPLATE_GLOBS):
+    files = []
+    for pat in globs:
+        files += glob.glob(str(REF / pat), recursive=True)
+    for f in sorted(set(files)):
+        try:
+            docs = list(yaml.safe_load_all(open(f)))
+        except Exception:
+            continue
+        for d in docs:
+            if isinstance(d, dict):
+                yield f, d
+
+
+def constraint_templates(exclude_bad=True):
+    """Yield (path, template_dict) for every ConstraintTemplate fixture."""
+    for f, d in iter_yaml_docs():
+        if d.get("kind") != "ConstraintTemplate":
+            continue
+        if exclude_bad and "/bad/" in f:
+            continue
+        yield f, d
+
+
+def template_rego(tmpl: dict):
+    tgt = tmpl["spec"]["targets"][0]
+    return tgt["rego"], tuple(tgt.get("libs") or ())
+
+
+def load_yaml(relpath: str):
+    return yaml.safe_load(open(REF / relpath))
+
+
+def make_review(obj: dict, namespace=None, operation="CREATE", group="", version="v1"):
+    kind = obj.get("kind", "")
+    api = obj.get("apiVersion", "v1")
+    if "/" in api:
+        group, version = api.split("/", 1)
+    else:
+        group, version = "", api
+    r = {
+        "kind": {"group": group, "version": version, "kind": kind},
+        "name": obj.get("metadata", {}).get("name", ""),
+        "object": obj,
+        "operation": operation,
+    }
+    ns = namespace or obj.get("metadata", {}).get("namespace")
+    if ns:
+        r["namespace"] = ns
+    return r

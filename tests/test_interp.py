@@ -1,0 +1,320 @@
+"""Interpreter (oracle) semantics tests: corpus templates against the
+reference's own good/bad fixtures, plus targeted Rego-semantics cases
+(undefined propagation, negation, functions, comprehensions, set algebra)."""
+
+import glob
+
+import pytest
+import yaml
+
+from gatekeeper_tpu.engine.interp import TemplatePolicy
+from gatekeeper_tpu.rego import RegoCompileError
+
+from .corpus import REF, load_yaml, make_review, template_rego
+
+
+def compile_template(relpath: str) -> TemplatePolicy:
+    tmpl = load_yaml(relpath)
+    rego, libs = template_rego(tmpl)
+    return TemplatePolicy.compile(rego, libs)
+
+
+class TestRequiredLabels:
+    def test_bad_ns_violates(self):
+        pol = compile_template("demo/basic/templates/k8srequiredlabels_template.yaml")
+        obj = load_yaml("demo/basic/bad/bad_ns.yaml")
+        v = pol.eval_violations(make_review(obj), {"labels": ["gatekeeper"]}, {})
+        assert len(v) == 1
+        assert v[0]["msg"] == 'you must provide labels: {"gatekeeper"}'
+        assert v[0]["details"] == {"missing_labels": ["gatekeeper"]}
+
+    def test_good_ns_passes(self):
+        pol = compile_template("demo/basic/templates/k8srequiredlabels_template.yaml")
+        obj = load_yaml("demo/basic/good/good_ns.yaml")
+        assert pol.eval_violations(make_review(obj), {"labels": ["gatekeeper"]}, {}) == []
+
+
+class TestPSP:
+    """Each psp-pods fixture violates exactly its own template
+    (reference pkg/webhook/testdata/psp-all-violations)."""
+
+    BASE = "pkg/webhook/testdata/psp-all-violations"
+
+    @pytest.fixture(scope="class")
+    def setup(self):
+        pols, params, pods = {}, {}, []
+        for tf in sorted(glob.glob(str(REF / self.BASE / "psp-templates/*.yaml"))):
+            t = yaml.safe_load(open(tf))
+            kind = t["spec"]["crd"]["spec"]["names"]["kind"]
+            rego, libs = template_rego(t)
+            pols[kind] = TemplatePolicy.compile(rego, libs)
+            params[kind] = {}
+        for cf in glob.glob(str(REF / self.BASE / "psp-constraints/*.yaml")):
+            c = yaml.safe_load(open(cf))
+            if c["kind"] in params:
+                params[c["kind"]] = c["spec"].get("parameters") or {}
+        for pf in sorted(glob.glob(str(REF / self.BASE / "psp-pods/*.yaml"))):
+            pods.append(yaml.safe_load(open(pf)))
+        return pols, params, pods
+
+    EXPECT = {
+        "K8sPSPHostFilesystem": {"nginx-host-filesystem", "nginx-volume-types"},
+        "K8sPSPHostNamespace": {"nginx-host-namespace"},
+        "K8sPSPHostNetworkingPorts": {"nginx-host-networking-ports"},
+        "K8sPSPPrivilegedContainer": {"nginx-privileged"},
+        "K8sPSPVolumeTypes": {"nginx-host-filesystem", "nginx-volume-types"},
+    }
+
+    def test_violation_matrix(self, setup):
+        pols, params, pods = setup
+        for kind, pol in pols.items():
+            violators = set()
+            for pod in pods:
+                review = make_review(pod, namespace="default")
+                if pol.eval_violations(review, params[kind], {}):
+                    violators.add(pod["metadata"]["name"])
+            assert violators == self.EXPECT[kind], kind
+
+
+class TestContainerLimits:
+    """Function clauses, negation, arbitrary-precision literals, re_match."""
+
+    @pytest.fixture(scope="class")
+    def pol(self):
+        return compile_template("demo/agilebank/templates/k8scontainterlimits_template.yaml")
+
+    PARAMS = {"cpu": "200m", "memory": "1Gi"}
+
+    def test_good(self, pol):
+        obj = load_yaml("demo/agilebank/good_resources/opa.yaml")
+        assert pol.eval_violations(make_review(obj), self.PARAMS, {}) == []
+
+    def test_no_limits(self, pol):
+        obj = load_yaml("demo/agilebank/bad_resources/opa_no_limits.yaml")
+        msgs = [v["msg"] for v in pol.eval_violations(make_review(obj), self.PARAMS, {})]
+        assert msgs == ["container <opa> has no resource limits"]
+
+    def test_limits_too_high(self, pol):
+        obj = load_yaml("demo/agilebank/bad_resources/opa_limits_too_high.yaml")
+        msgs = sorted(v["msg"] for v in pol.eval_violations(make_review(obj), self.PARAMS, {}))
+        assert msgs == [
+            "container <opa> cpu limit <300m> is higher than the maximum allowed of <200m>",
+            "container <opa> memory limit <4000Mi> is higher than the maximum allowed of <1Gi>",
+        ]
+
+
+class TestInventoryTemplates:
+    def test_unique_label_duplicate(self):
+        pol = compile_template("demo/basic/templates/k8suniquelabel_template.yaml")
+        ns1 = {"apiVersion": "v1", "kind": "Namespace",
+               "metadata": {"name": "ns1", "labels": {"gatekeeper": "true"}}}
+        ns2 = {"apiVersion": "v1", "kind": "Namespace",
+               "metadata": {"name": "ns2", "labels": {"gatekeeper": "true"}}}
+        inv = {"cluster": {"v1": {"Namespace": {"ns1": ns1}}}}
+        v = pol.eval_violations(make_review(ns2), {"label": "gatekeeper"}, inv)
+        assert [x["msg"] for x in v] == ["label gatekeeper has duplicate value true"]
+
+    def test_unique_label_self_excluded(self):
+        pol = compile_template("demo/basic/templates/k8suniquelabel_template.yaml")
+        ns1 = {"apiVersion": "v1", "kind": "Namespace",
+               "metadata": {"name": "ns1", "labels": {"gatekeeper": "true"}}}
+        inv = {"cluster": {"v1": {"Namespace": {"ns1": ns1}}}}
+        # reviewing ns1 itself: its cached copy must not count as a duplicate
+        assert pol.eval_violations(make_review(ns1), {"label": "gatekeeper"}, inv) == []
+
+    def test_unique_ingress_host(self):
+        pol = compile_template("demo/agilebank/dryrun/k8suniqueingresshost_template.yaml")
+        ing = lambda name, ns, host: {
+            "apiVersion": "extensions/v1beta1", "kind": "Ingress",
+            "metadata": {"name": name, "namespace": ns},
+            "spec": {"rules": [{"host": host}]},
+        }
+        other = ing("existing", "ns-a", "example.com")
+        inv = {"namespace": {"ns-a": {"extensions/v1beta1": {"Ingress": {"existing": other}}}}}
+        dup = ing("dup", "ns-b", "example.com")
+        v = pol.eval_violations(make_review(dup), {}, inv)
+        assert [x["msg"] for x in v] == [
+            "ingress host conflicts with an existing ingress <example.com>"
+        ]
+        ok = ing("ok", "ns-b", "other.com")
+        assert pol.eval_violations(make_review(ok), {}, inv) == []
+
+
+class TestAllowedRepos:
+    def test_wrong_repo(self):
+        pol = compile_template("demo/agilebank/templates/k8sallowedrepos_template.yaml")
+        obj = load_yaml("demo/agilebank/bad_resources/opa_wrong_repo.yaml")
+        v = pol.eval_violations(make_review(obj), {"repos": ["openpolicyagent"]}, {})
+        assert len(v) == 1 and "invalid image repo" in v[0]["msg"]
+
+    def test_good_repo(self):
+        pol = compile_template("demo/agilebank/templates/k8sallowedrepos_template.yaml")
+        obj = load_yaml("demo/agilebank/good_resources/opa.yaml")
+        assert pol.eval_violations(make_review(obj), {"repos": ["openpolicyagent"]}, {}) == []
+
+
+class TestSemantics:
+    """Targeted Rego-subset semantics."""
+
+    def run(self, rego, input_value=None, inventory=None):
+        pol = TemplatePolicy.compile(rego)
+        return pol.eval_violations(
+            (input_value or {}).get("review", {}),
+            (input_value or {}).get("parameters", {}),
+            inventory or {},
+        )
+
+    def test_undefined_vs_false_negation(self):
+        v = self.run(
+            """
+package p
+violation[{"msg": "undef"}] { not input.review.object.missing }
+violation[{"msg": "false"}] { not input.review.object.flag }
+violation[{"msg": "present"}] { input.review.object.present }
+""",
+            {"review": {"object": {"flag": False, "present": 1}}},
+        )
+        assert sorted(x["msg"] for x in v) == ["false", "present", "undef"]
+
+    def test_else_unsupported(self):
+        with pytest.raises(Exception):
+            TemplatePolicy.compile(
+                "package p\nviolation[{\"msg\": \"x\"}] { true } else = true { true }"
+            )
+
+    def test_recursion_rejected(self):
+        with pytest.raises(RegoCompileError, match="recursion"):
+            TemplatePolicy.compile(
+                """
+package p
+violation[{"msg": "x"}] { f(1) > 0 }
+f(x) = y { y := g(x) }
+g(x) = y { y := f(x) }
+"""
+            )
+
+    def test_data_ref_restriction(self):
+        with pytest.raises(RegoCompileError, match="restricted"):
+            TemplatePolicy.compile(
+                'package p\nviolation[{"msg": "x"}] { data.secrets.key == "boo" }'
+            )
+
+    def test_lib_package_required(self):
+        with pytest.raises(RegoCompileError, match="lib"):
+            TemplatePolicy.compile(
+                'package p\nviolation[{"msg": "x"}] { true }',
+                ("package notlib\nhelper = 1 { true }",),
+            )
+
+    def test_lib_call(self):
+        pol = TemplatePolicy.compile(
+            """
+package p
+violation[{"msg": msg}] {
+  data.lib.helpers.is_big(input.review.object.size)
+  msg := sprintf("big: %v", [data.lib.helpers.limit])
+}
+""",
+            (
+                """
+package lib.helpers
+limit = 10 { true }
+is_big(x) { x > limit }
+""",
+            ),
+        )
+        assert pol.eval_violations({"object": {"size": 11}}, {}, {}) == [{"msg": "big: 10"}]
+        assert pol.eval_violations({"object": {"size": 9}}, {}, {}) == []
+
+    def test_set_algebra_and_comprehensions(self):
+        v = self.run(
+            """
+package p
+violation[{"msg": msg}] {
+  a := {x | x := input.review.object.xs[_]}
+  b := {x | x := input.review.object.ys[_]}
+  inter := a & b
+  uni := a | b
+  diff := a - b
+  count(inter) == 1
+  count(uni) == 3
+  count(diff) == 1
+  msg := sprintf("%v/%v/%v", [inter, uni, diff])
+}
+""",
+            {"review": {"object": {"xs": ["p", "q"], "ys": ["q", "r"]}}},
+        )
+        assert v == [{"msg": '{"q"}/{"p", "q", "r"}/{"p"}'}]
+
+    def test_object_pattern_membership(self):
+        v = self.run(
+            """
+package p
+pairs[{"k": k, "tag": "even"}] { k := input.review.object.ns[_]; k % 2 == 0 }
+pairs[{"k": k, "tag": "odd"}] { k := input.review.object.ns[_]; k % 2 == 1 }
+violation[{"msg": msg}] {
+  pairs[{"k": k, "tag": "even"}]
+  msg := sprintf("even %v", [k])
+}
+""",
+            {"review": {"object": {"ns": [1, 2, 3, 4]}}},
+        )
+        assert sorted(x["msg"] for x in v) == ["even 2", "even 4"]
+
+    def test_arbitrary_precision(self):
+        v = self.run(
+            """
+package p
+violation[{"msg": msg}] {
+  x := 1152921504606846976000 * 2
+  msg := sprintf("%v", [x])
+}
+"""
+        )
+        assert v == [{"msg": "2305843009213693952000"}]
+
+    def test_division_and_mod_undefined_on_zero(self):
+        assert (
+            self.run('package p\nviolation[{"msg": "x"}] { y := 1 / 0; y == y }') == []
+        )
+
+    def test_string_builtins(self):
+        v = self.run(
+            """
+package p
+violation[{"msg": msg}] {
+  s := "registry.example.com/app:latest"
+  parts := split(s, ":")
+  tag := parts[count(parts) - 1]
+  startswith(s, "registry")
+  endswith(tag, "est")
+  contains(s, "/app")
+  t := trim("  x  ", " ")
+  r := replace(s, "latest", "stable")
+  msg := concat("|", [tag, t, substring(r, 0, 8)])
+}
+"""
+        )
+        assert v == [{"msg": "latest|x|registry"}]
+
+    def test_destructuring_assignment(self):
+        v = self.run(
+            """
+package p
+make_group_version(api_version) = [group, version] {
+  contains(api_version, "/")
+  [group, version] := split(api_version, "/")
+}
+make_group_version(api_version) = [group, version] {
+  not contains(api_version, "/")
+  group := ""
+  version := api_version
+}
+violation[{"msg": msg}] {
+  [g1, v1] := make_group_version("apps/v1")
+  [g2, v2] := make_group_version("v1")
+  msg := sprintf("%v,%v,%v,%v", [g1, v1, g2, v2])
+}
+"""
+        )
+        assert v == [{"msg": "apps,v1,,v1"}]

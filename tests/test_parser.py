@@ -1,0 +1,140 @@
+"""Parser coverage: the whole reference policy corpus must parse; known-bad
+fixtures must be rejected (mirroring the reference's demo/basic/bad/ intent)."""
+
+import pytest
+
+from gatekeeper_tpu.rego import RegoParseError, parse_module
+from gatekeeper_tpu.rego.ast import (
+    ArrayCompr,
+    BinOp,
+    Call,
+    ObjectCompr,
+    Ref,
+    Rule,
+    Scalar,
+    SetCompr,
+    Var,
+)
+
+from .corpus import constraint_templates, template_rego
+
+
+def test_corpus_parses():
+    count = 0
+    for path, tmpl in constraint_templates():
+        rego, libs = template_rego(tmpl)
+        m = parse_module(rego)
+        assert m.package, path
+        assert any(r.name == "violation" for r in m.rules), path
+        for lib in libs:
+            parse_module(lib)
+        count += 1
+    assert count >= 15  # demo + bats + psp fixtures
+
+
+def test_bad_template_rejected():
+    # demo/basic/bad/bad_template.yaml drops the '{' after the violation head.
+    src = """
+package k8sbad
+
+violation[{"msg": msg}]
+  msg := "nope"
+"""
+    with pytest.raises(RegoParseError):
+        parse_module(src)
+
+
+def test_multi_clause_functions_and_literal_args():
+    m = parse_module(
+        """
+package p
+
+mem_multiple("Ki") = 1024000 { true }
+mem_multiple("") = 1000 { true }
+
+f(x) = y { y := x * 2 }
+"""
+    )
+    mm = m.rules_named("mem_multiple")
+    assert len(mm) == 2
+    assert isinstance(mm[0].args[0], Scalar)
+    f = m.rules_named("f")[0]
+    assert f.is_function and isinstance(f.args[0], Var)
+
+
+def test_comprehensions_and_set_union_disambiguation():
+    m = parse_module(
+        """
+package p
+
+r {
+  provided := {label | input.object.labels[label]}
+  arr := [good | good := input.items[_]]
+  obj := {k: v | v := input.m[k]}
+  u := provided | {"extra"}
+  count(u) > 0
+}
+"""
+    )
+    body = m.rules[0].body
+    assert isinstance(body[0].terms[1], SetCompr)
+    assert isinstance(body[1].terms[1], ArrayCompr)
+    assert isinstance(body[2].terms[1], ObjectCompr)
+    assert isinstance(body[3].terms[1], BinOp) and body[3].terms[1].op == "|"
+
+
+def test_refs_calls_and_wildcards():
+    m = parse_module(
+        """
+package p
+
+violation[{"msg": m}] {
+  c := input.review.object.spec.containers[_]
+  hostPort := input_containers[_].ports[_].hostPort
+  x := data.inventory.namespace[ns][api]["Ingress"][name]
+  y := array.concat([], [1])
+  m := sprintf("%v", [c])
+}
+"""
+    )
+    stmts = m.rules[0].body
+    ref = stmts[0].terms[1]
+    assert isinstance(ref, Ref)
+    assert ref.operands[-1].name.startswith("$wild")
+    call = stmts[3].terms[1]
+    assert isinstance(call, Call) and call.path == ("array", "concat")
+
+
+def test_partial_set_rules_and_defaults():
+    m = parse_module(
+        """
+package p
+
+default allow = false
+
+input_containers[c] { c := input.spec.containers[_] }
+input_containers[c] { c := input.spec.initContainers[_] }
+"""
+    )
+    assert m.rules_named("allow")[0].is_default
+    ics = m.rules_named("input_containers")
+    assert len(ics) == 2 and all(r.is_partial_set for r in ics)
+
+
+def test_negation_of_comparison():
+    m = parse_module(
+        """
+package p
+
+r { not allowedHostPath.readOnly == true }
+"""
+    )
+    e = m.rules[0].body[0]
+    assert e.kind == "not"
+    inner = e.terms[0]
+    assert inner.kind == "term" and isinstance(inner.terms[0], BinOp)
+
+
+def test_rule_requires_body_or_value():
+    with pytest.raises(RegoParseError):
+        parse_module("package p\n\nviolation[x]\n")
