@@ -1,0 +1,95 @@
+"""ConstraintTemplate API types.
+
+Mirrors the reference's unversioned ConstraintTemplate core type
+(vendored frameworks/constraint/pkg/core/templates/constrainttemplate_types.go:32-60)
+accepting templates.gatekeeper.sh/v1alpha1 and /v1beta1 payloads.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+TEMPLATE_GROUP = "templates.gatekeeper.sh"
+TEMPLATE_VERSIONS = ("v1beta1", "v1alpha1")
+
+
+class TemplateError(Exception):
+    pass
+
+
+@dataclass
+class TargetSpec:
+    target: str
+    rego: str
+    libs: Tuple[str, ...] = ()
+
+
+@dataclass
+class ConstraintTemplate:
+    name: str
+    kind: str  # spec.crd.spec.names.kind
+    targets: List[TargetSpec]
+    validation_schema: Optional[dict] = None  # spec.crd.spec.validation.openAPIV3Schema
+    raw: dict = field(default_factory=dict)
+
+    @staticmethod
+    def from_dict(obj: Dict[str, Any]) -> "ConstraintTemplate":
+        if not isinstance(obj, dict):
+            raise TemplateError("template must be an object")
+        api = obj.get("apiVersion", "")
+        if api and "/" in api:
+            group, _version = api.split("/", 1)
+            if group != TEMPLATE_GROUP:
+                raise TemplateError(f"unexpected template group {group}")
+        if obj.get("kind") not in (None, "ConstraintTemplate"):
+            raise TemplateError(f"unexpected kind {obj.get('kind')}")
+        name = (obj.get("metadata") or {}).get("name", "")
+        spec = obj.get("spec") or {}
+        crd_spec = ((spec.get("crd") or {}).get("spec")) or {}
+        names = crd_spec.get("names") or {}
+        kind = names.get("kind") or ""
+        if not kind:
+            raise TemplateError("template has no CRD kind (spec.crd.spec.names.kind)")
+        # client.go:283-289: metadata.name must be the lowercased kind.
+        if name != kind.lower():
+            raise TemplateError(
+                f"template's name {name!r} should be {kind.lower()!r} (lowercase of CRD kind)"
+            )
+        targets_raw = spec.get("targets") or []
+        # client.go createTemplateArtifacts: exactly one target is supported.
+        if len(targets_raw) != 1:
+            raise TemplateError(
+                f"expected exactly 1 item in targets, got {len(targets_raw)}"
+            )
+        targets = []
+        for t in targets_raw:
+            rego = t.get("rego") or ""
+            if not rego:
+                raise TemplateError("template target has no Rego")
+            targets.append(
+                TargetSpec(
+                    target=t.get("target") or "",
+                    rego=rego,
+                    libs=tuple(t.get("libs") or ()),
+                )
+            )
+        validation = (crd_spec.get("validation") or {}).get("openAPIV3Schema")
+        return ConstraintTemplate(
+            name=name, kind=kind, targets=targets, validation_schema=validation, raw=obj
+        )
+
+    def semantic_key(self) -> str:
+        """Change-detection key, the analogue of templates.SemanticEqual."""
+        return json.dumps(
+            {
+                "kind": self.kind,
+                "targets": [
+                    {"target": t.target, "rego": t.rego, "libs": list(t.libs)}
+                    for t in self.targets
+                ],
+                "validation": self.validation_schema,
+            },
+            sort_keys=True,
+        )

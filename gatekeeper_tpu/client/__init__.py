@@ -1,0 +1,2 @@
+from .client import Client, Responses, Response, Result  # noqa: F401
+from .drivers import Driver, InterpDriver  # noqa: F401

@@ -1,0 +1,228 @@
+"""The constraint-framework client surface.
+
+Re-provides the capability surface of the vendored framework client
+(frameworks/constraint/pkg/client/client.go): template lifecycle with
+semantic-equality short-circuit, constraint CRUD with CRD-schema validation,
+data replication, Review and Audit with the response schema of
+regolib/src.go:13-19, Reset and Dump — over the Driver seam.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ..apis.templates import ConstraintTemplate, TemplateError
+from ..engine.interp import TemplatePolicy
+from ..rego.ast import RegoError
+from ..target.target import K8sValidationTarget, WipeData
+from . import crd as crdlib
+from .drivers import CompiledTemplate, Driver, InterpDriver, Result
+
+
+class ClientError(Exception):
+    pass
+
+
+@dataclass
+class Response:
+    """Per-target response (vendored types/validation.go)."""
+
+    target: str
+    results: List[Result] = field(default_factory=list)
+    trace: Optional[str] = None
+    input: Optional[Any] = None
+
+
+@dataclass
+class Responses:
+    by_target: Dict[str, Response] = field(default_factory=dict)
+
+    def results(self) -> List[Result]:
+        out: List[Result] = []
+        for t in sorted(self.by_target):
+            out.extend(self.by_target[t].results)
+        return out
+
+    def trace_dump(self) -> str:
+        lines = []
+        for t in sorted(self.by_target):
+            r = self.by_target[t]
+            lines.append(f"Target: {t}")
+            lines.append(r.trace or "(trace disabled)")
+        return "\n".join(lines)
+
+
+class Client:
+    """The analogue of the opa-frameworks constraint Client, bound to the
+    K8s validation target and a pluggable Driver."""
+
+    def __init__(
+        self,
+        driver: Optional[Driver] = None,
+        target: Optional[K8sValidationTarget] = None,
+    ):
+        self.target = target or K8sValidationTarget()
+        self.driver: Driver = driver or InterpDriver(self.target)
+        self.driver.init()
+        self._templates: Dict[str, ConstraintTemplate] = {}
+        self._crds: Dict[str, dict] = {}
+        self._semantic: Dict[str, str] = {}
+
+    # ---- templates --------------------------------------------------------
+
+    def create_crd(self, template: dict) -> dict:
+        """Validate a template and synthesize its constraint CRD without
+        installing anything (client.go:350-356) — the webhook's dry-run."""
+        tmpl, _policy = self._compile_template(template)
+        crd = crdlib.synthesize_crd(
+            tmpl.kind, tmpl.validation_schema, self.target.match_schema()
+        )
+        crdlib.validate_crd(crd)
+        return crd
+
+    def add_template(self, template: dict) -> dict:
+        """Compile + install a template; returns the synthesized constraint
+        CRD (client.go:361-447).  Unchanged templates (semantic equality)
+        short-circuit before the expensive Rego compile, as the reference
+        does (client.go:361-379)."""
+        try:
+            parsed = ConstraintTemplate.from_dict(template)
+        except TemplateError as e:
+            raise ClientError(str(e))
+        key = parsed.semantic_key()
+        if self._semantic.get(parsed.kind) == key:
+            return self._crds[parsed.kind]
+        tmpl, policy = self._compile_template(template)
+        crd = crdlib.synthesize_crd(
+            tmpl.kind, tmpl.validation_schema, self.target.match_schema()
+        )
+        crdlib.validate_crd(crd)
+        artifact = CompiledTemplate(kind=tmpl.kind, policy=policy, semantic_key=key)
+        self.driver.put_template(tmpl.kind, artifact)
+        self._templates[tmpl.kind] = tmpl
+        self._crds[tmpl.kind] = crd
+        self._semantic[tmpl.kind] = key
+        return crd
+
+    def remove_template(self, template: dict) -> bool:
+        tmpl = ConstraintTemplate.from_dict(template)
+        self._templates.pop(tmpl.kind, None)
+        self._crds.pop(tmpl.kind, None)
+        self._semantic.pop(tmpl.kind, None)
+        return self.driver.delete_template(tmpl.kind)
+
+    def _compile_template(self, template: dict):
+        try:
+            tmpl = ConstraintTemplate.from_dict(template)
+        except TemplateError as e:
+            raise ClientError(str(e))
+        spec = tmpl.targets[0]
+        if spec.target and spec.target != self.target.name:
+            raise ClientError(f"target {spec.target!r} not recognized")
+        try:
+            policy = TemplatePolicy.compile(spec.rego, spec.libs)
+        except RegoError as e:
+            raise ClientError(f"failed to compile template {tmpl.name}: {e}")
+        return tmpl, policy
+
+    def get_template(self, kind: str) -> Optional[ConstraintTemplate]:
+        return self._templates.get(kind)
+
+    def templates(self) -> List[str]:
+        return sorted(self._templates)
+
+    # ---- constraints ------------------------------------------------------
+
+    def validate_constraint(self, constraint: dict):
+        """Schema-validate a constraint against its template's CRD
+        (client.go:662-664 -> crd_helpers.go:157-177)."""
+        kind = constraint.get("kind") if isinstance(constraint, dict) else None
+        crd = self._crds.get(kind or "")
+        if crd is None:
+            raise ClientError(f"no constraint template found for kind {kind!r}")
+        try:
+            crdlib.validate_constraint(constraint, crd)
+        except crdlib.CRDError as e:
+            raise ClientError(str(e))
+
+    def add_constraint(self, constraint: dict):
+        self.validate_constraint(constraint)
+        kind = constraint["kind"]
+        name = constraint["metadata"]["name"]
+        self.driver.put_constraint(kind, name, constraint)
+
+    def remove_constraint(self, constraint: dict) -> bool:
+        kind = constraint.get("kind")
+        name = (constraint.get("metadata") or {}).get("name")
+        if not kind or not name:
+            raise ClientError("constraint requires kind and metadata.name")
+        return self.driver.delete_constraint(kind, name)
+
+    # ---- data -------------------------------------------------------------
+
+    def add_data(self, obj: Any):
+        handled, segments, data = self.target.process_data(obj)
+        if not handled:
+            raise ClientError("data not handled by target")
+        if data is None:
+            raise ClientError("cannot add WipeData")
+        self.driver.put_data(segments, data)
+
+    def remove_data(self, obj: Any) -> bool:
+        handled, segments, _data = self.target.process_data(obj)
+        if not handled:
+            raise ClientError("data not handled by target")
+        return self.driver.delete_data(segments)
+
+    def wipe_data(self) -> bool:
+        return self.driver.delete_data(())
+
+    # ---- evaluation -------------------------------------------------------
+
+    def review(self, obj: Any, tracing: bool = False) -> Responses:
+        handled, review = self.target.handle_review(obj)
+        if not handled:
+            raise ClientError("review input not handled by target")
+        results, trace = self.driver.review(review, tracing=tracing)
+        for r in results:
+            try:
+                r.resource = self.target.handle_violation(r.review)
+            except Exception:
+                r.resource = None
+        return Responses(
+            by_target={
+                self.target.name: Response(
+                    target=self.target.name,
+                    results=results,
+                    trace=trace,
+                    input=review if tracing else None,
+                )
+            }
+        )
+
+    def audit(self, tracing: bool = False) -> Responses:
+        results, trace = self.driver.audit(tracing=tracing)
+        for r in results:
+            try:
+                r.resource = self.target.handle_violation(r.review)
+            except Exception:
+                r.resource = None
+        return Responses(
+            by_target={
+                self.target.name: Response(
+                    target=self.target.name, results=results, trace=trace
+                )
+            }
+        )
+
+    # ---- admin ------------------------------------------------------------
+
+    def reset(self):
+        self.driver.reset()
+        self._templates.clear()
+        self._crds.clear()
+        self._semantic.clear()
+
+    def dump(self) -> str:
+        return self.driver.dump()

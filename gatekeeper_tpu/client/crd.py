@@ -1,0 +1,157 @@
+"""Constraint-CRD synthesis and structural validation.
+
+Re-provides the reference's crd_helpers (vendored
+frameworks/constraint/pkg/client/crd_helpers.go:40-177): the constraint CRD
+schema is assembled from the template's parameter schema plus the target's
+match schema plus `enforcementAction`, and constraint CRs are validated
+against it.  Validation is deliberately lenient where the reference's
+pre-structural-schema CRDs were (malformed schema nodes allow anything).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+CONSTRAINT_GROUP = "constraints.gatekeeper.sh"
+CONSTRAINT_VERSIONS = ("v1beta1", "v1alpha1")
+
+
+class CRDError(Exception):
+    pass
+
+
+def synthesize_crd(kind: str, parameters_schema: Optional[dict], match_schema: dict) -> dict:
+    """Build the constraint CRD (apiextensions v1beta1 shape) for a template
+    kind, per crd_helpers.go:40-155."""
+    plural = kind.lower()
+    props: Dict[str, Any] = {
+        "match": match_schema,
+        "enforcementAction": {"type": "string"},
+    }
+    if parameters_schema is not None:
+        props["parameters"] = parameters_schema
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1beta1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {
+            "name": f"{plural}.{CONSTRAINT_GROUP}",
+            "labels": {"gatekeeper.sh/constraint": "yes"},
+        },
+        "spec": {
+            "group": CONSTRAINT_GROUP,
+            "names": {
+                "kind": kind,
+                "listKind": kind + "List",
+                "plural": plural,
+                "singular": plural,
+            },
+            "scope": "Cluster",
+            "subresources": {"status": {}},
+            "versions": [
+                {"name": "v1beta1", "served": True, "storage": True},
+                {"name": "v1alpha1", "served": True, "storage": False},
+            ],
+            "validation": {
+                "openAPIV3Schema": {
+                    "properties": {
+                        "metadata": {
+                            "properties": {
+                                "name": {"type": "string", "maxLength": 63}
+                            }
+                        },
+                        "spec": {"properties": props},
+                        "status": {},
+                    }
+                }
+            },
+        },
+    }
+
+
+def validate_crd(crd: dict):
+    """Structural sanity of a synthesized CRD (crd_helpers.go:118-155)."""
+    spec = crd.get("spec") or {}
+    names = spec.get("names") or {}
+    if not names.get("kind"):
+        raise CRDError("CRD has no kind")
+    meta_name = (crd.get("metadata") or {}).get("name", "")
+    expected = f"{names.get('plural')}.{spec.get('group')}"
+    if meta_name != expected:
+        raise CRDError(f"CRD name {meta_name!r} != {expected!r}")
+
+
+def validate_constraint(constraint: dict, crd: dict):
+    """Validate a constraint CR against its synthesized CRD
+    (crd_helpers.go:157-177)."""
+    if not isinstance(constraint, dict):
+        raise CRDError("constraint must be an object")
+    api = constraint.get("apiVersion", "")
+    group, _, version = api.partition("/")
+    if group != CONSTRAINT_GROUP:
+        raise CRDError(f"constraint group {group!r} != {CONSTRAINT_GROUP!r}")
+    if version not in CONSTRAINT_VERSIONS:
+        raise CRDError(f"unsupported constraint version {version!r}")
+    want_kind = ((crd.get("spec") or {}).get("names") or {}).get("kind")
+    if constraint.get("kind") != want_kind:
+        raise CRDError(f"constraint kind {constraint.get('kind')!r} != {want_kind!r}")
+    if not (constraint.get("metadata") or {}).get("name"):
+        raise CRDError("constraint has no metadata.name")
+    schema = (((crd.get("spec") or {}).get("validation")) or {}).get("openAPIV3Schema")
+    if schema:
+        errs: List[str] = []
+        _validate_value(constraint, schema, "", errs)
+        if errs:
+            raise CRDError("; ".join(errs))
+
+
+def validate_enforcement_action(constraint: dict):
+    """util/enforcement_action.go:11-47: only deny/dryrun are recognized."""
+    action = (constraint.get("spec") or {}).get("enforcementAction", "deny")
+    if action not in ("deny", "dryrun"):
+        raise CRDError(f"unrecognized enforcementAction {action!r}")
+
+
+_TYPES = {
+    "string": lambda v: isinstance(v, str),
+    "integer": lambda v: isinstance(v, int) and not isinstance(v, bool),
+    "number": lambda v: isinstance(v, (int, float)) and not isinstance(v, bool),
+    "boolean": lambda v: isinstance(v, bool),
+    "array": lambda v: isinstance(v, list),
+    "object": lambda v: isinstance(v, dict),
+    "null": lambda v: v is None,
+}
+
+
+def _validate_value(value: Any, schema: Any, path: str, errs: List[str]):
+    if not isinstance(schema, dict):
+        return  # malformed schema node (e.g. `items: string`): allow anything
+    typ = schema.get("type")
+    if isinstance(typ, str) and typ in _TYPES:
+        if value is None and typ != "null":
+            # K8s treats nulls as unset; defer to required-field handling.
+            return
+        if not _TYPES[typ](value):
+            errs.append(f"{path or '.'}: expected {typ}")
+            return
+    if isinstance(value, dict):
+        props = schema.get("properties")
+        if isinstance(props, dict):
+            for k, sub in props.items():
+                if k in value:
+                    _validate_value(value[k], sub, f"{path}.{k}", errs)
+        addl = schema.get("additionalProperties")
+        if isinstance(addl, dict):
+            props = props or {}
+            for k, v in value.items():
+                if k not in props:
+                    _validate_value(v, addl, f"{path}.{k}", errs)
+        req = schema.get("required")
+        if isinstance(req, list):
+            for k in req:
+                if not isinstance(value, dict) or k not in value:
+                    errs.append(f"{path or '.'}: missing required field {k!r}")
+    elif isinstance(value, list):
+        items = schema.get("items")
+        if isinstance(items, dict):
+            for i, v in enumerate(value):
+                _validate_value(v, items, f"{path}[{i}]", errs)

@@ -1,0 +1,323 @@
+"""The Driver seam and the interpreter-backed reference driver.
+
+The reference abstracts its policy engine behind the Driver interface
+(vendored frameworks/constraint/pkg/client/drivers/interface.go:21-39) whose
+only implementation wraps OPA's compiler+topdown (drivers/local/local.go).
+Here the same seam separates the control plane from the evaluation backend:
+
+  InterpDriver  — pure-Python oracle (this module)
+  TpuDriver     — vectorized JAX/XLA backend (gatekeeper_tpu.ops.driver)
+
+Drivers hold compiled template policies, constraints, and the replicated
+inventory, and serve Review (one review x all constraints) and Audit
+(all cached objects x all constraints).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Protocol, Tuple
+
+from ..engine.interp import TemplatePolicy
+from ..engine.value import freeze
+from ..target.match import constraint_matches, needs_autoreject
+from ..target.target import K8sValidationTarget
+
+
+@dataclass
+class Result:
+    """One violation (vendored types/validation.go Result)."""
+
+    msg: str
+    constraint: dict
+    review: Any = None
+    resource: Any = None
+    metadata: dict = field(default_factory=dict)
+    enforcement_action: str = "deny"
+
+    def to_dict(self) -> dict:
+        return {
+            "msg": self.msg,
+            "metadata": self.metadata,
+            "constraint": self.constraint,
+            "review": self.review,
+            "resource": self.resource,
+            "enforcementAction": self.enforcement_action,
+        }
+
+
+@dataclass
+class CompiledTemplate:
+    """Driver-side artifact for one ConstraintTemplate."""
+
+    kind: str
+    policy: TemplatePolicy
+    semantic_key: str
+
+
+class Driver(Protocol):
+    def init(self) -> None: ...
+
+    def put_template(self, kind: str, artifact: CompiledTemplate) -> None: ...
+
+    def delete_template(self, kind: str) -> bool: ...
+
+    def put_constraint(self, kind: str, name: str, constraint: dict) -> None: ...
+
+    def delete_constraint(self, kind: str, name: str) -> bool: ...
+
+    def put_data(self, segments: Tuple[str, ...], obj: Any) -> None: ...
+
+    def delete_data(self, segments: Tuple[str, ...]) -> bool: ...
+
+    def review(self, review: dict, tracing: bool = False) -> Tuple[List[Result], Optional[str]]: ...
+
+    def audit(self, tracing: bool = False) -> Tuple[List[Result], Optional[str]]: ...
+
+    def reset(self) -> None: ...
+
+    def dump(self) -> str: ...
+
+
+class InventoryStore:
+    """Replicated cluster state, laid out exactly as the reference's data
+    paths (pkg/target/target.go:62-89):
+      cluster/<groupVersion>/<kind>/<name>
+      namespace/<ns>/<groupVersion>/<kind>/<name>
+    Leaf objects are stored frozen; the frozen spine view is rebuilt lazily
+    per write epoch so queries share one immutable inventory tree.
+    """
+
+    def __init__(self):
+        self.tree: Dict[str, Any] = {}
+        self._frozen = None
+        self._lock = threading.Lock()
+
+    def put(self, segments: Tuple[str, ...], obj: Any):
+        with self._lock:
+            node = self.tree
+            for seg in segments[:-1]:
+                node = node.setdefault(seg, {})
+            node[segments[-1]] = freeze(obj)
+            self._frozen = None
+
+    def delete(self, segments: Tuple[str, ...]) -> bool:
+        with self._lock:
+            if not segments:  # WipeData
+                had = bool(self.tree)
+                self.tree = {}
+                self._frozen = None
+                return had
+            node = self.tree
+            for seg in segments[:-1]:
+                node = node.get(seg)
+                if not isinstance(node, dict):
+                    return False
+            if segments[-1] in node:
+                del node[segments[-1]]
+                self._frozen = None
+                return True
+            return False
+
+    def frozen(self):
+        with self._lock:
+            if self._frozen is None:
+                self._frozen = freeze_spine(self.tree)
+            return self._frozen
+
+    def cached_namespace(self, name: Any) -> Optional[dict]:
+        """Thawed cluster/v1/Namespace/<name>, used by nsSelector matching."""
+        if not isinstance(name, str):
+            return None
+        try:
+            from ..engine.value import thaw
+
+            obj = self.tree["cluster"]["v1"]["Namespace"][name]
+        except (KeyError, TypeError):
+            return None
+        return thaw(obj)
+
+    def iter_objects(self):
+        """Yield (obj_frozen, api_version, kind, name, namespace) for every
+        cached object; namespace == "" for cluster-scoped."""
+        for api, kinds in sorted((self.tree.get("cluster") or {}).items()):
+            for kind, names in sorted(kinds.items()):
+                for name, obj in sorted(names.items()):
+                    yield obj, api, kind, name, ""
+        for ns, apis in sorted((self.tree.get("namespace") or {}).items()):
+            for api, kinds in sorted(apis.items()):
+                for kind, names in sorted(kinds.items()):
+                    for name, obj in sorted(names.items()):
+                        yield obj, api, kind, name, ns
+
+
+def freeze_spine(node):
+    from ..engine.value import FrozenDict
+
+    if isinstance(node, dict):
+        return FrozenDict({k: freeze_spine(v) for k, v in node.items()})
+    return node  # already-frozen leaf
+
+
+class InterpDriver:
+    """Oracle driver: per-cell interpreter evaluation.  Semantics source of
+    truth; the TPU driver is differentially tested against it."""
+
+    def __init__(self, target: Optional[K8sValidationTarget] = None):
+        self.target = target or K8sValidationTarget()
+        self.templates: Dict[str, CompiledTemplate] = {}
+        self.constraints: Dict[str, Dict[str, dict]] = {}
+        self.store = InventoryStore()
+        self._lock = threading.RLock()
+
+    # ---- lifecycle --------------------------------------------------------
+
+    def init(self):
+        pass
+
+    def reset(self):
+        with self._lock:
+            self.templates.clear()
+            self.constraints.clear()
+            self.store = InventoryStore()
+
+    def put_template(self, kind: str, artifact: CompiledTemplate):
+        with self._lock:
+            self.templates[kind] = artifact
+
+    def delete_template(self, kind: str) -> bool:
+        with self._lock:
+            self.constraints.pop(kind, None)
+            return self.templates.pop(kind, None) is not None
+
+    def put_constraint(self, kind: str, name: str, constraint: dict):
+        with self._lock:
+            self.constraints.setdefault(kind, {})[name] = constraint
+
+    def delete_constraint(self, kind: str, name: str) -> bool:
+        with self._lock:
+            return self.constraints.get(kind, {}).pop(name, None) is not None
+
+    def put_data(self, segments: Tuple[str, ...], obj: Any):
+        # The driver lock (not just the store's) excludes writes while
+        # review/audit iterate the tree.
+        with self._lock:
+            self.store.put(segments, obj)
+
+    def delete_data(self, segments: Tuple[str, ...]) -> bool:
+        with self._lock:
+            return self.store.delete(segments)
+
+    # ---- evaluation -------------------------------------------------------
+
+    @staticmethod
+    def _enforcement_action(constraint: dict) -> str:
+        spec = constraint.get("spec") or {}
+        action = spec.get("enforcementAction")
+        return action if isinstance(action, str) and action else "deny"
+
+    def review(self, review: dict, tracing: bool = False) -> Tuple[List[Result], Optional[str]]:
+        with self._lock:
+            inventory = self.store.frozen()
+            cached_ns = self.store.cached_namespace
+            results: List[Result] = []
+            trace: List[str] = [] if tracing else None
+            frozen_review = freeze(review)
+            for kind in sorted(self.constraints):
+                tmpl = self.templates.get(kind)
+                for name in sorted(self.constraints[kind]):
+                    constraint = self.constraints[kind][name]
+                    action = self._enforcement_action(constraint)
+                    if needs_autoreject(constraint, review, cached_ns):
+                        results.append(
+                            Result(
+                                msg="Namespace is not cached in OPA.",
+                                metadata={"details": {}},
+                                constraint=constraint,
+                                review=review,
+                                enforcement_action=action,
+                            )
+                        )
+                        if tracing:
+                            trace.append(f"autoreject {kind}/{name}")
+                    matched = constraint_matches(constraint, review, cached_ns)
+                    if tracing:
+                        trace.append(f"match {kind}/{name} = {matched}")
+                    if not matched or tmpl is None:
+                        continue
+                    params = (constraint.get("spec") or {}).get("parameters") or {}
+                    violations = tmpl.policy.eval_violations(
+                        frozen_review, freeze(params), inventory
+                    )
+                    for v in violations:
+                        results.append(
+                            Result(
+                                msg=str(v.get("msg", "")),
+                                metadata={"details": v.get("details", {})},
+                                constraint=constraint,
+                                review=review,
+                                enforcement_action=action,
+                            )
+                        )
+                        if tracing:
+                            trace.append(f"violation {kind}/{name}: {v.get('msg')}")
+            return results, ("\n".join(trace) if tracing else None)
+
+    def audit(self, tracing: bool = False) -> Tuple[List[Result], Optional[str]]:
+        with self._lock:
+            inventory = self.store.frozen()
+            cached_ns = self.store.cached_namespace
+            results: List[Result] = []
+            trace: List[str] = [] if tracing else None
+            from ..engine.value import thaw
+
+            for obj_frozen, api, kind_name, name, ns in self.store.iter_objects():
+                obj = thaw(obj_frozen)
+                review = self.target.make_audit_review(obj, api, kind_name, name, ns)
+                frozen_review = freeze(review)
+                for kind in sorted(self.constraints):
+                    tmpl = self.templates.get(kind)
+                    if tmpl is None:
+                        continue
+                    for cname in sorted(self.constraints[kind]):
+                        constraint = self.constraints[kind][cname]
+                        if not constraint_matches(constraint, review, cached_ns):
+                            continue
+                        params = (constraint.get("spec") or {}).get("parameters") or {}
+                        violations = tmpl.policy.eval_violations(
+                            frozen_review, freeze(params), inventory
+                        )
+                        action = self._enforcement_action(constraint)
+                        for v in violations:
+                            results.append(
+                                Result(
+                                    msg=str(v.get("msg", "")),
+                                    metadata={"details": v.get("details", {})},
+                                    constraint=constraint,
+                                    review=review,
+                                    enforcement_action=action,
+                                )
+                            )
+                            if tracing:
+                                trace.append(
+                                    f"violation {kind}/{cname} on {kind_name}/{name}: {v.get('msg')}"
+                                )
+            return results, ("\n".join(trace) if tracing else None)
+
+    def dump(self) -> str:
+        from ..engine.value import thaw
+
+        with self._lock:
+            return json.dumps(
+                {
+                    "templates": sorted(self.templates),
+                    "constraints": {
+                        k: sorted(v) for k, v in self.constraints.items()
+                    },
+                    "data": thaw(freeze_spine(self.store.tree)),
+                },
+                indent=2,
+                sort_keys=True,
+            )

@@ -64,6 +64,9 @@ class CompiledModule:
     __slots__ = ("module", "rules")
 
     def __init__(self, module: Module):
+        from ..rego.safety import reorder_module
+
+        module = reorder_module(module)
         self.module = module
         self.rules: Dict[str, List[Rule]] = {}
         for r in module.rules:

@@ -1,0 +1,259 @@
+"""Body reordering for variable safety.
+
+Rego bodies are declarative: statement order does not determine evaluation
+order.  OPA's compiler reorders body expressions so every variable is bound
+before use (ast/compile.go "reordering for safety"); e.g. the corpus's
+k8suniqueserviceselector writes
+
+    selectors := [s | s = concat(":", [key, val]); val = obj.spec.selector[key]]
+
+where `key`/`val` are bound by the *second* statement.  This pass performs the
+same greedy topological reorder over (needs, binds) var sets, recursively
+including comprehension bodies.
+"""
+
+from __future__ import annotations
+
+from typing import FrozenSet, List, Set, Tuple
+
+from .ast import (
+    ArrayCompr,
+    ArrayTerm,
+    BinOp,
+    Body,
+    Call,
+    Expr,
+    Module,
+    Node,
+    ObjectCompr,
+    ObjectTerm,
+    Ref,
+    Rule,
+    Scalar,
+    SetCompr,
+    SetTerm,
+    UnaryMinus,
+    Var,
+)
+
+_GLOBALS = frozenset({"input", "data"})
+
+
+class _Analysis:
+    __slots__ = ("needs", "binds")
+
+    def __init__(self):
+        self.needs: Set[str] = set()
+        self.binds: Set[str] = set()
+
+
+def _is_special(name: str, rule_names: FrozenSet[str]) -> bool:
+    return name in _GLOBALS or name in rule_names
+
+
+def _walk(t: Node, pos: str, a: _Analysis, rule_names: FrozenSet[str]):
+    """pos: 'pattern' (vars get bound) or 'eval' (vars must be bound)."""
+    if isinstance(t, Scalar):
+        return
+    if isinstance(t, Var):
+        if t.is_wildcard or _is_special(t.name, rule_names):
+            return
+        (a.binds if pos == "pattern" else a.needs).add(t.name)
+        return
+    if isinstance(t, Ref):
+        if isinstance(t.head, Var):
+            if not t.head.is_wildcard and not _is_special(t.head.name, rule_names):
+                a.needs.add(t.head.name)
+        else:
+            _walk(t.head, "eval", a, rule_names)
+        for op in t.operands:
+            if isinstance(op, Var):
+                if not op.is_wildcard and not _is_special(op.name, rule_names):
+                    a.binds.add(op.name)  # enumeration binds ref operands
+            elif isinstance(op, (ArrayTerm, ObjectTerm)):
+                _walk(op, "pattern", a, rule_names)
+            else:
+                _walk(op, "eval", a, rule_names)
+        return
+    if isinstance(t, Call):
+        for arg in t.args:
+            _walk(arg, "eval", a, rule_names)
+        return
+    if isinstance(t, BinOp):
+        _walk(t.lhs, "eval", a, rule_names)
+        _walk(t.rhs, "eval", a, rule_names)
+        return
+    if isinstance(t, UnaryMinus):
+        _walk(t.operand, "eval", a, rule_names)
+        return
+    if isinstance(t, (ArrayTerm, SetTerm)):
+        inner_pos = pos if isinstance(t, ArrayTerm) else "eval"
+        for item in t.items:
+            _walk(item, inner_pos, a, rule_names)
+        return
+    if isinstance(t, ObjectTerm):
+        for k, v in t.pairs:
+            _walk(k, "eval", a, rule_names)
+            _walk(v, pos, a, rule_names)
+        return
+    if isinstance(t, (ArrayCompr, SetCompr, ObjectCompr)):
+        # Comprehensions have local scope: they need only the free variables
+        # their bodies cannot bind internally.
+        sub = _Analysis()
+        for e in t.body:
+            en, eb = _expr_analysis(e, rule_names)
+            sub.needs |= en
+            sub.binds |= eb
+        heads = (
+            (t.key, t.value) if isinstance(t, ObjectCompr) else (t.head,)
+        )
+        for h in heads:
+            _walk(h, "eval", sub, rule_names)
+        a.needs |= sub.needs - sub.binds
+        return
+    raise TypeError(f"unexpected node {type(t).__name__}")
+
+
+def _all_vars(t: Node, rule_names: FrozenSet[str], out: Set[str]):
+    a = _Analysis()
+    _walk(t, "eval", a, rule_names)
+    out |= a.needs | a.binds
+
+
+def _expr_analysis(e: Expr, rule_names: FrozenSet[str]) -> Tuple[Set[str], Set[str]]:
+    a = _Analysis()
+    if e.kind == "some":
+        return set(), set()
+    if e.kind == "not":
+        # Negation safety: everything under `not` must already be bound.
+        inner = e.terms[0]
+        needs: Set[str] = set()
+        for t in inner.terms:
+            if isinstance(t, Expr):
+                n2, b2 = _expr_analysis(t, rule_names)
+                needs |= n2 | b2
+            else:
+                _all_vars(t, rule_names, needs)
+        return needs, set()
+    if e.kind in ("unify", "assign"):
+        for side in e.terms:
+            if isinstance(side, Var):
+                if not side.is_wildcard and not _is_special(side.name, rule_names):
+                    a.binds.add(side.name)
+            elif isinstance(side, (ArrayTerm, ObjectTerm)):
+                _walk(side, "pattern", a, rule_names)
+            else:
+                _walk(side, "eval", a, rule_names)
+        return a.needs, a.binds
+    _walk(e.terms[0], "eval", a, rule_names)
+    return a.needs, a.binds
+
+
+def reorder_body(body: Body, initial_bound: Set[str], rule_names: FrozenSet[str]) -> Body:
+    if len(body) <= 1:
+        return body
+    infos = [(e, *_expr_analysis(e, rule_names)) for e in body]
+    binds_all: Set[str] = set()
+    needs_all: Set[str] = set()
+    for _e, n, b in infos:
+        binds_all |= b
+        needs_all |= n
+    # Vars never bound in this body are assumed bound by the enclosing scope
+    # (comprehension over outer vars) — or genuinely unsafe, surfacing at eval.
+    bound = set(initial_bound) | (needs_all - binds_all)
+    remaining = list(infos)
+    ordered: List[Expr] = []
+    while remaining:
+        progress = False
+        for i, (e, n, b) in enumerate(remaining):
+            if n <= bound:
+                ordered.append(e)
+                bound |= b
+                remaining.pop(i)
+                progress = True
+                break
+        if not progress:
+            # Cannot order safely (e.g. mutually-recursive negation);
+            # keep original order for the tail — eval will surface errors.
+            ordered.extend(e for e, _n, _b in remaining)
+            break
+    return tuple(ordered)
+
+
+def _transform_term(t: Node, rule_names: FrozenSet[str]) -> Node:
+    if isinstance(t, (Scalar, Var)):
+        return t
+    if isinstance(t, Ref):
+        return Ref(
+            _transform_term(t.head, rule_names),  # type: ignore[arg-type]
+            tuple(_transform_term(op, rule_names) for op in t.operands),
+        )
+    if isinstance(t, Call):
+        return Call(t.path, tuple(_transform_term(x, rule_names) for x in t.args))
+    if isinstance(t, BinOp):
+        return BinOp(t.op, _transform_term(t.lhs, rule_names), _transform_term(t.rhs, rule_names))
+    if isinstance(t, UnaryMinus):
+        return UnaryMinus(_transform_term(t.operand, rule_names))
+    if isinstance(t, ArrayTerm):
+        return ArrayTerm(tuple(_transform_term(x, rule_names) for x in t.items))
+    if isinstance(t, SetTerm):
+        return SetTerm(tuple(_transform_term(x, rule_names) for x in t.items))
+    if isinstance(t, ObjectTerm):
+        return ObjectTerm(
+            tuple(
+                (_transform_term(k, rule_names), _transform_term(v, rule_names))
+                for k, v in t.pairs
+            )
+        )
+    if isinstance(t, ArrayCompr):
+        return ArrayCompr(
+            _transform_term(t.head, rule_names),
+            transform_body(t.body, set(), rule_names),
+        )
+    if isinstance(t, SetCompr):
+        return SetCompr(
+            _transform_term(t.head, rule_names),
+            transform_body(t.body, set(), rule_names),
+        )
+    if isinstance(t, ObjectCompr):
+        return ObjectCompr(
+            _transform_term(t.key, rule_names),
+            _transform_term(t.value, rule_names),
+            transform_body(t.body, set(), rule_names),
+        )
+    raise TypeError(f"unexpected node {type(t).__name__}")
+
+
+def _transform_expr(e: Expr, rule_names: FrozenSet[str]) -> Expr:
+    if e.kind == "not":
+        return Expr("not", (_transform_expr(e.terms[0], rule_names),), e.loc)
+    if e.kind == "some":
+        return e
+    return Expr(
+        e.kind, tuple(_transform_term(t, rule_names) for t in e.terms), e.loc
+    )
+
+
+def transform_body(body: Body, initial_bound: Set[str], rule_names: FrozenSet[str]) -> Body:
+    transformed = tuple(_transform_expr(e, rule_names) for e in body)
+    return reorder_body(transformed, initial_bound, rule_names)
+
+
+def reorder_module(module: Module) -> Module:
+    """Reorder every rule body (and nested comprehension bodies) for safety."""
+    rule_names = frozenset(r.name for r in module.rules)
+    new_rules = []
+    for r in module.rules:
+        params: Set[str] = set()
+        if r.args:
+            a = _Analysis()
+            for p in r.args:
+                _walk(p, "pattern", a, rule_names)
+            params = a.binds
+        body = transform_body(r.body, params, rule_names)
+        key = _transform_term(r.key, rule_names) if r.key is not None else None
+        value = _transform_term(r.value, rule_names) if r.value is not None else None
+        new_rules.append(
+            Rule(r.name, r.args, key, value, body, r.is_default, r.loc)
+        )
+    return Module(package=module.package, rules=tuple(new_rules), source=module.source)

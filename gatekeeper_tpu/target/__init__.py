@@ -1,0 +1,2 @@
+from .match import constraint_matches, needs_autoreject, matches_label_selector  # noqa: F401
+from .target import K8sValidationTarget, AugmentedReview, AugmentedUnstructured, WipeData  # noqa: F401

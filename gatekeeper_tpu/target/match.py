@@ -1,0 +1,263 @@
+"""Native constraint-match semantics.
+
+This is a faithful, vectorization-friendly re-implementation of the
+reference's Rego matching library (pkg/target/target_template_source.go,
+generated from pkg/target/regolib/src.rego): kind selectors, namespaces,
+excludedNamespaces, labelSelector, namespaceSelector, scope, and the
+namespace-not-cached autoreject rule.  Its behavior — including the
+undefined-propagation quirks of the original Rego — is pinned by a
+differential test that runs the original library source through the
+gatekeeper_tpu interpreter (tests/test_match_differential.py).
+
+`None` field values are treated as missing, per get_default
+(target_template_source.go:107-125).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+_MISSING = object()
+
+
+def _get(obj: Any, field: str, default=_MISSING):
+    """get_default semantics: missing key or null -> default."""
+    if not isinstance(obj, dict):
+        return default
+    v = obj.get(field, _MISSING)
+    if v is _MISSING or v is None:
+        return default
+    return v
+
+
+def _is_ns(kind: Any) -> bool:
+    # target_template_source.go:289-292
+    return (
+        isinstance(kind, dict)
+        and kind.get("group") == ""
+        and kind.get("kind") == "Namespace"
+    )
+
+
+def _always_match_ns_selectors(review: dict) -> bool:
+    # :316-319 — cluster-scoped resources (empty/missing namespace) that are
+    # not themselves Namespaces skip all namespace-based selectors.
+    return not _is_ns(review.get("kind")) and _get(review, "namespace", "") == ""
+
+
+def _get_ns_name(review: dict):
+    # :303-311; returns _MISSING when undefined in the Rego original.
+    if _is_ns(review.get("kind")):
+        obj = _get(review, "object", _MISSING)
+        if obj is _MISSING:
+            return _MISSING
+        meta = _get(obj, "metadata", _MISSING)
+        if meta is _MISSING:
+            return _MISSING
+        return _get(meta, "name", _MISSING)
+    return _get(review, "namespace", _MISSING)
+
+
+def _kind_selector_matches(match: dict, review: dict) -> bool:
+    # :131-156
+    kinds = _get(match, "kinds", [{"apiGroups": ["*"], "kinds": ["*"]}])
+    if not isinstance(kinds, list):
+        return False
+    kind = review.get("kind") if isinstance(review.get("kind"), dict) else {}
+    group = kind.get("group", _MISSING)
+    k = kind.get("kind", _MISSING)
+    for ks in kinds:
+        if not isinstance(ks, dict):
+            continue
+        groups = ks.get("apiGroups") or []
+        names = ks.get("kinds") or []
+        g_ok = "*" in groups or (group is not _MISSING and group in groups)
+        k_ok = "*" in names or (k is not _MISSING and k in names)
+        if g_ok and k_ok:
+            return True
+    return False
+
+
+def _matches_scope(match: dict, review: dict) -> bool:
+    # :162-180 — uses has_field, so a null/false-valued "scope" counts as
+    # PRESENT (unlike get_default) and then matches nothing.
+    if "scope" not in match:
+        return True
+    scope = match.get("scope")
+    if scope == "*":
+        return True
+    ns = _get(review, "namespace", "")
+    if scope == "Namespaced":
+        return ns != ""
+    if scope == "Cluster":
+        return ns == ""
+    return False
+
+
+def _match_expression_violated(op: str, labels: dict, key: Any, values: list) -> bool:
+    # :186-211 — undefined bodies in the original simply don't fire.  The
+    # original's has_field treats a null-valued key as PRESENT.
+    has = isinstance(labels, dict) and key in labels
+    val = labels.get(key) if has else None
+    if op == "In":
+        if not has:
+            return True
+        return len(values) > 0 and val not in values
+    if op == "NotIn":
+        return has and len(values) > 0 and val in values
+    if op == "Exists":
+        return not has
+    if op == "DoesNotExist":
+        return has
+    return False  # unknown operator: no violated-rule clause fires
+
+
+def matches_label_selector(selector: Any, labels: Any) -> bool:
+    # :216-230
+    if not isinstance(selector, dict):
+        selector = {}
+    if not isinstance(labels, dict):
+        labels = {}
+    match_labels = _get(selector, "matchLabels", {})
+    if isinstance(match_labels, dict):
+        for k, v in match_labels.items():
+            # matchLabels[key] == labels[key]: a missing label key is
+            # undefined (never satisfied), even against a null selector value.
+            if k not in labels or labels[k] != v:
+                return False
+    exprs = _get(selector, "matchExpressions", [])
+    if isinstance(exprs, list):
+        for e in exprs:
+            if not isinstance(e, dict):
+                # original indexes operator/key and gets undefined: not violated
+                continue
+            op = e.get("operator")
+            key = e.get("key")
+            values = _get(e, "values", [])
+            if not isinstance(values, list):
+                values = []
+            if _match_expression_violated(op, labels, key, values):
+                return False
+    return True
+
+
+def _any_labelselector_match(selector: Any, review: dict) -> bool:
+    # :233-278 — empty object and missing object are equivalent.
+    obj = _get(review, "object", {})
+    old = _get(review, "oldObject", {})
+    obj_empty = obj == {}
+    old_empty = old == {}
+
+    def labels_of(o):
+        return _get(_get(o, "metadata", {}), "labels", {})
+
+    if obj_empty and old_empty:
+        return matches_label_selector(selector, {})
+    if old_empty:
+        return matches_label_selector(selector, labels_of(obj))
+    if obj_empty:
+        return matches_label_selector(selector, labels_of(old))
+    return matches_label_selector(selector, labels_of(obj)) or matches_label_selector(
+        selector, labels_of(old)
+    )
+
+
+def _matches_namespaces(match: dict, review: dict) -> bool:
+    # :321-337 — has_field semantics: null/false-valued "namespaces" counts
+    # as present; the set comprehension over it is then empty.
+    if "namespaces" not in match:
+        return True
+    if _always_match_ns_selectors(review):
+        return True
+    ns = _get_ns_name(review)
+    if ns is _MISSING:
+        return False
+    nss = match.get("namespaces")
+    return isinstance(nss, list) and ns in nss
+
+
+def _does_not_match_excluded(match: dict, review: dict) -> bool:
+    # :339-355 — same has_field presence semantics as _matches_namespaces.
+    if "excludedNamespaces" not in match:
+        return True
+    if _always_match_ns_selectors(review):
+        return True
+    ns = _get_ns_name(review)
+    if ns is _MISSING:
+        return False
+    nss = match.get("excludedNamespaces")
+    return not (isinstance(nss, list) and ns in nss)
+
+
+def _matches_nsselector(
+    match: dict, review: dict, cached_namespace: Callable[[str], Optional[dict]]
+) -> bool:
+    # :357-380 — gated on has_field (null counts present); the selector value
+    # itself then goes through get_default (null -> {} matches everything).
+    if "namespaceSelector" not in match:
+        return True
+    selector = _get(match, "namespaceSelector", {})
+    if _is_ns(review.get("kind")):
+        return _any_labelselector_match(selector, review)
+    if _always_match_ns_selectors(review):
+        return True
+    # get_ns (:294-301): side-loaded namespace first, then the cached one.
+    ns_obj = _get(_get(review, "_unstable", {}), "namespace", _MISSING)
+    if ns_obj is _MISSING:
+        ns_name = _get(review, "namespace", _MISSING)
+        cached = cached_namespace(ns_name) if ns_name is not _MISSING else None
+        if cached is None:
+            return False
+        ns_obj = cached
+    nslabels = _get(_get(ns_obj, "metadata", {}), "labels", {})
+    return matches_label_selector(selector, nslabels)
+
+
+def constraint_matches(
+    constraint: dict,
+    review: dict,
+    cached_namespace: Callable[[str], Optional[dict]] = lambda name: None,
+) -> bool:
+    """matching_constraints (target_template_source.go:27-44) for one
+    constraint against one review."""
+    match = _get(_get(constraint, "spec", {}), "match", {})
+    if not isinstance(match, dict):
+        match = {}
+    return (
+        _kind_selector_matches(match, review)
+        and _matches_namespaces(match, review)
+        and _does_not_match_excluded(match, review)
+        and _matches_nsselector(match, review, cached_namespace)
+        and _matches_scope(match, review)
+        and _any_labelselector_match(_get(match, "labelSelector", {}), review)
+    )
+
+
+def needs_autoreject(
+    constraint: dict,
+    review: dict,
+    cached_namespace: Callable[[str], Optional[dict]] = lambda name: None,
+) -> bool:
+    """autoreject_review (target_template_source.go:12-25): a constraint with
+    a namespaceSelector autorejects when the review's namespace is neither
+    side-loaded (_unstable.namespace) nor cached.  Faithfully preserves the
+    original's undefined-propagation: a review with *no* namespace field also
+    autorejects (absent namespace makes `namespace == ""` undefined, so
+    `not namespace == ""` succeeds)."""
+    match = _get(_get(constraint, "spec", {}), "match", {})
+    if not isinstance(match, dict) or "namespaceSelector" not in match:
+        return False
+    ns_name = _get(review, "namespace", _MISSING)
+    if ns_name is not _MISSING and not isinstance(ns_name, str):
+        ns_name = _MISSING
+    if ns_name is not _MISSING and cached_namespace(ns_name) is not None:
+        return False
+    # `not input.review._unstable.namespace`: any defined non-false value
+    # blocks autoreject (null included); false or missing lets it through.
+    unstable = review.get("_unstable")
+    if isinstance(unstable, dict) and "namespace" in unstable:
+        if unstable["namespace"] is not False:
+            return False
+    if ns_name == "":
+        return False
+    return True

@@ -1,0 +1,210 @@
+"""K8sValidationTarget — the target adapter (reference pkg/target/target.go).
+
+Owns: data-path layout for replicated cluster state, review shaping
+(unstructured objects / admission requests / augmented reviews -> the
+gkReview JSON the policies see), violation-resource rehydration, and the
+constraint `match` schema.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+from dataclasses import dataclass
+from typing import Any, Optional, Tuple
+from urllib.parse import quote, unquote
+
+
+class TargetError(Exception):
+    pass
+
+
+class WipeData:
+    """Sentinel: remove all replicated data (target.go:36-41)."""
+
+
+@dataclass
+class AugmentedUnstructured:
+    """An object plus its (optional) Namespace for nsSelector matching
+    (target.go:52-56)."""
+
+    object: dict
+    namespace: Optional[dict] = None
+
+
+@dataclass
+class AugmentedReview:
+    """An AdmissionRequest plus its (optional) Namespace (target.go:43-46)."""
+
+    admission_request: dict
+    namespace: Optional[dict] = None
+
+
+class K8sValidationTarget:
+    name = "admission.k8s.gatekeeper.sh"  # target.go:27-29
+
+    # ---- data layout ------------------------------------------------------
+
+    def process_data(self, obj: Any) -> Tuple[bool, Tuple[str, ...], Any]:
+        """Map an object to its inventory path (target.go:62-89):
+        cluster/<groupVersion>/<kind>/<name> or
+        namespace/<ns>/<groupVersion>/<kind>/<name>.
+        Returns (handled, path_segments, data)."""
+        if isinstance(obj, WipeData) or obj is WipeData:
+            return True, (), None
+        if not isinstance(obj, dict):
+            return False, (), None
+        api = obj.get("apiVersion") or ""
+        kind = obj.get("kind") or ""
+        meta = obj.get("metadata") or {}
+        name = meta.get("name") or ""
+        if not api:
+            raise TargetError(f"resource {name} has no version")
+        if not kind:
+            raise TargetError(f"resource {name} has no kind")
+        ns = meta.get("namespace") or ""
+        if ns == "":
+            return True, ("cluster", api, kind, name), obj
+        return True, ("namespace", ns, api, kind, name), obj
+
+    def path_string(self, segments: Tuple[str, ...]) -> str:
+        """External (Driver-interface) path form with the groupVersion
+        URL-escaped, as the reference does with url.PathEscape."""
+        return "/".join(quote(s, safe="") for s in segments)
+
+    @staticmethod
+    def parse_path(path: str) -> Tuple[str, ...]:
+        return tuple(unquote(s) for s in path.split("/"))
+
+    # ---- review shaping ---------------------------------------------------
+
+    def handle_review(self, obj: Any) -> Tuple[bool, Optional[dict]]:
+        """Shape any accepted input into the gkReview JSON document
+        (target.go:91-127).  Returns (handled, review_dict)."""
+        if isinstance(obj, AugmentedReview):
+            review = dict(obj.admission_request)
+            if obj.namespace:
+                review["_unstable"] = {"namespace": obj.namespace}
+            return True, review
+        if isinstance(obj, AugmentedUnstructured):
+            review = self._unstructured_to_request(obj.object)
+            if obj.namespace is not None:
+                review["_unstable"] = {"namespace": obj.namespace}
+                ns_name = (obj.namespace.get("metadata") or {}).get("name")
+                if ns_name:
+                    review["namespace"] = ns_name
+            return True, review
+        if isinstance(obj, dict):
+            if self._is_admission_request(obj):
+                return True, dict(obj)
+            if "apiVersion" in obj and "kind" in obj:
+                return True, self._unstructured_to_request(obj)
+        return False, None
+
+    @staticmethod
+    def _is_admission_request(obj: dict) -> bool:
+        # An AdmissionRequest has a structured kind {group, version, kind}.
+        k = obj.get("kind")
+        return isinstance(k, dict) and "kind" in k
+
+    @staticmethod
+    def _unstructured_to_request(obj: dict) -> dict:
+        api = obj.get("apiVersion") or ""
+        if "/" in api:
+            group, version = api.split("/", 1)
+        else:
+            group, version = "", api
+        return {
+            "kind": {"group": group, "version": version, "kind": obj.get("kind", "")},
+            "name": (obj.get("metadata") or {}).get("name", ""),
+            "object": obj,
+        }
+
+    @staticmethod
+    def make_audit_review(
+        obj: dict, api_version: str, kind: str, name: str, namespace: str = ""
+    ) -> dict:
+        """make_review / add_field for cached-state audits
+        (target_template_source.go:47-90)."""
+        if "/" in api_version:
+            group, version = api_version.split("/", 1)
+        else:
+            group, version = "", api_version
+        review = {
+            "kind": {"group": group, "version": version, "kind": kind},
+            "name": name,
+            "object": obj,
+        }
+        if namespace:
+            review["namespace"] = namespace
+        return review
+
+    # ---- violation rehydration -------------------------------------------
+
+    def handle_violation(self, review: dict) -> dict:
+        """Rebuild the violating object from its review (target.go:193-244)."""
+        kind = review.get("kind") or {}
+        group = kind.get("group")
+        version = kind.get("version")
+        k = kind.get("kind")
+        if not isinstance(group, str) or not isinstance(version, str) or not isinstance(k, str):
+            raise TargetError(f"bad review kind: {json.dumps(kind)[:200]}")
+        api_version = version if group == "" else f"{group}/{version}"
+        obj = review.get("object")
+        if not isinstance(obj, dict) or obj is None:
+            obj = review.get("oldObject")
+        if not isinstance(obj, dict):
+            raise TargetError("no object or oldObject returned in review")
+        out = copy.deepcopy(obj)
+        out["apiVersion"] = api_version
+        out["kind"] = k
+        return out
+
+    # ---- match schema -----------------------------------------------------
+
+    def match_schema(self) -> dict:
+        """The constraint spec.match schema (target.go:246-318)."""
+        string_list = {"type": "array", "items": {"type": "string"}}
+        label_selector = {
+            "type": "object",
+            "properties": {
+                "matchLabels": {
+                    "type": "object",
+                    "additionalProperties": {"type": "string"},
+                },
+                "matchExpressions": {
+                    "type": "array",
+                    "items": {
+                        "type": "object",
+                        "properties": {
+                            "key": {"type": "string"},
+                            "operator": {
+                                "type": "string",
+                                "enum": ["In", "NotIn", "Exists", "DoesNotExist"],
+                            },
+                            "values": string_list,
+                        },
+                    },
+                },
+            },
+        }
+        return {
+            "type": "object",
+            "properties": {
+                "kinds": {
+                    "type": "array",
+                    "items": {
+                        "type": "object",
+                        "properties": {
+                            "apiGroups": string_list,
+                            "kinds": string_list,
+                        },
+                    },
+                },
+                "namespaces": string_list,
+                "excludedNamespaces": string_list,
+                "labelSelector": label_selector,
+                "namespaceSelector": label_selector,
+                "scope": {"type": "string", "enum": ["*", "Cluster", "Namespaced"]},
+            },
+        }

@@ -1,0 +1,190 @@
+"""Differential test: native match semantics vs the reference's own Rego.
+
+Extracts the constraint-matching library straight out of the reference
+(pkg/target/target_template_source.go), substitutes the template roots, and
+evaluates `matching_constraints` / `autoreject_review` with the
+gatekeeper_tpu interpreter.  The native implementation
+(gatekeeper_tpu.target.match) must agree on every generated
+(match-spec x review) combination — including the original's
+undefined-propagation quirks.
+"""
+
+import itertools
+import random
+import re
+
+import pytest
+
+from gatekeeper_tpu.engine.interp import TemplatePolicy
+from gatekeeper_tpu.target.match import constraint_matches, needs_autoreject
+
+from .corpus import REF
+
+GO_SOURCE = REF / "pkg/target/target_template_source.go"
+
+
+def load_matching_library() -> TemplatePolicy:
+    src = GO_SOURCE.read_text()
+    m = re.search(r"const templSrc = `(.*)`", src, re.DOTALL)
+    assert m, "could not extract templSrc"
+    rego = m.group(1)
+    rego = rego.replace("{{.ConstraintsRoot}}", "data.inventory.constraints")
+    rego = rego.replace("{{.DataRoot}}", "data.inventory.external")
+    # Drop the audit cross-product rules (they use `with`, and their
+    # semantics are exercised via the audit path tests instead).
+    rego = re.sub(
+        r"# Namespace-scoped objects\n.*?# Cluster-scoped objects\n.*?\n}\n",
+        "",
+        rego,
+        flags=re.DOTALL,
+    )
+    assert "with input" not in rego
+    return TemplatePolicy.compile(rego, entry="matching_constraints")
+
+
+@pytest.fixture(scope="module")
+def lib():
+    return load_matching_library()
+
+
+NS_OBJECTS = {
+    "cached-a": {
+        "apiVersion": "v1",
+        "kind": "Namespace",
+        "metadata": {"name": "cached-a", "labels": {"team": "a", "env": "prod"}},
+    },
+    "cached-plain": {
+        "apiVersion": "v1",
+        "kind": "Namespace",
+        "metadata": {"name": "cached-plain"},
+    },
+}
+
+
+MATCH_SPECS = [
+    {},
+    {"kinds": [{"apiGroups": [""], "kinds": ["Pod"]}]},
+    {"kinds": [{"apiGroups": ["*"], "kinds": ["*"]}]},
+    {"kinds": [{"apiGroups": ["apps"], "kinds": ["Deployment", "Pod"]}]},
+    {"kinds": [{"apiGroups": [""], "kinds": ["Namespace"]}]},
+    {"namespaces": ["cached-a", "other"]},
+    {"namespaces": []},
+    {"excludedNamespaces": ["cached-a"]},
+    {"scope": "Cluster"},
+    {"scope": "Namespaced"},
+    {"scope": "*"},
+    {"labelSelector": {"matchLabels": {"app": "web"}}},
+    {"labelSelector": {"matchExpressions": [{"key": "app", "operator": "In", "values": ["web", "api"]}]}},
+    {"labelSelector": {"matchExpressions": [{"key": "app", "operator": "NotIn", "values": ["db"]}]}},
+    {"labelSelector": {"matchExpressions": [{"key": "app", "operator": "Exists"}]}},
+    {"labelSelector": {"matchExpressions": [{"key": "app", "operator": "DoesNotExist"}]}},
+    {"labelSelector": {"matchExpressions": [{"key": "app", "operator": "Bogus", "values": ["x"]}]}},
+    {"labelSelector": {"matchExpressions": [{"key": "app", "operator": "In", "values": []}]}},
+    {"namespaceSelector": {"matchLabels": {"team": "a"}}},
+    {"namespaceSelector": {"matchExpressions": [{"key": "team", "operator": "Exists"}]}},
+    {"namespaceSelector": {}},
+    {"namespaces": ["cached-a"], "excludedNamespaces": ["cached-a"]},
+    {"kinds": [{"apiGroups": [""], "kinds": ["Pod"]}],
+     "namespaceSelector": {"matchLabels": {"team": "a"}},
+     "labelSelector": {"matchLabels": {"app": "web"}}},
+    {"scope": "Namespaced", "namespaces": ["cached-plain"]},
+    None,  # no match field at all
+    # null-valued fields: has_field treats null as PRESENT, get_default as
+    # missing — the library mixes both (code-review finding, now pinned).
+    {"scope": None},
+    {"namespaces": None},
+    {"excludedNamespaces": None},
+    {"namespaceSelector": None},
+    {"labelSelector": None},
+    {"labelSelector": {"matchLabels": {"app": None}}},
+    {"labelSelector": {"matchExpressions": None}},
+    {"kinds": None},
+]
+
+
+def make_reviews():
+    reviews = []
+    kinds = [
+        {"group": "", "version": "v1", "kind": "Pod"},
+        {"group": "apps", "version": "v1", "kind": "Deployment"},
+        {"group": "", "version": "v1", "kind": "Namespace"},
+    ]
+    namespaces = [None, "", "cached-a", "cached-plain", "uncached"]
+    labelsets = [None, {}, {"app": "web"}, {"app": "db", "team": "a"}]
+    for kind, ns, labels in itertools.product(kinds, namespaces, labelsets):
+        meta = {"name": "obj-1"}
+        if labels is not None:
+            meta["labels"] = labels
+        obj = {"metadata": meta}
+        review = {"kind": kind, "name": "obj-1", "object": obj}
+        if ns is not None:
+            review["namespace"] = ns
+        reviews.append(review)
+    # oldObject-only (DELETE-ish) and both-objects reviews
+    reviews.append(
+        {"kind": kinds[0], "name": "obj-1", "namespace": "cached-a",
+         "oldObject": {"metadata": {"name": "obj-1", "labels": {"app": "web"}}}}
+    )
+    reviews.append(
+        {"kind": kinds[0], "name": "obj-1", "namespace": "cached-a",
+         "object": {"metadata": {"name": "obj-1", "labels": {"app": "db"}}},
+         "oldObject": {"metadata": {"name": "obj-1", "labels": {"app": "web"}}}}
+    )
+    # side-loaded namespace
+    reviews.append(
+        {"kind": kinds[0], "name": "obj-1", "namespace": "uncached",
+         "object": {"metadata": {"name": "obj-1"}},
+         "_unstable": {"namespace": NS_OBJECTS["cached-a"]}}
+    )
+    # null-valued fields exercise get_default's null handling
+    reviews.append(
+        {"kind": kinds[0], "name": "obj-1", "namespace": "cached-a",
+         "object": {"metadata": {"name": "obj-1", "labels": None}}}
+    )
+    # null-valued label key: has_field treats it as present (Exists matches)
+    reviews.append(
+        {"kind": kinds[0], "name": "obj-1", "namespace": "cached-a",
+         "object": {"metadata": {"name": "obj-1", "labels": {"app": None}}}}
+    )
+    return reviews
+
+
+def rego_verdicts(lib: TemplatePolicy, constraint: dict, review: dict):
+    inventory = {
+        "constraints": {constraint["kind"]: {constraint["metadata"]["name"]: constraint}},
+        "external": {"cluster": {"v1": {"Namespace": NS_OBJECTS}}},
+    }
+    matched = lib.eval_rule("matching_constraints", {"review": review}, inventory)
+    rejected = lib.eval_rule("autoreject_review", {"review": review}, inventory)
+    return bool(matched), bool(rejected)
+
+
+def native_verdicts(constraint: dict, review: dict):
+    cached = lambda name: NS_OBJECTS.get(name)
+    return (
+        constraint_matches(constraint, review, cached),
+        needs_autoreject(constraint, review, cached),
+    )
+
+
+def test_differential_native_vs_rego(lib):
+    rng = random.Random(7)
+    reviews = make_reviews()
+    mismatches = []
+    total = 0
+    for spec in MATCH_SPECS:
+        constraint = {
+            "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+            "kind": "Foo",
+            "metadata": {"name": "c1"},
+            "spec": ({"match": spec} if spec is not None else {}),
+        }
+        # sample reviews to keep runtime bounded while covering every spec
+        for review in rng.sample(reviews, min(len(reviews), 30)):
+            total += 1
+            want = rego_verdicts(lib, constraint, review)
+            got = native_verdicts(constraint, review)
+            if want != got:
+                mismatches.append((spec, review, want, got))
+    assert total > 500
+    assert not mismatches, f"{len(mismatches)}/{total} divergences; first: {mismatches[0]}"
