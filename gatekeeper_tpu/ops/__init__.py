@@ -1,0 +1,12 @@
+"""TPU execution backend: columnar packing, vectorized match/violation
+kernels, and the TpuDriver.
+
+Design (SURVEY.md section 2.4 / 7):
+- The audit sweep constraints x resources becomes one batched boolean-tensor
+  evaluation on device; admission reviews micro-batch onto the same kernels.
+- Violation predicates compiled from the Rego AST may OVER-approximate
+  (never under-): positive cells are re-rendered through the interpreter
+  oracle, so false positives cost host render time, never correctness.
+- Templates outside the vectorizable fragment fall back to all-true masks
+  (pure interpreter evaluation for their cells).
+"""

@@ -1,0 +1,225 @@
+"""Columnar feature extraction: JSON resources -> padded numpy arrays.
+
+Column kinds:
+- scalar: one value per resource at a []-free path                   -> [R]
+- slot:   per-entity values, where entities come from iteration
+          paths (arrays, flattened across all [] levels and unioned
+          over paths — e.g. containers[] + initContainers[]) and the
+          value is read at a []-free path relative to the entity.
+          All slot columns sharing the same iteration paths are
+          ALIGNED on the slot axis                                   -> [R, S]
+- keyset: the set of (truthy) object keys found at paths (arrays
+          allowed), minus excluded literals, per resource            -> [R, K]
+
+Scalar/slot columns carry a type code per cell plus the representation
+arrays the predicates need:
+
+  tcode: 0 undefined, 1 null, 2 false, 3 true, 4 number, 5 string, 6 composite
+  sid:   interned string id (tcode 5)
+  num:   float value (tcode 4)
+
+Rego statement truthiness == tcode not in {0, 2}; OPA's cross-type ordering
+(null < bool < number < string < composites) maps to tcode rank for exact
+vectorized comparisons.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from .interning import Interner
+
+Path = Tuple[str, ...]
+
+T_UNDEF, T_NULL, T_FALSE, T_TRUE, T_NUM, T_STR, T_COMP = range(7)
+
+
+def parse_path(dotted: str) -> Path:
+    """'spec.containers[].image' -> ('spec', 'containers', '[]', 'image')."""
+    out: List[str] = []
+    for seg in dotted.split("."):
+        while seg.endswith("[]"):
+            seg = seg[:-2]
+            if seg:
+                out.append(seg)
+            out.append("[]")
+            seg = ""
+        if seg:
+            out.append(seg)
+    return tuple(out)
+
+
+def _walk(obj: Any, path: Path, i: int, out: List[Any]):
+    if i == len(path):
+        out.append(obj)
+        return
+    seg = path[i]
+    if seg == "[]":
+        if isinstance(obj, list):
+            for item in obj:
+                _walk(item, path, i + 1, out)
+        return
+    if isinstance(obj, dict) and seg in obj:
+        _walk(obj[seg], path, i + 1, out)
+
+
+def _get_rel(obj: Any, path: Path):
+    """[]-free relative path; returns _ABSENT when missing."""
+    cur = obj
+    for seg in path:
+        if isinstance(cur, dict) and seg in cur:
+            cur = cur[seg]
+        else:
+            return _ABSENT
+    return cur
+
+
+class _Absent:
+    def __repr__(self):
+        return "<absent>"
+
+
+_ABSENT = _Absent()
+
+
+@dataclass(frozen=True)
+class ColumnSpec:
+    kind: str  # "scalar" | "slot" | "keyset"
+    iter_paths: Tuple[Path, ...]  # slot/keyset entity sources ([] allowed)
+    rel_path: Path = ()  # []-free value path (scalar: the full path)
+    exclude: Tuple[str, ...] = ()  # keyset: excluded key literals
+
+    @property
+    def key(self):
+        return (self.kind, self.iter_paths, self.rel_path, self.exclude)
+
+    @property
+    def iter_key(self):
+        """Slot-axis alignment group."""
+        return self.iter_paths
+
+
+def _bucket(n: int, minimum: int = 1) -> int:
+    b = max(minimum, 1)
+    while b < n:
+        b *= 2
+    return b
+
+
+def _encode(values: List[Any], interner: Interner, shape) -> Dict[str, np.ndarray]:
+    n = len(values)
+    tcode = np.zeros(n, np.int8)
+    sid = np.full(n, Interner.MISSING, np.int32)
+    num = np.zeros(n, np.float64)
+    for i, v in enumerate(values):
+        if v is _ABSENT:
+            tcode[i] = T_UNDEF
+        elif v is None:
+            tcode[i] = T_NULL
+        elif v is True:
+            tcode[i] = T_TRUE
+        elif v is False:
+            tcode[i] = T_FALSE
+        elif isinstance(v, str):
+            tcode[i] = T_STR
+            sid[i] = interner.intern(v)
+        elif isinstance(v, (int, float)):
+            tcode[i] = T_NUM
+            num[i] = float(v)
+        else:
+            tcode[i] = T_COMP
+    return {
+        "tcode": tcode.reshape(shape),
+        "sid": sid.reshape(shape),
+        "num": num.reshape(shape),
+    }
+
+
+def extract_columns(
+    resources: Sequence[dict],
+    specs: Sequence[ColumnSpec],
+    interner: Interner,
+    rows: int,
+) -> Dict[Tuple, Dict[str, np.ndarray]]:
+    """Extract requested columns over `resources`, padded to `rows` rows.
+    Slot columns in the same iter group share entity extraction and width."""
+    out: Dict[Tuple, Dict[str, np.ndarray]] = {}
+
+    # Group slot specs by iteration source so their slot axes align.
+    slot_groups: Dict[Tuple, List[ColumnSpec]] = {}
+    for spec in specs:
+        if spec.kind == "slot":
+            slot_groups.setdefault(spec.iter_key, []).append(spec)
+
+    group_entities: Dict[Tuple, List[List[Any]]] = {}
+    group_width: Dict[Tuple, int] = {}
+    for ik in slot_groups:
+        ents: List[List[Any]] = []
+        for r in resources:
+            hits: List[Any] = []
+            for p in ik:
+                _walk(r, p, 0, hits)
+            ents.append(hits)
+        group_entities[ik] = ents
+        group_width[ik] = _bucket(max((len(e) for e in ents), default=0), 1)
+
+    for spec in specs:
+        if spec.kind == "scalar":
+            values = []
+            for r in resources:
+                hits: List[Any] = []
+                _walk(r, spec.rel_path, 0, hits)
+                values.append(hits[0] if hits else _ABSENT)
+            values += [_ABSENT] * (rows - len(resources))
+            out[spec.key] = _encode(values, interner, (rows,))
+        elif spec.kind == "slot":
+            ik = spec.iter_key
+            ents = group_entities[ik]
+            width = group_width[ik]
+            mask = np.zeros((rows, width), bool)
+            values = []
+            for i in range(rows):
+                row_ents = ents[i] if i < len(ents) else []
+                for j in range(width):
+                    if j < len(row_ents):
+                        mask[i, j] = True
+                        values.append(_get_rel(row_ents[j], spec.rel_path))
+                    else:
+                        values.append(_ABSENT)
+            arrs = _encode(values, interner, (rows, width))
+            arrs["mask"] = mask
+            out[spec.key] = arrs
+        elif spec.kind == "keyset":
+            per_row_keys: List[List[int]] = []
+            for r in resources:
+                hits = []
+                for p in spec.iter_paths:
+                    _walk(r, p, 0, hits)
+                keys: List[int] = []
+                seen = set()
+                for h in hits:
+                    target = _get_rel(h, spec.rel_path) if spec.rel_path else h
+                    if isinstance(target, dict):
+                        for k, v in target.items():
+                            # key enumeration is a body statement: a
+                            # false-valued key fails it and is excluded
+                            if (
+                                isinstance(k, str)
+                                and v is not False
+                                and k not in spec.exclude
+                                and k not in seen
+                            ):
+                                seen.add(k)
+                                keys.append(interner.intern(k))
+                per_row_keys.append(keys)
+            width = _bucket(max((len(k) for k in per_row_keys), default=0), 1)
+            ids = np.full((rows, width), Interner.PAD, np.int32)
+            for i, keys in enumerate(per_row_keys):
+                ids[i, : len(keys)] = keys
+            out[spec.key] = {"ids": ids}
+        else:
+            raise ValueError(f"unknown column kind {spec.kind}")
+    return out

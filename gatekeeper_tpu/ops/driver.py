@@ -1,0 +1,275 @@
+"""TpuDriver: the vectorized JAX/XLA evaluation backend.
+
+Pipeline per Review/Audit:
+  1. pack reviews + constraints to integer tensors (host, incremental interner)
+  2. device: match kernel -> bool[C, R]; per-kind violation programs
+     (vectorizer output) -> bool[C_k, R]; combined candidate mask
+  3. host: for each positive cell, exact native match re-check + interpreter
+     violation rendering (messages/details) — the over-approximation filter
+
+Correctness therefore never depends on the device mask being tight — only
+throughput does.  Templates with no vectorized program get all-true columns
+(pure interpreter fallback for their cells).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..client.drivers import CompiledTemplate, InterpDriver, Result
+from ..target.match import constraint_matches, needs_autoreject
+from ..target.target import K8sValidationTarget
+from .columns import extract_columns
+from .interning import Interner, PredicateTable
+from .matchkernel import match_kernel
+from .pack import pack_constraints, pack_reviews
+from .params import pack_params
+from .vectorizer import vectorize
+from .vexpr import EvalEnv, VProgram, eval_program
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _match_jit(rv, cs):
+    return match_kernel(rv, cs)
+
+
+def _make_eval_jit(prog: VProgram):
+    """One jitted evaluator per template program; C/R are static so jit
+    re-specializes per shape bucket."""
+
+    @functools.partial(jax.jit, static_argnames=("C", "R"))
+    def run(prog_cols, params, elems, tables, keysets, C, R):
+        env = EvalEnv(prog_cols, params, elems, tables, keysets, C, R)
+        return eval_program(prog, env)
+
+    return run
+
+
+class TpuDriver(InterpDriver):
+    """Drop-in Driver with device-side batched evaluation.  Inherits state
+    management (templates/constraints/store) and render fallback from
+    InterpDriver."""
+
+    def __init__(self, target: Optional[K8sValidationTarget] = None):
+        super().__init__(target)
+        self.interner = Interner()
+        self.programs: Dict[str, Optional[VProgram]] = {}
+        self.pred_cache: Dict[Tuple[str, str], PredicateTable] = {}
+        self._eval_jits: Dict[str, object] = {}
+        # constraint-side packing is invalidated on any template/constraint
+        # mutation and on vocabulary growth (str-pred tables are vocab-sized)
+        self._cs_epoch = 0
+        self._cs_cache = None
+
+    # ---- lifecycle --------------------------------------------------------
+
+    def put_template(self, kind: str, artifact: CompiledTemplate):
+        super().put_template(kind, artifact)
+        self.programs[kind] = vectorize(artifact.policy)
+        self._eval_jits.pop(kind, None)
+        self._cs_epoch += 1
+
+    def delete_template(self, kind: str) -> bool:
+        self.programs.pop(kind, None)
+        self._eval_jits.pop(kind, None)
+        self._cs_epoch += 1
+        return super().delete_template(kind)
+
+    def put_constraint(self, kind: str, name: str, constraint: dict):
+        super().put_constraint(kind, name, constraint)
+        self._cs_epoch += 1
+
+    def delete_constraint(self, kind: str, name: str) -> bool:
+        self._cs_epoch += 1
+        return super().delete_constraint(kind, name)
+
+    def reset(self):
+        super().reset()
+        self.programs.clear()
+        self._eval_jits.clear()
+        self._cs_epoch += 1
+        self._cs_cache = None
+
+    # ---- device evaluation ------------------------------------------------
+
+    def _ordered_constraints(self) -> List[Tuple[str, str, dict]]:
+        out = []
+        for kind in sorted(self.constraints):
+            for name in sorted(self.constraints[kind]):
+                out.append((kind, name, self.constraints[kind][name]))
+        return out
+
+    def _constraint_side(self):
+        """Cached constraint-side packing: match pack, per-kind param packs,
+        and column-spec union.  Rebuilt when constraints/templates change or
+        the vocabulary has grown (str-pred tables are vocab-indexed)."""
+        ordered = self._ordered_constraints()
+        vocab = self.interner.snapshot_size()
+        key = (self._cs_epoch, vocab)
+        if self._cs_cache and self._cs_cache[0] == key:
+            return self._cs_cache[1]
+
+        cp = pack_constraints([c for _k, _n, c in ordered], self.interner)
+        specs = {}
+        by_kind: Dict[str, List[int]] = {}
+        for i, (kind, _n, _c) in enumerate(ordered):
+            by_kind.setdefault(kind, []).append(i)
+        kind_params = {}
+        for kind, idxs in by_kind.items():
+            prog = self.programs.get(kind)
+            if not prog:
+                continue
+            for spec in prog.column_specs:
+                specs[spec.key] = spec
+            kcs = [ordered[i][2] for i in idxs]
+            kind_params[kind] = pack_params(
+                kcs, prog, self.interner, self.pred_cache, len(kcs)
+            )
+        side = (ordered, cp, by_kind, kind_params, list(specs.values()))
+        # key uses the vocab size BEFORE param packing interned new strings;
+        # recompute so the cache stays valid next call
+        key = (self._cs_epoch, self.interner.snapshot_size())
+        self._cs_cache = (key, side)
+        return side
+
+    def compute_masks(self, reviews: List[dict]):
+        """-> (ordered constraints, match&violation candidate mask [C, R],
+        autoreject mask [C, R]) as numpy arrays."""
+        ordered, cp, by_kind, kind_params, col_specs = self._constraint_side()
+        rp = pack_reviews(reviews, self.interner, self.store.cached_namespace)
+        rows = len(rp.arrays["valid"])
+        cols = extract_columns(reviews, col_specs, self.interner, rows)
+        if self.interner.snapshot_size() > self._cs_cache[0][1]:
+            # new strings interned from these reviews: str-pred tables must
+            # cover them, so rebuild the constraint side once
+            ordered, cp, by_kind, kind_params, col_specs = self._constraint_side()
+
+        match, autoreject = _match_jit(rp.arrays, cp.arrays)
+        match = np.asarray(match)
+        autoreject = np.asarray(autoreject)
+
+        mask = match.copy()
+        for kind, idxs in by_kind.items():
+            prog = self.programs.get(kind)
+            if not prog or kind not in kind_params:
+                continue
+            params, elems, tables = kind_params[kind]
+            keysets = {
+                spec.key: cols[spec.key]["ids"]
+                for spec in prog.column_specs
+                if spec.kind == "keyset"
+            }
+            prog_cols = {
+                spec.key: cols[spec.key]
+                for spec in prog.column_specs
+                if spec.kind != "keyset"
+            }
+            fn = self._eval_jits.get(kind)
+            if fn is None:
+                fn = _make_eval_jit(prog)
+                self._eval_jits[kind] = fn
+            vmask = np.asarray(
+                fn(prog_cols, params, elems, tables, keysets, len(idxs), rows)
+            )
+            for j, i in enumerate(idxs):
+                mask[i] &= vmask[j]
+        return ordered, mask, autoreject
+
+    # ---- render (exactness filter) ---------------------------------------
+
+    def _render_cell(
+        self,
+        results: List[Result],
+        constraint: dict,
+        kind: str,
+        review: dict,
+        frozen_review,
+        inventory,
+        tracing_log,
+    ):
+        from ..engine.value import freeze
+
+        tmpl = self.templates.get(kind)
+        if tmpl is None:
+            return
+        if not constraint_matches(constraint, review, self.store.cached_namespace):
+            return  # device over-approximation filtered here
+        params = (constraint.get("spec") or {}).get("parameters") or {}
+        violations = tmpl.policy.eval_violations(
+            frozen_review, freeze(params), inventory
+        )
+        action = self._enforcement_action(constraint)
+        for v in violations:
+            results.append(
+                Result(
+                    msg=str(v.get("msg", "")),
+                    metadata={"details": v.get("details", {})},
+                    constraint=constraint,
+                    review=review,
+                    enforcement_action=action,
+                )
+            )
+            if tracing_log is not None:
+                tracing_log.append(
+                    f"violation {kind}/{constraint['metadata']['name']}: {v.get('msg')}"
+                )
+
+    def review(self, review: dict, tracing: bool = False):
+        from ..engine.value import freeze
+
+        with self._lock:
+            ordered, mask, autoreject = self.compute_masks([review])
+            inventory = self.store.frozen()
+            frozen_review = freeze(review)
+            results: List[Result] = []
+            trace: List[str] = [] if tracing else None
+            for i, (kind, name, constraint) in enumerate(ordered):
+                if autoreject[i, 0]:
+                    if needs_autoreject(constraint, review, self.store.cached_namespace):
+                        results.append(
+                            Result(
+                                msg="Namespace is not cached in OPA.",
+                                metadata={"details": {}},
+                                constraint=constraint,
+                                review=review,
+                                enforcement_action=self._enforcement_action(constraint),
+                            )
+                        )
+                        if tracing:
+                            trace.append(f"autoreject {kind}/{name}")
+                if mask[i, 0]:
+                    self._render_cell(
+                        results, constraint, kind, review, frozen_review,
+                        inventory, trace,
+                    )
+            return results, ("\n".join(trace) if tracing else None)
+
+    def audit(self, tracing: bool = False):
+        from ..engine.value import freeze, thaw
+
+        with self._lock:
+            objs = list(self.store.iter_objects())
+            reviews = []
+            for obj_frozen, api, kind_name, name, ns in objs:
+                obj = thaw(obj_frozen)
+                reviews.append(self.target.make_audit_review(obj, api, kind_name, name, ns))
+            if not reviews:
+                return [], ("" if tracing else None)
+            ordered, mask, _autoreject = self.compute_masks(reviews)
+            inventory = self.store.frozen()
+            results: List[Result] = []
+            trace: List[str] = [] if tracing else None
+            # resource-major order, matching InterpDriver.audit
+            for ri, review in enumerate(reviews):
+                frozen_review = freeze(review)
+                for i, (kind, _name, constraint) in enumerate(ordered):
+                    if mask[i, ri]:
+                        self._render_cell(
+                            results, constraint, kind, review, frozen_review,
+                            inventory, trace,
+                        )
+            return results, ("\n".join(trace) if tracing else None)

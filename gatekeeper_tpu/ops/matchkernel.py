@@ -1,0 +1,162 @@
+"""Vectorized constraint matching: bool[C, R] on device.
+
+The selector logic of target_template_source.go:127-386 as boolean tensor
+algebra over interned ids: kind selectors, namespaces/excludedNamespaces,
+scope, labelSelector and namespaceSelector (matchLabels + matchExpressions),
+plus the autoreject mask.  One fused XLA computation replaces the per-cell
+Rego scan of matching_constraints (the reference's linear scan at
+target_template_source.go:27-44).
+
+All arrays come from ops/pack.py; dims: C constraints, R reviews, KP kind
+pairs, N/X namespace ids, L label pairs, E match expressions, V values.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .pack import (
+    OP_UNKNOWN,
+    PAD,
+    SCOPE_NONE,
+    SCOPE_OTHER,
+    UNDEF,
+    WILD,
+)
+
+
+def _selector_match(lab_pairs, cs_ml, cs_op, cs_key, cs_vals, cs_nvals):
+    """matches_label_selector over [R, L, 2] labels x [C, ...] selectors
+    -> bool[C, R]."""
+    lab_key = lab_pairs[:, :, 0]  # [R, L]
+    lab_val = lab_pairs[:, :, 1]
+    lab_ok = lab_key != PAD  # [R, L]
+
+    # matchLabels: every (k, v) pair (non-pad) must be satisfied.
+    mlk = cs_ml[:, :, 0][:, :, None, None]  # [C, Lc, 1, 1]
+    mlv = cs_ml[:, :, 1][:, :, None, None]
+    hit = (
+        (lab_key[None, None, :, :] == mlk)
+        & (lab_val[None, None, :, :] == mlv)
+        & lab_ok[None, None, :, :]
+    )  # [C, Lc, R, L]
+    sat = jnp.any(hit, axis=-1)  # [C, Lc, R]
+    pair_pad = (cs_ml[:, :, 0] == PAD)[:, :, None]  # [C, Lc, 1]
+    ml_ok = jnp.all(sat | pair_pad, axis=1)  # [C, R]
+
+    # matchExpressions
+    key = cs_key[:, :, None, None]  # [C, E, 1, 1]
+    key_hit = (lab_key[None, None, :, :] == key) & lab_ok[None, None, :, :]
+    has = jnp.any(key_hit, axis=-1)  # [C, E, R]
+    vals = cs_vals[:, :, :, None, None]  # [C, E, V, 1, 1]
+    val_hit = key_hit[:, :, None, :, :] & (
+        lab_val[None, None, None, :, :] == vals
+    )  # [C, E, V, R, L]
+    val_in = jnp.any(val_hit, axis=(2, 4))  # [C, E, R]
+    nvals = cs_nvals[:, :, None]  # [C, E, 1]
+    op = cs_op[:, :, None]  # [C, E, 1]
+
+    violated = jnp.where(
+        op == 0, ~has | ((nvals > 0) & ~val_in),  # In
+        jnp.where(
+            op == 1, has & (nvals > 0) & val_in,  # NotIn
+            jnp.where(
+                op == 2, ~has,  # Exists
+                jnp.where(op == 3, has, False),  # DoesNotExist / unknown
+            ),
+        ),
+    )
+    expr_pad = (cs_op == -1)[:, :, None]
+    ex_ok = ~jnp.any(violated & ~expr_pad, axis=1)  # [C, R]
+    return ml_ok & ex_ok
+
+
+def _any_labelselector_match(rv, cs_ml, cs_op, cs_key, cs_vals, cs_nvals):
+    """any_labelselector_match (target_template_source.go:233-278)
+    -> bool[C, R]."""
+    sm_obj = _selector_match(rv["obj_labels"], cs_ml, cs_op, cs_key, cs_vals, cs_nvals)
+    sm_old = _selector_match(rv["old_labels"], cs_ml, cs_op, cs_key, cs_vals, cs_nvals)
+    empty = jnp.full_like(rv["obj_labels"][:1], PAD)
+    sm_empty = _selector_match(empty, cs_ml, cs_op, cs_key, cs_vals, cs_nvals)  # [C, 1]
+    obj_e = rv["obj_empty"][None, :]
+    old_e = rv["old_empty"][None, :]
+    return jnp.where(
+        obj_e & old_e, sm_empty,
+        jnp.where(
+            old_e, sm_obj,
+            jnp.where(obj_e, sm_old, sm_obj | sm_old),
+        ),
+    )
+
+
+def match_kernel(rv: dict, cs: dict):
+    """-> (match bool[C, R], autoreject bool[C, R])."""
+    group = rv["group"][None, :]  # [1, R]
+    kind = rv["kind"][None, :]
+
+    # kind selectors: any (group, kind) pair matches
+    kp_g = cs["kind_pairs"][:, :, 0][:, :, None]  # [C, KP, 1]
+    kp_k = cs["kind_pairs"][:, :, 1][:, :, None]
+    pair_ok = (
+        ((kp_g == WILD) | (kp_g == group[:, None, :]))
+        & ((kp_k == WILD) | (kp_k == kind[:, None, :]))
+        & (kp_g != PAD)
+    )
+    kinds_ok = jnp.any(pair_ok, axis=1)  # [C, R]
+
+    # namespaces / excludedNamespaces
+    ns_name = rv["ns_name"][None, :]  # [1, R]
+    ns_def = ns_name != UNDEF
+    always = rv["always"][None, :]
+    member_ns = jnp.any(
+        (cs["ns_ids"][:, :, None] == ns_name[:, None, :])
+        & (cs["ns_ids"][:, :, None] != PAD),
+        axis=1,
+    )
+    ns_ok = ~cs["has_ns"][:, None] | always | (ns_def & member_ns)
+    member_ex = jnp.any(
+        (cs["ex_ids"][:, :, None] == ns_name[:, None, :])
+        & (cs["ex_ids"][:, :, None] != PAD),
+        axis=1,
+    )
+    ex_ok = ~cs["has_ex"][:, None] | always | (ns_def & ~member_ex)
+
+    # scope
+    scope = cs["scope"][:, None]  # [C, 1]
+    ns_empty = rv["ns_empty"][None, :]
+    scope_ok = jnp.where(
+        (scope == SCOPE_NONE) | (scope == 1), True,
+        jnp.where(
+            scope == 2, ~ns_empty,
+            jnp.where(scope == 3, ns_empty, False),  # SCOPE_OTHER -> False
+        ),
+    )
+
+    # labelSelector
+    ls_ok = _any_labelselector_match(
+        rv, cs["ls_ml"], cs["ls_op"], cs["ls_key"], cs["ls_vals"], cs["ls_nvals"]
+    )
+
+    # namespaceSelector by mode: 0 always-T, 1 ns labels, 2 uncached-F, 3 is_ns
+    sm_ns = _selector_match(
+        rv["ns_labels"], cs["nssel_ml"], cs["ns_op"], cs["ns_key"],
+        cs["ns_vals"], cs["ns_nvals"],
+    )
+    alm_ns = _any_labelselector_match(
+        rv, cs["nssel_ml"], cs["ns_op"], cs["ns_key"], cs["ns_vals"], cs["ns_nvals"]
+    )
+    mode = rv["ns_mode"][None, :]
+    nssel_result = jnp.where(
+        mode == 0, True,
+        jnp.where(mode == 1, sm_ns, jnp.where(mode == 3, alm_ns, False)),
+    )
+    nssel_ok = ~cs["has_nssel"][:, None] | nssel_result
+
+    valid = cs["valid"][:, None] & rv["valid"][None, :]
+    match = kinds_ok & ns_ok & ex_ok & scope_ok & ls_ok & nssel_ok & valid
+    autoreject = cs["has_nssel"][:, None] & rv["autoreject"][None, :] & valid
+    return match, autoreject
+
+
+match_kernel_jit = jax.jit(match_kernel)

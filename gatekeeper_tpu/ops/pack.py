@@ -1,0 +1,333 @@
+"""Columnar packing of reviews and constraints for the match kernels.
+
+Everything string-valued goes through the global Interner; list-valued match
+fields become padded id arrays with masks.  Padded dims are bucketed
+(next power of two) so jitted kernel shapes stay stable across calls.
+
+Exactness note: the device-side match may OVER-approximate in exotic cases
+(non-string labels); every positive cell is re-checked host-side with the
+exact native matcher before results are produced (ops/driver.py), so only
+performance — never correctness — depends on tightness.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..target.match import _MISSING, _get, _is_ns, needs_autoreject  # type: ignore
+from .interning import Interner
+
+WILD = -1  # "*" wildcard in kind selectors
+PAD = -2
+UNDEF = -4  # undefined (missing field) sentinel for id columns
+
+
+def _bucket(n: int, minimum: int = 1) -> int:
+    b = max(minimum, 1)
+    while b < n:
+        b *= 2
+    return b
+
+
+def _intern_labels(interner: Interner, labels: Any, out: List):
+    if not isinstance(labels, dict):
+        return
+    for k in sorted(labels.keys(), key=str):
+        out.append((interner.intern_value(k), interner.intern_value(labels[k])))
+
+
+# --------------------------------------------------------------------------
+# Reviews
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class ReviewPack:
+    n: int
+    arrays: Dict[str, np.ndarray]
+    reviews: List[dict]
+
+
+def pack_reviews(
+    reviews: List[dict],
+    interner: Interner,
+    cached_namespace: Callable[[str], Optional[dict]],
+    bucket_rows: bool = True,
+) -> ReviewPack:
+    n = len(reviews)
+    rows = _bucket(n, 8) if bucket_rows else max(n, 1)
+
+    group = np.full(rows, UNDEF, np.int32)
+    kind = np.full(rows, UNDEF, np.int32)
+    ns_name = np.full(rows, UNDEF, np.int32)  # get_ns_name result
+    always = np.zeros(rows, bool)  # always_match_ns_selectors
+    ns_empty = np.zeros(rows, bool)  # namespace missing-or-empty
+    is_ns = np.zeros(rows, bool)
+    obj_empty = np.ones(rows, bool)
+    old_empty = np.ones(rows, bool)
+    ns_mode = np.zeros(rows, np.int8)  # 0 always-T, 1 ns labels, 2 uncached, 3 is_ns
+    autoreject = np.zeros(rows, bool)
+    valid = np.zeros(rows, bool)
+
+    obj_lab: List[List] = []
+    old_lab: List[List] = []
+    ns_lab: List[List] = []
+
+    for i, review in enumerate(reviews):
+        valid[i] = True
+        rkind = review.get("kind") if isinstance(review.get("kind"), dict) else {}
+        g = rkind.get("group", _MISSING)
+        k = rkind.get("kind", _MISSING)
+        group[i] = interner.intern_value(g) if g is not _MISSING else UNDEF
+        kind[i] = interner.intern_value(k) if k is not _MISSING else UNDEF
+        isns = _is_ns(review.get("kind"))
+        is_ns[i] = isns
+        ns = _get(review, "namespace", "")
+        ns_empty[i] = ns == ""
+        always[i] = (not isns) and ns == ""
+
+        # get_ns_name
+        if isns:
+            obj = _get(review, "object", _MISSING)
+            meta = _get(obj, "metadata", _MISSING) if obj is not _MISSING else _MISSING
+            nm = _get(meta, "name", _MISSING) if meta is not _MISSING else _MISSING
+            ns_name[i] = interner.intern_value(nm) if nm is not _MISSING else UNDEF
+        else:
+            nm = _get(review, "namespace", _MISSING)
+            ns_name[i] = interner.intern_value(nm) if nm is not _MISSING else UNDEF
+
+        obj = _get(review, "object", {})
+        old = _get(review, "oldObject", {})
+        obj_empty[i] = obj == {}
+        old_empty[i] = old == {}
+        ol: List = []
+        _intern_labels(interner, _get(_get(obj, "metadata", {}), "labels", {}), ol)
+        obj_lab.append(ol)
+        odl: List = []
+        _intern_labels(interner, _get(_get(old, "metadata", {}), "labels", {}), odl)
+        old_lab.append(odl)
+
+        # namespaceSelector resolution mode
+        nsl: List = []
+        if isns:
+            ns_mode[i] = 3
+        elif always[i]:
+            ns_mode[i] = 0
+        else:
+            unstable_ns = _get(_get(review, "_unstable", {}), "namespace", _MISSING)
+            ns_obj = unstable_ns if unstable_ns is not _MISSING else None
+            if ns_obj is None and isinstance(ns, str):
+                ns_obj = cached_namespace(ns)
+            if ns_obj is None:
+                ns_mode[i] = 2
+            else:
+                ns_mode[i] = 1
+                _intern_labels(
+                    interner, _get(_get(ns_obj, "metadata", {}), "labels", {}), nsl
+                )
+        ns_lab.append(nsl)
+
+        autoreject[i] = needs_autoreject(
+            {"spec": {"match": {"namespaceSelector": {}}}}, review, cached_namespace
+        )
+
+    def pad_pairs(rows_pairs: List[List], rows_total: int) -> np.ndarray:
+        width = _bucket(max((len(p) for p in rows_pairs), default=0), 1)
+        arr = np.full((rows_total, width, 2), PAD, np.int32)
+        for i, pairs in enumerate(rows_pairs):
+            for j, (a, b) in enumerate(pairs):
+                arr[i, j] = (a, b)
+        return arr
+
+    arrays = {
+        "group": group,
+        "kind": kind,
+        "ns_name": ns_name,
+        "always": always,
+        "ns_empty": ns_empty,
+        "is_ns": is_ns,
+        "obj_empty": obj_empty,
+        "old_empty": old_empty,
+        "ns_mode": ns_mode,
+        "autoreject": autoreject,
+        "valid": valid,
+        "obj_labels": pad_pairs(obj_lab, rows),
+        "old_labels": pad_pairs(old_lab, rows),
+        "ns_labels": pad_pairs(ns_lab, rows),
+    }
+    return ReviewPack(n=n, arrays=arrays, reviews=reviews)
+
+
+# --------------------------------------------------------------------------
+# Constraints
+# --------------------------------------------------------------------------
+
+OP_CODES = {"In": 0, "NotIn": 1, "Exists": 2, "DoesNotExist": 3}
+OP_UNKNOWN = 4
+SCOPE_CODES = {"*": 1, "Namespaced": 2, "Cluster": 3}
+SCOPE_NONE = 0
+SCOPE_OTHER = 4
+
+
+@dataclass
+class ConstraintPack:
+    n: int
+    arrays: Dict[str, np.ndarray]
+    constraints: List[dict]
+
+
+def _pack_selector(selector: Any, interner: Interner):
+    """-> (matchLabels pairs, exprs list of (op, key_id, value_ids))."""
+    if not isinstance(selector, dict) or selector is None:
+        selector = {}
+    pairs: List = []
+    ml = _get(selector, "matchLabels", {})
+    if isinstance(ml, dict):
+        for k in sorted(ml.keys(), key=str):
+            pairs.append((interner.intern_value(k), interner.intern_value(ml[k])))
+    exprs = []
+    me = _get(selector, "matchExpressions", [])
+    if isinstance(me, list):
+        for e in me:
+            if not isinstance(e, dict):
+                # original indexes operator/key -> undefined -> no clause fires
+                continue
+            op = OP_CODES.get(e.get("operator"), OP_UNKNOWN)
+            key = interner.intern_value(e.get("key"))
+            values = _get(e, "values", [])
+            vids = (
+                [interner.intern_value(v) for v in values]
+                if isinstance(values, list)
+                else []
+            )
+            exprs.append((op, key, vids))
+    return pairs, exprs
+
+
+def pack_constraints(constraints: List[dict], interner: Interner) -> ConstraintPack:
+    n = len(constraints)
+    rows = _bucket(n, 1)
+
+    kind_pairs: List[List] = []
+    ns_lists: List[List] = []
+    ex_lists: List[List] = []
+    has_ns = np.zeros(rows, bool)
+    has_ex = np.zeros(rows, bool)
+    scope = np.zeros(rows, np.int8)
+    has_nssel = np.zeros(rows, bool)
+    valid = np.zeros(rows, bool)
+
+    sel_ml: List[List] = []
+    sel_ex: List[List] = []
+    nssel_ml: List[List] = []
+    nssel_ex: List[List] = []
+
+    for i, c in enumerate(constraints):
+        valid[i] = True
+        match = _get(_get(c, "spec", {}), "match", {})
+        if not isinstance(match, dict):
+            match = {}
+
+        kinds = _get(match, "kinds", [{"apiGroups": ["*"], "kinds": ["*"]}])
+        pairs: List = []
+        if isinstance(kinds, list):
+            for ks in kinds:
+                if not isinstance(ks, dict):
+                    continue
+                groups = ks.get("apiGroups") or []
+                names = ks.get("kinds") or []
+                gids = [
+                    WILD if g == "*" else interner.intern_value(g) for g in groups
+                ]
+                kids = [
+                    WILD if k == "*" else interner.intern_value(k) for k in names
+                ]
+                for g in gids:
+                    for k in kids:
+                        pairs.append((g, k))
+        kind_pairs.append(pairs)
+
+        has_ns[i] = "namespaces" in match
+        nss = match.get("namespaces")
+        ns_lists.append(
+            [interner.intern_value(x) for x in nss] if isinstance(nss, list) else []
+        )
+        has_ex[i] = "excludedNamespaces" in match
+        exs = match.get("excludedNamespaces")
+        ex_lists.append(
+            [interner.intern_value(x) for x in exs] if isinstance(exs, list) else []
+        )
+
+        if "scope" not in match:
+            scope[i] = SCOPE_NONE
+        else:
+            scope[i] = SCOPE_CODES.get(match.get("scope"), SCOPE_OTHER)
+
+        ml, ex = _pack_selector(_get(match, "labelSelector", {}), interner)
+        sel_ml.append(ml)
+        sel_ex.append(ex)
+
+        has_nssel[i] = "namespaceSelector" in match
+        nml, nex = _pack_selector(_get(match, "namespaceSelector", {}), interner)
+        nssel_ml.append(nml)
+        nssel_ex.append(nex)
+
+    def pad_pairs2(rows_pairs: List[List]) -> np.ndarray:
+        width = _bucket(max((len(p) for p in rows_pairs), default=0), 1)
+        arr = np.full((rows, width, 2), PAD, np.int32)
+        for i, pairs in enumerate(rows_pairs):
+            for j, pr in enumerate(pairs):
+                arr[i, j] = pr
+        return arr
+
+    def pad_ids(rows_ids: List[List]) -> np.ndarray:
+        width = _bucket(max((len(p) for p in rows_ids), default=0), 1)
+        arr = np.full((rows, width), PAD, np.int32)
+        for i, ids in enumerate(rows_ids):
+            arr[i, : len(ids)] = ids
+        return arr
+
+    def pad_exprs(rows_exprs: List[List]):
+        e_width = _bucket(max((len(e) for e in rows_exprs), default=0), 1)
+        v_width = _bucket(
+            max((len(v) for e in rows_exprs for (_o, _k, v) in e), default=0), 1
+        )
+        op = np.full((rows, e_width), -1, np.int8)
+        key = np.full((rows, e_width), PAD, np.int32)
+        vals = np.full((rows, e_width, v_width), PAD, np.int32)
+        nvals = np.zeros((rows, e_width), np.int32)
+        for i, exprs in enumerate(rows_exprs):
+            for j, (o, k, v) in enumerate(exprs):
+                op[i, j] = o
+                key[i, j] = k
+                vals[i, j, : len(v)] = v
+                nvals[i, j] = len(v)
+        return op, key, vals, nvals
+
+    ls_op, ls_key, ls_vals, ls_nvals = pad_exprs(sel_ex)
+    ns_op, ns_key, ns_vals, ns_nvals = pad_exprs(nssel_ex)
+
+    arrays = {
+        "kind_pairs": pad_pairs2(kind_pairs),
+        "has_ns": has_ns,
+        "ns_ids": pad_ids(ns_lists),
+        "has_ex": has_ex,
+        "ex_ids": pad_ids(ex_lists),
+        "scope": scope,
+        "valid": valid,
+        "ls_ml": pad_pairs2(sel_ml),
+        "ls_op": ls_op,
+        "ls_key": ls_key,
+        "ls_vals": ls_vals,
+        "ls_nvals": ls_nvals,
+        "has_nssel": has_nssel,
+        "nssel_ml": pad_pairs2(nssel_ml),
+        "ns_op": ns_op,
+        "ns_key": ns_key,
+        "ns_vals": ns_vals,
+        "ns_nvals": ns_nvals,
+    }
+    return ConstraintPack(n=n, arrays=arrays, constraints=constraints)

@@ -1,0 +1,775 @@
+"""Rego AST -> VProgram compiler.
+
+Compiles a template's `violation` rules into vectorized predicates over the
+VExpr IR (ops/vexpr.py) under the over-approximation contract:
+
+- A recognized condition compiles to an exact VExpr node.
+- An unrecognized condition in POSITIVE position is DROPPED (widens the
+  predicate; sound) and the program is marked inexact.
+- Under `not`, the negated expression must compile EXACTLY (otherwise
+  negating an approximation would narrow); if it cannot, the whole `not`
+  statement is dropped instead (widens; sound).
+
+Recognized fragment (derived from the reference's policy corpus — PSP
+family, required-labels family, allowed-repos family; see SURVEY.md 2.3):
+iteration over (possibly nested, unioned) array paths incl. helper partial
+sets; truthiness/negation of paths; cross-type comparisons; string
+predicates vs parameters (startswith/endswith/contains/re_match) incl. the
+`[good | p = params[_]; good = pred(x, p)]` + `not any(...)` idiom; boolean
+helper functions (inlined as clause disjunctions); key-set comprehensions
+with set difference and count comparisons.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..engine.interp import CompiledModule, TemplatePolicy
+from ..rego.ast import (
+    ArrayCompr,
+    BinOp,
+    Call,
+    Expr,
+    Node,
+    ObjectTerm,
+    Ref,
+    Rule,
+    Scalar,
+    SetCompr,
+    Var,
+)
+from .columns import ColumnSpec, Path
+from .vexpr import (
+    AnyParam,
+    BoolOp,
+    Clause,
+    ColRef,
+    Cmp,
+    Const,
+    Lit,
+    ParamElemRef,
+    ParamRef,
+    SetCountCmp,
+    StrPred,
+    Truthy,
+    VProgram,
+)
+
+_STR_PREDS = {"startswith", "endswith", "contains", "re_match"}
+_BENIGN_CALLS = {"sprintf", "concat", "json.marshal", "format_int", "lower", "upper"}
+_CMP_OPS = {"==", "!=", "<", "<=", ">", ">="}
+
+
+# ---- symbolic values ------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class SPath:
+    """root: 'review' | 'params' | ('slot', iter_paths); segs: []-free."""
+
+    root: Any
+    segs: Tuple[str, ...]
+
+
+@dataclass(frozen=True)
+class SConst:
+    value: Any
+
+
+@dataclass(frozen=True)
+class SKeySet:
+    iter_paths: Tuple[Path, ...]
+    rel: Tuple[str, ...]
+    exclude: Tuple[str, ...]
+
+
+@dataclass(frozen=True)
+class SParamIds:
+    ppath: Tuple[str, ...]
+    subpath: Tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class SSetDiff:
+    left: Any
+    right: Any
+
+
+@dataclass(frozen=True)
+class SPredAny:
+    node: AnyParam
+
+
+@dataclass(frozen=True)
+class SUnknown:
+    pass
+
+
+class _Unsupported(Exception):
+    pass
+
+
+class Vectorizer:
+    def __init__(self, policy: TemplatePolicy):
+        self.policy = policy
+        self.cm: CompiledModule = policy.main
+        self.columns: Dict[Tuple, ColumnSpec] = {}
+        self.param_scalars: set = set()
+        self.param_arrays: Dict[Tuple[str, ...], set] = {}
+        self.literals: set = set()
+        self.str_preds: List[StrPred] = []
+        self.exact = True
+
+    # ---- public ----------------------------------------------------------
+
+    def compile(self) -> Optional[VProgram]:
+        clauses: List[Clause] = []
+        for rule in self.cm.rules.get("violation", []):
+            if not rule.is_partial_set:
+                return None
+            clause = self._compile_clause(rule)
+            if clause is None:
+                # nothing recognized: all-true for this clause
+                clauses.append(Clause(conds=(Const(True),), slot_iter=None))
+                self.exact = False
+            else:
+                clauses.append(clause)
+        return VProgram(
+            clauses=clauses,
+            column_specs=list(self.columns.values()),
+            param_scalars=sorted(self.param_scalars),
+            param_arrays=[
+                (p, tuple(sorted(subs))) for p, subs in sorted(self.param_arrays.items())
+            ],
+            str_preds=self.str_preds,
+            literals=sorted(self.literals),
+            exact=self.exact,
+        )
+
+    # ---- clause compilation ----------------------------------------------
+
+    def _compile_clause(self, rule: Rule) -> Optional[Clause]:
+        env: Dict[str, Any] = {}
+        conds: List = []
+        state = {"slot": None}
+        recognized = 0
+        for stmt in rule.body:
+            ok = self._compile_stmt(stmt, env, conds, state, exact_required=False)
+            if ok:
+                recognized += 1
+            else:
+                self.exact = False
+        if recognized == 0 and not conds and state["slot"] is None:
+            return None
+        return Clause(conds=tuple(conds), slot_iter=state["slot"])
+
+    def _compile_stmt(self, stmt: Expr, env, conds, state, exact_required: bool) -> bool:
+        """Compile one statement into zero or more conds.  Returns False when
+        the statement was dropped (only allowed when not exact_required)."""
+        try:
+            if stmt.kind == "some":
+                return True
+            if stmt.kind == "not":
+                inner = stmt.terms[0]
+                node = self._compile_cond_expr(inner, env, state, exact_required=True)
+                conds.append(BoolOp("not", (node,)))
+                return True
+            if stmt.kind in ("assign", "unify"):
+                return self._compile_assign(stmt, env, conds, state, exact_required)
+            # plain term condition
+            node = self._compile_cond_expr(stmt, env, state, exact_required)
+            conds.append(node)
+            return True
+        except _Unsupported:
+            if exact_required:
+                raise
+            return False
+
+    # ---- assignments ------------------------------------------------------
+
+    def _compile_assign(self, stmt: Expr, env, conds, state, exact_required) -> bool:
+        lhs, rhs = stmt.terms
+        if not isinstance(lhs, Var):
+            raise _Unsupported()
+        # iteration?
+        it = self._try_iteration(rhs, env, state)
+        if it is not None:
+            env[lhs.name] = it
+            return True
+        sym = self._resolve(rhs, env, state, allow_compr=True)
+        if isinstance(sym, SUnknown):
+            env[lhs.name] = sym
+            if self._benign_rhs(rhs):
+                return True
+            raise _Unsupported()
+        env[lhs.name] = sym
+        return True
+
+    def _benign_rhs(self, rhs: Node) -> bool:
+        return isinstance(rhs, Call) and ".".join(rhs.path) in _BENIGN_CALLS
+
+    # ---- iteration recognition -------------------------------------------
+
+    def _try_iteration(self, t: Node, env, state):
+        """Recognize `<ref with wildcard(s)>` producing a slot entity or a
+        slot-relative scalar; registers the clause slot axis."""
+        if not isinstance(t, Ref) or not isinstance(t.head, Var):
+            return None
+        has_wild = any(isinstance(o, Var) and o.is_wildcard for o in t.operands)
+        if not has_wild:
+            return None
+        base_paths, strip_review, skip_first_wild = self._iter_base(t.head, env)
+        if base_paths is None:
+            return None
+        # walk operands: strings extend; wildcards flatten array levels —
+        # except a helper partial set's first wildcard, which is the set
+        # membership selector (the entity itself), not another level.
+        segs: List[str] = []
+        first_wild = True
+        for op in t.operands:
+            if isinstance(op, Scalar) and isinstance(op.value, str):
+                segs.append(op.value)
+            elif isinstance(op, Var) and op.is_wildcard:
+                if first_wild and skip_first_wild:
+                    first_wild = False
+                    continue
+                first_wild = False
+                segs.append("[]")
+            else:
+                raise _Unsupported()
+        if strip_review:
+            if segs[:1] != ["review"]:
+                raise _Unsupported()
+            segs = segs[1:]
+        if segs and "[]" in segs:
+            last = len(segs) - 1 - segs[::-1].index("[]")
+            iter_paths = tuple(p + tuple(segs[: last + 1]) for p in base_paths)
+            rel = tuple(segs[last + 1 :])
+        else:
+            # all flattening lives in the base paths (helper membership)
+            iter_paths = tuple(base_paths)
+            rel = tuple(segs)
+        if state["slot"] is None:
+            state["slot"] = iter_paths
+        elif state["slot"] != iter_paths:
+            raise _Unsupported()  # second iteration axis in one clause
+        # Always register the entity-presence column so the slot mask exists
+        # even when no per-slot condition survives compilation.
+        base_spec = ColumnSpec("slot", iter_paths, ())
+        self.columns[base_spec.key] = base_spec
+        return SPath(("slot", iter_paths), rel)
+
+    def _iter_base(self, head: Var, env):
+        """Resolve an iteration head -> (review-rooted base paths,
+        strip_review_prefix, skip_first_wildcard)."""
+        if head.name in env:
+            v = env[head.name]
+            if isinstance(v, SPath) and v.root == "review":
+                return (v.segs,), False, False
+            return None, False, False
+        if head.name == "input":
+            return ((),), True, False
+        # helper partial-set rule that unions plain iterations
+        rules = self.cm.rules.get(head.name)
+        if rules and all(r.is_partial_set for r in rules):
+            paths: List[Path] = []
+            for r in rules:
+                p = self._helper_source(r)
+                if p is None:
+                    return None, False, False
+                paths.append(p)
+            return tuple(paths), False, True
+        return None, False, False
+
+    def _helper_source(self, rule: Rule) -> Optional[Path]:
+        """A helper like `input_containers[c] { c := input...containers[_] }`:
+        single body statement assigning the key var from an iteration."""
+        if len(rule.body) != 1 or not isinstance(rule.key, Var):
+            return None
+        stmt = rule.body[0]
+        if stmt.kind not in ("assign", "unify"):
+            return None
+        lhs, rhs = stmt.terms
+        if not (isinstance(lhs, Var) and lhs.name == rule.key.name):
+            return None
+        if not (isinstance(rhs, Ref) and isinstance(rhs.head, Var) and rhs.head.name == "input"):
+            return None
+        segs: List[str] = []
+        for op in rhs.operands:
+            if isinstance(op, Scalar) and isinstance(op.value, str):
+                segs.append(op.value)
+            elif isinstance(op, Var) and op.is_wildcard:
+                segs.append("[]")
+            else:
+                return None
+        if not segs or segs[-1] != "[]" or segs[0] != "review":
+            return None
+        return tuple(segs[1:])  # review-rooted
+
+    # ---- term resolution --------------------------------------------------
+
+    def _resolve(self, t: Node, env, state, allow_compr=False):
+        if isinstance(t, Scalar):
+            return SConst(t.value)
+        if isinstance(t, Var):
+            if t.name in env:
+                return env[t.name]
+            raise _Unsupported()
+        if isinstance(t, Ref):
+            return self._resolve_ref(t, env, state)
+        if isinstance(t, SetCompr) and allow_compr:
+            return self._resolve_setcompr(t, env, state)
+        if isinstance(t, ArrayCompr) and allow_compr:
+            return self._resolve_satisfied_compr(t, env, state)
+        if isinstance(t, BinOp) and t.op == "-" and allow_compr:
+            left = self._resolve(t.lhs, env, state)
+            right = self._resolve(t.rhs, env, state)
+            if isinstance(left, (SKeySet, SParamIds)) and isinstance(
+                right, (SKeySet, SParamIds)
+            ):
+                return SSetDiff(left, right)
+            return SUnknown()
+        if isinstance(t, Call):
+            return SUnknown()
+        return SUnknown()
+
+    def _resolve_ref(self, t: Ref, env, state):
+        if not isinstance(t.head, Var):
+            raise _Unsupported()
+        segs: List[str] = []
+        for op in t.operands:
+            if isinstance(op, Scalar) and isinstance(op.value, str):
+                segs.append(op.value)
+            elif isinstance(op, Var) and not op.is_wildcard and isinstance(env.get(op.name), SConst):
+                v = env[op.name].value
+                if not isinstance(v, str):
+                    raise _Unsupported()
+                segs.append(v)
+            else:
+                raise _Unsupported()
+        name = t.head.name
+        if name == "input":
+            if segs[:1] == ["review"]:
+                rest = tuple(segs[1:])
+                return SPath("review", rest)
+            if segs[:1] == ["parameters"]:
+                return SPath("params", tuple(segs[1:]))
+            raise _Unsupported()
+        if name in env:
+            base = env[name]
+            if isinstance(base, SPath):
+                return SPath(base.root, base.segs + tuple(segs))
+            raise _Unsupported()
+        raise _Unsupported()
+
+    def _resolve_setcompr(self, t: SetCompr, env, state):
+        """{x | PATH[x]} -> key set; {x | x = params.P[_]} -> param id set;
+        extra `x != "lit"` conditions become excludes."""
+        if not isinstance(t.head, Var):
+            return SUnknown()
+        var = t.head.name
+        key_source = None
+        param_source = None
+        excludes: List[str] = []
+        for stmt in t.body:
+            if stmt.kind == "term" and isinstance(stmt.terms[0], Ref):
+                ref = stmt.terms[0]
+                ops = ref.operands
+                if ops and isinstance(ops[-1], Var) and ops[-1].name == var:
+                    base = Ref(ref.head, ops[:-1])
+                    try:
+                        sym = self._resolve_ref_allow_arrays(base, env)
+                    except _Unsupported:
+                        return SUnknown()
+                    key_source = sym
+                    continue
+                return SUnknown()
+            if stmt.kind in ("assign", "unify"):
+                lhs, rhs = stmt.terms
+                if isinstance(lhs, Var) and lhs.name == var and isinstance(rhs, Ref):
+                    # input.parameters.<pp>[_](.<subpath>)*
+                    src = self._param_array_elem_path(rhs)
+                    if src is not None:
+                        param_source = src
+                        continue
+                return SUnknown()
+            if stmt.kind == "term" and isinstance(stmt.terms[0], BinOp):
+                b = stmt.terms[0]
+                if (
+                    b.op == "!="
+                    and isinstance(b.lhs, Var)
+                    and b.lhs.name == var
+                    and isinstance(b.rhs, Scalar)
+                    and isinstance(b.rhs.value, str)
+                ):
+                    excludes.append(b.rhs.value)
+                    continue
+                return SUnknown()
+            return SUnknown()
+        if param_source is not None:
+            pp, sub = param_source
+            self.param_arrays.setdefault(pp, set()).add(sub)
+            return SParamIds(pp, sub)
+        if key_source is not None:
+            iter_paths, rel = key_source
+            return SKeySet(iter_paths, rel, tuple(excludes))
+        return SUnknown()
+
+    def _resolve_ref_allow_arrays(self, t: Ref, env):
+        """Resolve a ref that may traverse arrays ([]) — used for key-set
+        sources like spec.volumes[_] or metadata.labels.  Returns
+        (iter_paths, rel_segs) review-rooted."""
+        if not isinstance(t.head, Var):
+            raise _Unsupported()
+        segs: List[str] = []
+        name = t.head.name
+        if name in env:
+            base = env[name]
+            if isinstance(base, SPath) and base.root == "review":
+                segs.extend(base.segs)
+            elif isinstance(base, SPath) and isinstance(base.root, tuple):
+                # slot-entity-relative key set: unsupported for now
+                raise _Unsupported()
+            else:
+                raise _Unsupported()
+        elif name == "input":
+            pass
+        else:
+            raise _Unsupported()
+        for op in t.operands:
+            if isinstance(op, Scalar) and isinstance(op.value, str):
+                segs.append(op.value)
+            elif isinstance(op, Var) and op.is_wildcard:
+                segs.append("[]")
+            else:
+                raise _Unsupported()
+        if name == "input":
+            if segs[:1] != ["review"]:
+                raise _Unsupported()
+            segs = segs[1:]
+        if "[]" in segs:
+            last = len(segs) - 1 - segs[::-1].index("[]")
+            return (tuple(segs[: last + 1]),), tuple(segs[last + 1 :])
+        return (tuple(segs),), ()
+
+    def _resolve_satisfied_compr(self, t: ArrayCompr, env, state):
+        """[good | p = input.parameters.X[_]; good = pred(col, p)] ->
+        SPredAny(AnyParam(X, [StrPred...]))."""
+        if not isinstance(t.head, Var):
+            return SUnknown()
+        good = t.head.name
+        param_path = None
+        param_var = None
+        pred_node = None
+        for stmt in t.body:
+            if stmt.kind not in ("assign", "unify"):
+                return SUnknown()
+            lhs, rhs = stmt.terms
+            if isinstance(lhs, Var) and isinstance(rhs, Ref):
+                if (
+                    isinstance(rhs.head, Var)
+                    and rhs.head.name == "input"
+                    and rhs.operands
+                    and isinstance(rhs.operands[0], Scalar)
+                    and rhs.operands[0].value == "parameters"
+                    and isinstance(rhs.operands[-1], Var)
+                    and rhs.operands[-1].is_wildcard
+                ):
+                    pp = []
+                    for op in rhs.operands[1:-1]:
+                        if isinstance(op, Scalar) and isinstance(op.value, str):
+                            pp.append(op.value)
+                        else:
+                            return SUnknown()
+                    param_path = tuple(pp)
+                    param_var = lhs.name
+                    continue
+            if (
+                isinstance(lhs, Var)
+                and lhs.name == good
+                and isinstance(rhs, Call)
+                and len(rhs.path) == 1
+                and rhs.path[0] in _STR_PREDS
+                and param_path is not None
+            ):
+                pred_node = self._make_strpred(
+                    rhs, env, state, param_elem=(param_var, param_path)
+                )
+                continue
+            return SUnknown()
+        if pred_node is None or param_path is None:
+            return SUnknown()
+        self.param_arrays.setdefault(param_path, set()).add(())
+        return SPredAny(AnyParam(param_path, (pred_node,)))
+
+    # ---- conditions -------------------------------------------------------
+
+    def _compile_cond_expr(self, stmt: Expr, env, state, exact_required):
+        if stmt.kind == "not":
+            inner = self._compile_cond_expr(stmt.terms[0], env, state, True)
+            return BoolOp("not", (_flip_unknown_defaults(inner),))
+        if stmt.kind in ("assign", "unify"):
+            raise _Unsupported()
+        t = stmt.terms[0]
+        return self._compile_cond_term(t, env, state, exact_required)
+
+    def _compile_cond_term(self, t: Node, env, state, exact_required):
+        if isinstance(t, Ref):
+            # `banned[tag]`-style membership on a param id set
+            if (
+                isinstance(t.head, Var)
+                and t.head.name in env
+                and isinstance(env[t.head.name], SParamIds)
+                and len(t.operands) == 1
+            ):
+                elem = self._operand(self._resolve(t.operands[0], env, state), state)
+                s = env[t.head.name]
+                self.param_arrays.setdefault(s.ppath, set()).add(s.subpath)
+                return AnyParam(
+                    s.ppath, (Cmp("==", ParamElemRef(s.ppath, s.subpath), elem),)
+                )
+            sym = self._resolve(t, env, state)
+            return Truthy(self._operand(sym, state))
+        if isinstance(t, Var):
+            sym = self._resolve(t, env, state)
+            if isinstance(sym, SPredAny):
+                raise _Unsupported()
+            return Truthy(self._operand(sym, state))
+        if isinstance(t, BinOp):
+            if t.op not in _CMP_OPS:
+                raise _Unsupported()
+            return self._compile_cmp(t, env, state)
+        if isinstance(t, Call):
+            return self._compile_call_cond(t, env, state, exact_required)
+        raise _Unsupported()
+
+    def _compile_cmp(self, t: BinOp, env, state):
+        # count(x) cmp n with x a set difference
+        for lhs, rhs, op in ((t.lhs, t.rhs, t.op), (t.rhs, t.lhs, _flip(t.op))):
+            if (
+                isinstance(lhs, Call)
+                and lhs.path == ("count",)
+                and isinstance(rhs, Scalar)
+                and isinstance(rhs.value, int)
+            ):
+                arg = self._resolve(lhs.args[0], env, state, allow_compr=True)
+                if isinstance(arg, SSetDiff):
+                    return self._setcount(arg, op, rhs.value)
+                raise _Unsupported()
+        # `input.parameters.X[_] == v`: exists over the parameter array
+        for lhs, rhs, op in ((t.lhs, t.rhs, t.op), (t.rhs, t.lhs, _flip(t.op))):
+            pp = self._try_param_elem_ref(lhs)
+            if pp is not None:
+                other = self._operand(self._resolve(rhs, env, state), state)
+                self.param_arrays.setdefault(pp, set()).add(())
+                return AnyParam(pp, (Cmp(op, ParamElemRef(pp), other),))
+        a = self._operand(self._resolve(t.lhs, env, state), state)
+        b = self._operand(self._resolve(t.rhs, env, state), state)
+        return Cmp(t.op, a, b)
+
+    @staticmethod
+    def _param_array_elem_path(t: Node):
+        """input.parameters.<pp>[_](.<sub>)* -> ((pp,), (sub,)) or None."""
+        if not (
+            isinstance(t, Ref)
+            and isinstance(t.head, Var)
+            and t.head.name == "input"
+            and len(t.operands) >= 2
+            and isinstance(t.operands[0], Scalar)
+            and t.operands[0].value == "parameters"
+        ):
+            return None
+        pp: List[str] = []
+        sub: List[str] = []
+        seen_wild = False
+        for op in t.operands[1:]:
+            if isinstance(op, Var) and op.is_wildcard:
+                if seen_wild:
+                    return None
+                seen_wild = True
+            elif isinstance(op, Scalar) and isinstance(op.value, str):
+                (sub if seen_wild else pp).append(op.value)
+            else:
+                return None
+        if not seen_wild:
+            return None
+        return tuple(pp), tuple(sub)
+
+    @staticmethod
+    def _try_param_elem_ref(t: Node):
+        """input.parameters.<path>[_] -> ppath, else None."""
+        if not (
+            isinstance(t, Ref)
+            and isinstance(t.head, Var)
+            and t.head.name == "input"
+            and len(t.operands) >= 2
+            and isinstance(t.operands[0], Scalar)
+            and t.operands[0].value == "parameters"
+            and isinstance(t.operands[-1], Var)
+            and t.operands[-1].is_wildcard
+        ):
+            return None
+        pp = []
+        for op in t.operands[1:-1]:
+            if isinstance(op, Scalar) and isinstance(op.value, str):
+                pp.append(op.value)
+            else:
+                return None
+        return tuple(pp)
+
+    def _setcount(self, diff: SSetDiff, op: str, n: int):
+        def side(s):
+            if isinstance(s, SKeySet):
+                spec = ColumnSpec("keyset", s.iter_paths, s.rel, s.exclude)
+                self.columns[spec.key] = spec
+                return ("keyset", spec.key)
+            if isinstance(s, SParamIds):
+                self.param_arrays.setdefault(s.ppath, set()).add(s.subpath)
+                return ("paramids", (s.ppath, s.subpath))
+            raise _Unsupported()
+
+        return SetCountCmp(side(diff.left), side(diff.right), op, n)
+
+    def _compile_call_cond(self, t: Call, env, state, exact_required):
+        name = ".".join(t.path)
+        if name in _STR_PREDS:
+            return self._make_strpred(t, env, state)
+        if name == "any" and len(t.args) == 1:
+            sym = self._resolve(t.args[0], env, state)
+            if isinstance(sym, SPredAny):
+                return sym.node
+            raise _Unsupported()
+        if len(t.path) == 1 and t.path[0] in self.cm.rules:
+            return self._inline_helper(t.path[0], t.args, env, state)
+        raise _Unsupported()
+
+    def _make_strpred(self, t: Call, env, state, param_elem=None):
+        pred = t.path[0]
+        if len(t.args) != 2:
+            raise _Unsupported()
+        a0, a1 = t.args
+        if pred == "re_match":
+            pattern, value = a0, a1
+        else:
+            value, pattern = a0, a1
+        col_sym = self._resolve(value, env, state)
+        col = self._operand(col_sym, state)
+        if not isinstance(col, ColRef):
+            raise _Unsupported()
+        # pattern side: param scalar / param elem / literal
+        if param_elem and isinstance(pattern, Var) and pattern.name == param_elem[0]:
+            rhs: Any = ParamElemRef(param_elem[1])
+        else:
+            sym = self._resolve(pattern, env, state)
+            if isinstance(sym, SConst) and isinstance(sym.value, str):
+                rhs = Lit(sym.value)
+                self.literals.add(sym.value)
+            elif isinstance(sym, SPath) and sym.root == "params":
+                self.param_scalars.add(sym.segs)
+                rhs = ParamRef(sym.segs)
+            else:
+                raise _Unsupported()
+        node = StrPred(pred, col, rhs, pred_id=len(self.str_preds))
+        self.str_preds.append(node)
+        return node
+
+    def _inline_helper(self, name: str, args, env, state, depth: int = 0):
+        """Boolean helper function -> disjunction of clause conjunctions.
+        Every statement of every clause must compile (exactness under the
+        possibility of negation is enforced by the caller chain)."""
+        if depth > 4:
+            raise _Unsupported()
+        rules = self.cm.rules.get(name, [])
+        arg_syms = [self._resolve(a, env, state) for a in args]
+        disjuncts: List = []
+        for r in rules:
+            if not r.is_function or len(r.args or ()) != len(args):
+                raise _Unsupported()
+            if r.value is not None and not (
+                isinstance(r.value, Scalar) and r.value.value is True
+            ):
+                raise _Unsupported()  # non-boolean helper
+            env2: Dict[str, Any] = {}
+            for p, s in zip(r.args, arg_syms):
+                if isinstance(p, Var):
+                    env2[p.name] = s
+                else:
+                    raise _Unsupported()  # literal-arg clauses unsupported
+            conds: List = []
+            state2 = dict(state)
+            for stmt in r.body:
+                self._compile_stmt(stmt, env2, conds, state2, exact_required=True)
+            if state2["slot"] != state["slot"]:
+                # The helper clause opened its own iteration axis: reduce it
+                # locally so sibling clauses stay resource-level (a pod with
+                # hostNetwork but no containers must still violate).
+                if state["slot"] is not None:
+                    raise _Unsupported()  # would be a second axis
+                from .vexpr import ReduceSlots
+
+                disjuncts.append(ReduceSlots(tuple(conds), state2["slot"]))
+                continue
+            disjuncts.append(BoolOp("and", tuple(conds)) if conds else Const(True))
+        if not disjuncts:
+            raise _Unsupported()
+        return BoolOp("or", tuple(disjuncts))
+
+    # ---- operands ---------------------------------------------------------
+
+    def _operand(self, sym, state):
+        if isinstance(sym, SConst):
+            return Lit(sym.value) if not isinstance(sym.value, str) else self._lit(sym.value)
+        if isinstance(sym, SPath):
+            if sym.root == "review":
+                spec = ColumnSpec("scalar", (), tuple(sym.segs))
+                self.columns[spec.key] = spec
+                return ColRef(spec.key, slot=False)
+            if sym.root == "params":
+                self.param_scalars.add(sym.segs)
+                return ParamRef(sym.segs)
+            if isinstance(sym.root, tuple) and sym.root[0] == "slot":
+                iter_paths = sym.root[1]
+                spec = ColumnSpec("slot", iter_paths, tuple(sym.segs))
+                self.columns[spec.key] = spec
+                return ColRef(spec.key, slot=True)
+        raise _Unsupported()
+
+    def _lit(self, s: str):
+        self.literals.add(s)
+        return Lit(s)
+
+
+def _flip(op: str) -> str:
+    return {"<": ">", ">": "<", "<=": ">=", ">=": "<=", "==": "==", "!=": "!="}[op]
+
+
+def _flip_unknown_defaults(node):
+    """Under (odd-depth) negation, runtime-unknown comparison cells must
+    resolve False so the negated result stays an over-approximation."""
+    from dataclasses import replace
+
+    if isinstance(node, Cmp):
+        return replace(node, unknown_default=not node.unknown_default)
+    if isinstance(node, BoolOp):
+        return BoolOp(node.op, tuple(_flip_unknown_defaults(c) for c in node.children))
+    if isinstance(node, AnyParam):
+        return AnyParam(node.ppath, tuple(_flip_unknown_defaults(c) for c in node.inner))
+    from .vexpr import ReduceSlots
+
+    if isinstance(node, ReduceSlots):
+        return ReduceSlots(
+            tuple(_flip_unknown_defaults(c) for c in node.inner), node.iter_key
+        )
+    return node
+
+
+def vectorize(policy: TemplatePolicy) -> Optional[VProgram]:
+    """Compile a template policy to a vectorized program, or None when
+    nothing at all is recognizable (callers then use an all-true mask)."""
+    try:
+        return Vectorizer(policy).compile()
+    except _Unsupported:
+        return None
+    except Exception:
+        return None

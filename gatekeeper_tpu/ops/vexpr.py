@@ -1,0 +1,467 @@
+"""VExpr: the vectorized predicate IR for violation rules.
+
+A template's violation rules compile (ops/vectorizer.py) into a VProgram:
+clauses OR-ed over [C, R] (C constraints of the template's kind, R
+resources), each clause an AND of conditions, optionally reduced over a slot
+axis S (one flattened array-iteration) and/or a constraint-parameter axis P.
+
+Soundness contract: a program may OVER-approximate the true violation
+predicate (false positives are filtered by the host-side interpreter render)
+but must never under-approximate.  Conditions whose exact value cannot be
+computed on device resolve to a compile-time `unknown_default` chosen by
+polarity: True in positive positions, False under negation.
+
+Cross-type comparisons follow OPA's total order via type-code ranks
+(null < bool < number < string < composites), making </==/etc exact for
+every case the corpus produces.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from .columns import T_COMP, T_FALSE, T_NUM, T_STR, T_TRUE, T_UNDEF
+
+# ---- operands -------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ColRef:
+    """A scalar [R] or slot [R, S] column."""
+
+    colkey: Tuple
+    slot: bool
+
+
+@dataclass(frozen=True)
+class ParamRef:
+    """Per-constraint scalar parameter [C]."""
+
+    ppath: Tuple[str, ...]
+
+
+@dataclass(frozen=True)
+class ParamElemRef:
+    """Per-element field of the active AnyParam axis [C, P]."""
+
+    ppath: Tuple[str, ...]
+    subpath: Tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class Lit:
+    value: Any
+
+
+Operand = Union[ColRef, ParamRef, ParamElemRef, Lit]
+
+# ---- nodes ----------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Const:
+    value: bool
+
+
+@dataclass(frozen=True)
+class Truthy:
+    operand: Operand
+    negate: bool = False
+
+
+@dataclass(frozen=True)
+class Cmp:
+    op: str  # == != < <= > >=
+    lhs: Operand
+    rhs: Operand
+    unknown_default: bool = True
+
+
+@dataclass(frozen=True)
+class StrPred:
+    """pred(col_string, rhs_string): startswith/endswith/contains/re_match
+    (re_match: rhs is the pattern).  Evaluated via host-precomputed lookup
+    tables over the vocabulary; `pred_id` keys the table set in the env."""
+
+    pred: str
+    operand: Operand  # the string column tested
+    rhs: Operand  # ParamRef/ParamElemRef/Lit supplying the pattern/affix
+    pred_id: int = -1
+    negate: bool = False
+
+
+@dataclass(frozen=True)
+class AnyParam:
+    """Exists over a parameter-array axis."""
+
+    ppath: Tuple[str, ...]
+    inner: Tuple[Any, ...]  # conjunction over [C, P(, R, S)]
+
+
+@dataclass(frozen=True)
+class SetCountCmp:
+    """count(left - right) <op> n over id sets."""
+
+    left: Tuple[str, Any]  # ("keyset", colkey) | ("paramids", ppath)
+    right: Tuple[str, Any]
+    op: str
+    n: int
+
+
+@dataclass(frozen=True)
+class AnySlots:
+    inner: Tuple[Any, ...]  # conjunction, may reference slot columns
+
+
+@dataclass(frozen=True)
+class ReduceSlots:
+    """any over a slot axis of (inner conjunction & slot mask), producing a
+    resource-level value — used when an inlined helper clause iterates an
+    array while the enclosing violation clause does not."""
+
+    inner: Tuple[Any, ...]
+    iter_key: Tuple
+
+
+@dataclass(frozen=True)
+class BoolOp:
+    """Generic combinators for inlined helper disjunctions: op in
+    {'and', 'or', 'not'}.  'not' is STATEMENT negation: true when the child
+    statement fails (false or undefined) — children already encode
+    undefined-as-False, so plain logical negation is correct."""
+
+    op: str
+    children: Tuple[Any, ...]
+
+
+VNode = Union[
+    Const, Truthy, Cmp, StrPred, AnyParam, SetCountCmp, AnySlots, BoolOp, ReduceSlots
+]
+
+
+@dataclass
+class Clause:
+    conds: Tuple[VNode, ...]
+    slot_iter: Optional[Tuple] = None  # iteration group key, if any
+
+
+@dataclass
+class VProgram:
+    clauses: List[Clause]
+    column_specs: List  # ColumnSpec list
+    param_scalars: List[Tuple[str, ...]]
+    param_arrays: List[Tuple[Tuple[str, ...], Tuple[Tuple[str, ...], ...]]]
+    # (ppath, needed elem subpaths)
+    str_preds: List[StrPred] = field(default_factory=list)
+    literals: List[str] = field(default_factory=list)
+    exact: bool = True
+
+
+# ---- evaluation -----------------------------------------------------------
+
+_RANK = np.array([-1, 0, 1, 1, 2, 3, 4], np.int8)  # tcode -> OPA order rank
+
+
+class EvalEnv:
+    """Bound arrays for one (constraint batch, resource batch) evaluation.
+
+    cols:    colkey -> {tcode[R(,S)], sid, num, mask?}
+    params:  ppath -> {tcode[C], sid, num}
+    elems:   (ppath, subpath) -> {tcode[C,P], sid, num, mask[C,P]}
+    tables:  pred_id -> (table [U, vocab] uint8, idx [C] or [C, P])
+    keysets: colkey -> ids [R, K]
+    """
+
+    def __init__(self, cols, params, elems, tables, keysets, C, R):
+        self.cols = cols
+        self.params = params
+        self.elems = elems
+        self.tables = tables
+        self.keysets = keysets
+        self.C = C
+        self.R = R
+
+
+def _operand_arrays(op: Operand, env: EvalEnv, axes: str):
+    """Return dict with tcode/sid/num arrays broadcast to `axes` layout.
+    axes is one of 'CR', 'CRS', 'CPR', 'CPRS' (P present inside AnyParam)."""
+    lead = 2 if "P" in axes else 1  # C(,P) leading broadcast dims for columns
+
+    def shape_col(a, slot):
+        # col arrays are [R] or [R, S]; prepend C(,P) dims, append S if needed
+        x = jnp.asarray(a)
+        if slot and not axes.endswith("S"):
+            raise ValueError("slot column outside slot context")
+        for _ in range(lead):
+            x = x[None]
+        if not slot and axes.endswith("S"):
+            x = x[..., None]
+        return x
+
+    if isinstance(op, ColRef):
+        d = env.cols[op.colkey]
+        return {k: shape_col(v, op.slot) for k, v in d.items() if k != "mask"}
+    if isinstance(op, ParamRef):
+        d = env.params[op.ppath]
+        out = {}
+        for k, v in d.items():
+            x = jnp.asarray(v)  # [C]
+            if "P" in axes:
+                x = x[:, None]  # [C, 1]
+            x = x[..., None]  # broadcast over R
+            if axes.endswith("S"):
+                x = x[..., None]
+            out[k] = x
+        return out
+    if isinstance(op, ParamElemRef):
+        d = env.elems[(op.ppath, op.subpath)]
+        out = {}
+        for k, v in d.items():
+            if k == "mask":
+                continue
+            x = jnp.asarray(v)[:, :, None]  # [C, P, 1]
+            if axes.endswith("S"):
+                x = x[..., None]
+            out[k] = x
+        return out
+    if isinstance(op, Lit):
+        v = op.value
+        if isinstance(v, str):
+            # literal string ids are interned at pack time into env.params
+            # under the pseudo-path ("__lit__", v); [1]-shaped scalars
+            d = env.params[("__lit__", v)]
+            return {
+                "tcode": jnp.asarray(d["tcode"])[0],
+                "sid": jnp.asarray(d["sid"])[0],
+                "num": jnp.asarray(0.0),
+            }
+        if isinstance(v, bool):
+            return {
+                "tcode": jnp.asarray(T_TRUE if v else T_FALSE, jnp.int8),
+                "sid": jnp.asarray(-1, jnp.int32),
+                "num": jnp.asarray(0.0),
+            }
+        if isinstance(v, (int, float)):
+            return {
+                "tcode": jnp.asarray(T_NUM, jnp.int8),
+                "sid": jnp.asarray(-1, jnp.int32),
+                "num": jnp.asarray(float(v)),
+            }
+        raise ValueError(f"unsupported literal {v!r}")
+    raise TypeError(op)
+
+
+def _eval_node(node: VNode, env: EvalEnv, axes: str):
+    if isinstance(node, Const):
+        return jnp.asarray(node.value)
+    if isinstance(node, Truthy):
+        d = _operand_arrays(node.operand, env, axes)
+        truthy = (d["tcode"] != T_UNDEF) & (d["tcode"] != T_FALSE)
+        return ~truthy if node.negate else truthy
+    if isinstance(node, Cmp):
+        return _eval_cmp(node, env, axes)
+    if isinstance(node, StrPred):
+        return _eval_strpred(node, env, axes)
+    if isinstance(node, AnyParam):
+        sub_axes = "CP" + axes[1:]  # insert P after C
+        parts = [_eval_node(n, env, sub_axes) for n in node.inner]
+        mask = jnp.asarray(env.elems[(node.ppath, ())]["mask"])  # [C, P]
+        m = mask[:, :, None]
+        if axes.endswith("S"):
+            m = m[..., None]
+        acc = m
+        for p in parts:
+            acc = acc & p
+        return jnp.any(acc, axis=1)
+    if isinstance(node, SetCountCmp):
+        return _eval_setcount(node, env, axes)
+    if isinstance(node, BoolOp):
+        parts = [_eval_node(c, env, axes) for c in node.children]
+        if node.op == "not":
+            return ~parts[0]
+        acc = parts[0]
+        for p in parts[1:]:
+            acc = (acc & p) if node.op == "and" else (acc | p)
+        return acc
+    if isinstance(node, ReduceSlots):
+        if axes.endswith("S"):
+            raise ValueError("nested slot reduction is not supported")
+        sub_axes = axes + "S"
+        mask = _slot_mask(env, node.iter_key)  # [R, S]
+        m = mask[None]
+        if "P" in axes:
+            m = m[None]
+            m = jnp.moveaxis(m, 0, 0)  # [1, 1, R, S]
+        acc = m
+        for n in node.inner:
+            acc = acc & _eval_node(n, env, sub_axes)
+        return jnp.any(acc, axis=-1)
+    if isinstance(node, AnySlots):
+        raise ValueError("AnySlots must be handled at clause level")
+    raise TypeError(node)
+
+
+def _cmp_values(a, b, op: str, unknown_default: bool):
+    ra = _RANK_LOOKUP(a["tcode"])
+    rb = _RANK_LOOKUP(b["tcode"])
+    defined = (a["tcode"] != T_UNDEF) & (b["tcode"] != T_UNDEF)
+    both_comp = (a["tcode"] == T_COMP) & (b["tcode"] == T_COMP)
+
+    same_rank = ra == rb
+    # per-rank equality (composite unknown)
+    eq_val = jnp.where(
+        a["tcode"] == T_NUM, a["num"] == b["num"],
+        jnp.where(
+            a["tcode"] == T_STR, a["sid"] == b["sid"],
+            a["tcode"] == b["tcode"],  # null/bools: tcode equality decides
+        ),
+    )
+    eq = same_rank & eq_val & (a["tcode"] == b["tcode"])
+
+    if op in ("==", "!="):
+        res = eq if op == "==" else defined & ~eq
+        return jnp.where(both_comp, unknown_default, defined & res)
+
+    # ordering: rank decides across types; within rank use value
+    lt_val = jnp.where(
+        a["tcode"] == T_NUM, a["num"] < b["num"],
+        jnp.where(
+            a["tcode"] == T_STR, jnp.asarray(False),  # string<string: unknown
+            (a["tcode"] == T_FALSE) & (b["tcode"] == T_TRUE),
+        ),
+    )
+    lt = jnp.where(same_rank, lt_val, ra < rb)
+    unknown = both_comp | (same_rank & (a["tcode"] == T_STR))
+    if op == "<":
+        res = lt
+    elif op == ">":
+        res = ~lt & ~eq
+    elif op == "<=":
+        res = lt | eq
+    else:  # >=
+        res = ~lt
+    return jnp.where(unknown, unknown_default, defined & res)
+
+
+def _RANK_LOOKUP(tcode):
+    return jnp.asarray(_RANK)[jnp.clip(tcode, 0, 6)]
+
+
+def _eval_cmp(node: Cmp, env: EvalEnv, axes: str):
+    a = _operand_arrays(node.lhs, env, axes)
+    b = _operand_arrays(node.rhs, env, axes)
+    return _cmp_values(a, b, node.op, node.unknown_default)
+
+
+def _eval_strpred(node: StrPred, env: EvalEnv, axes: str):
+    table, idx = env.tables[node.pred_id]  # [U, vocab], [C] or [C, P]
+    d = _operand_arrays(node.operand, env, axes)
+    sid = d["sid"]
+    is_str = d["tcode"] == T_STR
+    idx = jnp.asarray(idx)
+    if idx.ndim == 1:  # per-constraint
+        idx_b = idx[:, None]
+        if "P" in axes:
+            idx_b = idx_b[:, None]
+    else:  # [C, P]
+        idx_b = idx[:, :, None]
+    if axes.endswith("S"):
+        idx_b = idx_b[..., None]
+    table = jnp.asarray(table)
+    hit = table[idx_b, jnp.clip(sid, 0, table.shape[1] - 1)] != 0
+    res = is_str & (sid >= 0) & hit
+    return ~res if node.negate else res
+
+
+def _eval_setcount(node: SetCountCmp, env: EvalEnv, axes: str):
+    from .interning import Interner
+
+    def side(ref):
+        kind, key = ref
+        if kind == "keyset":
+            ids = jnp.asarray(env.keysets[key])  # [R, K]
+            return ids, ids != Interner.PAD, "R"
+        # key is (ppath, subpath)
+        ids = jnp.asarray(env.elems[key]["sid"])  # [C, P]
+        mask = jnp.asarray(env.elems[key]["mask"])
+        return ids, mask, "C"
+
+    lids, lmask, lax = side(node.left)
+    rids, rmask, rax = side(node.right)
+
+    if lax == "C" and rax == "R":
+        # count over P of params not present in the keyset  -> [C, R]
+        inr = jnp.any(
+            (lids[:, :, None, None] == rids[None, None, :, :])
+            & rmask[None, None, :, :],
+            axis=3,
+        )  # [C, P, R]
+        cnt = jnp.sum(lmask[:, :, None] & ~inr, axis=1)  # [C, R]
+    elif lax == "R" and rax == "C":
+        inr = jnp.any(
+            (lids[None, :, :, None] == rids[:, None, None, :])
+            & rmask[:, None, None, :],
+            axis=3,
+        )  # [C, R, K]
+        cnt = jnp.sum(lmask[None, :, :] & ~inr, axis=2)  # [C, R]
+    else:
+        raise ValueError("unsupported SetCountCmp side combination")
+
+    n = node.n
+    return {
+        ">": cnt > n, ">=": cnt >= n, "<": cnt < n,
+        "<=": cnt <= n, "==": cnt == n, "!=": cnt != n,
+    }[node.op]
+
+
+def _slot_mask(env: EvalEnv, iter_key: Tuple):
+    for spec_key, arrs in env.cols.items():
+        if "mask" in arrs and spec_key[1] == iter_key:
+            return jnp.asarray(arrs["mask"])
+    raise ValueError("no slot column for iteration group")
+
+
+def eval_program(prog: VProgram, env: EvalEnv):
+    """-> bool[C, R]: OR over clauses."""
+    total = jnp.zeros((env.C, env.R), bool)
+    for clause in prog.clauses:
+        r_conds: List = []
+        s_conds: List = []
+        for cond in clause.conds:
+            if _clause_uses_slot(cond):
+                s_conds.append(cond)
+            else:
+                r_conds.append(cond)
+        acc = jnp.ones((env.C, env.R), bool)
+        for cond in r_conds:
+            acc = acc & _eval_node(cond, env, "CR")
+        if clause.slot_iter is not None:
+            mask = _slot_mask(env, clause.slot_iter)
+            sacc = mask[None, :, :]  # [1, R, S]
+            for cond in s_conds:
+                sacc = sacc & _eval_node(cond, env, "CRS")
+            acc = acc & jnp.any(sacc, axis=2)
+        elif s_conds:
+            raise ValueError("slot conditions without slot_iter")
+        total = total | acc
+    return total
+
+
+def _clause_uses_slot(node: VNode) -> bool:
+    if isinstance(node, Truthy):
+        return isinstance(node.operand, ColRef) and node.operand.slot
+    if isinstance(node, Cmp):
+        return any(
+            isinstance(o, ColRef) and o.slot for o in (node.lhs, node.rhs)
+        )
+    if isinstance(node, StrPred):
+        return isinstance(node.operand, ColRef) and node.operand.slot
+    if isinstance(node, AnyParam):
+        return any(_clause_uses_slot(n) for n in node.inner)
+    if isinstance(node, BoolOp):
+        return any(_clause_uses_slot(n) for n in node.children)
+    return False

@@ -4,12 +4,25 @@ multi-chip sharding paths compile and execute without TPU hardware
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# This dev environment tunnels JAX to a real TPU chip via the "axon" PJRT
+# plugin (sitecustomize registers it whenever PALLAS_AXON_POOL_IPS is set,
+# and JAX_PLATFORMS=axon is baked into the env).  Every host<->device
+# transfer then pays a network round trip, so tests must run on the true
+# local CPU backend: clear the plugin trigger BEFORE any jax import and
+# force the platform.
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The axon sitecustomize may already have registered the plugin (it runs at
+# interpreter start, before this file); a late platform switch still works.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pathlib
 import sys
