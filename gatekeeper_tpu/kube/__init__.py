@@ -1,0 +1,1 @@
+from .inmem import InMemoryKube, WatchEvent  # noqa: F401

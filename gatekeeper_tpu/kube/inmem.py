@@ -1,0 +1,176 @@
+"""In-memory Kubernetes API: the control plane's API-server abstraction.
+
+Plays the role envtest plays in the reference's test strategy (SURVEY.md
+section 4 tier 2): a real store with list/get/create/update/delete/watch
+semantics and resourceVersion bookkeeping, no kubelet.  The controllers,
+webhook, audit manager and readiness tracker are written against this
+interface; a real-cluster client can implement the same surface later.
+
+Watches deliver ADDED/MODIFIED/DELETED events over per-watcher queues with
+replay of existing objects on start (the reference's watch manager replays
+cached objects to late joiners, pkg/watch/replay.go:35-120).
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+GVK = Tuple[str, str, str]  # (group, version, kind)
+
+
+def gvk_of(obj: dict) -> GVK:
+    api = obj.get("apiVersion", "")
+    if "/" in api:
+        g, v = api.split("/", 1)
+    else:
+        g, v = "", api
+    return (g, v, obj.get("kind", ""))
+
+
+def obj_key(obj: dict) -> Tuple[str, str]:
+    meta = obj.get("metadata") or {}
+    return (meta.get("namespace") or "", meta.get("name") or "")
+
+
+@dataclass
+class WatchEvent:
+    type: str  # ADDED | MODIFIED | DELETED
+    object: dict
+
+
+class Conflict(Exception):
+    pass
+
+
+class NotFound(Exception):
+    pass
+
+
+class InMemoryKube:
+    def __init__(self):
+        self._store: Dict[GVK, Dict[Tuple[str, str], dict]] = {}
+        self._watchers: Dict[GVK, List[queue.Queue]] = {}
+        self._rv = itertools.count(1)
+        self._lock = threading.RLock()
+
+    # ---- CRUD -------------------------------------------------------------
+
+    def create(self, obj: dict) -> dict:
+        with self._lock:
+            gvk = gvk_of(obj)
+            key = obj_key(obj)
+            bucket = self._store.setdefault(gvk, {})
+            if key in bucket:
+                raise Conflict(f"{gvk} {key} already exists")
+            stored = copy.deepcopy(obj)
+            meta = stored.setdefault("metadata", {})
+            meta["resourceVersion"] = str(next(self._rv))
+            meta.setdefault("uid", f"uid-{meta.get('name', '')}-{meta['resourceVersion']}")
+            bucket[key] = stored
+            self._notify(gvk, WatchEvent("ADDED", copy.deepcopy(stored)))
+            return copy.deepcopy(stored)
+
+    def get(self, gvk: GVK, name: str, namespace: str = "") -> dict:
+        with self._lock:
+            try:
+                return copy.deepcopy(self._store[gvk][(namespace, name)])
+            except KeyError:
+                raise NotFound(f"{gvk} {namespace}/{name}")
+
+    def update(self, obj: dict, check_version: bool = False) -> dict:
+        with self._lock:
+            gvk = gvk_of(obj)
+            key = obj_key(obj)
+            bucket = self._store.setdefault(gvk, {})
+            if key not in bucket:
+                raise NotFound(f"{gvk} {key}")
+            if check_version:
+                old_rv = bucket[key].get("metadata", {}).get("resourceVersion")
+                new_rv = obj.get("metadata", {}).get("resourceVersion")
+                if old_rv != new_rv:
+                    raise Conflict(f"{gvk} {key}: resourceVersion mismatch")
+            stored = copy.deepcopy(obj)
+            stored.setdefault("metadata", {})["resourceVersion"] = str(next(self._rv))
+            # preserve uid across updates
+            stored["metadata"].setdefault(
+                "uid", bucket[key].get("metadata", {}).get("uid")
+            )
+            bucket[key] = stored
+            self._notify(gvk, WatchEvent("MODIFIED", copy.deepcopy(stored)))
+            return copy.deepcopy(stored)
+
+    def apply(self, obj: dict) -> dict:
+        """create-or-update."""
+        try:
+            return self.create(obj)
+        except Conflict:
+            return self.update(obj)
+
+    def delete(self, gvk: GVK, name: str, namespace: str = "") -> bool:
+        with self._lock:
+            bucket = self._store.get(gvk, {})
+            obj = bucket.pop((namespace, name), None)
+            if obj is None:
+                return False
+            self._notify(gvk, WatchEvent("DELETED", copy.deepcopy(obj)))
+            return True
+
+    def list(self, gvk: GVK, namespace: Optional[str] = None) -> List[dict]:
+        with self._lock:
+            out = []
+            for (ns, _name), obj in sorted(self._store.get(gvk, {}).items()):
+                if namespace is None or ns == namespace:
+                    out.append(copy.deepcopy(obj))
+            return out
+
+    def list_gvks(self) -> List[GVK]:
+        """Discovery: every GVK with stored objects (the analogue of
+        ServerPreferredResources in audit discovery mode)."""
+        with self._lock:
+            return sorted(self._store.keys())
+
+    # ---- watch ------------------------------------------------------------
+
+    def watch(self, gvk: GVK, replay: bool = True) -> "Watcher":
+        q: queue.Queue = queue.Queue()
+        with self._lock:
+            if replay:
+                for obj in self.list(gvk):
+                    q.put(WatchEvent("ADDED", obj))
+            self._watchers.setdefault(gvk, []).append(q)
+        return Watcher(self, gvk, q)
+
+    def _unwatch(self, gvk: GVK, q: queue.Queue):
+        with self._lock:
+            try:
+                self._watchers.get(gvk, []).remove(q)
+            except ValueError:
+                pass
+
+    def _notify(self, gvk: GVK, event: WatchEvent):
+        for q in self._watchers.get(gvk, []):
+            q.put(event)
+
+
+class Watcher:
+    def __init__(self, kube: InMemoryKube, gvk: GVK, q: queue.Queue):
+        self.kube = kube
+        self.gvk = gvk
+        self.queue = q
+        self._stopped = False
+
+    def next(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
+        try:
+            return self.queue.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def stop(self):
+        if not self._stopped:
+            self._stopped = True
+            self.kube._unwatch(self.gvk, self.queue)

@@ -14,10 +14,10 @@ throughput does.  Templates with no vectorized program get all-true columns
 
 from __future__ import annotations
 
-import functools
 from typing import Dict, List, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..client.drivers import CompiledTemplate, InterpDriver, Result
@@ -32,23 +32,6 @@ from .vectorizer import vectorize
 from .vexpr import EvalEnv, VProgram, eval_program
 
 
-@functools.partial(jax.jit, static_argnames=())
-def _match_jit(rv, cs):
-    return match_kernel(rv, cs)
-
-
-def _make_eval_jit(prog: VProgram):
-    """One jitted evaluator per template program; C/R are static so jit
-    re-specializes per shape bucket."""
-
-    @functools.partial(jax.jit, static_argnames=("C", "R"))
-    def run(prog_cols, params, elems, tables, keysets, C, R):
-        env = EvalEnv(prog_cols, params, elems, tables, keysets, C, R)
-        return eval_program(prog, env)
-
-    return run
-
-
 class TpuDriver(InterpDriver):
     """Drop-in Driver with device-side batched evaluation.  Inherits state
     management (templates/constraints/store) and render fallback from
@@ -59,7 +42,8 @@ class TpuDriver(InterpDriver):
         self.interner = Interner()
         self.programs: Dict[str, Optional[VProgram]] = {}
         self.pred_cache: Dict[Tuple[str, str], PredicateTable] = {}
-        self._eval_jits: Dict[str, object] = {}
+        self._fused = None
+        self._fused_key = None
         # constraint-side packing is invalidated on any template/constraint
         # mutation and on vocabulary growth (str-pred tables are vocab-sized)
         self._cs_epoch = 0
@@ -70,12 +54,10 @@ class TpuDriver(InterpDriver):
     def put_template(self, kind: str, artifact: CompiledTemplate):
         super().put_template(kind, artifact)
         self.programs[kind] = vectorize(artifact.policy)
-        self._eval_jits.pop(kind, None)
         self._cs_epoch += 1
 
     def delete_template(self, kind: str) -> bool:
         self.programs.pop(kind, None)
-        self._eval_jits.pop(kind, None)
         self._cs_epoch += 1
         return super().delete_template(kind)
 
@@ -90,9 +72,10 @@ class TpuDriver(InterpDriver):
     def reset(self):
         super().reset()
         self.programs.clear()
-        self._eval_jits.clear()
         self._cs_epoch += 1
         self._cs_cache = None
+        self._fused = None
+        self._fused_key = None
 
     # ---- device evaluation ------------------------------------------------
 
@@ -104,9 +87,12 @@ class TpuDriver(InterpDriver):
         return out
 
     def _constraint_side(self):
-        """Cached constraint-side packing: match pack, per-kind param packs,
-        and column-spec union.  Rebuilt when constraints/templates change or
-        the vocabulary has grown (str-pred tables are vocab-indexed)."""
+        """Cached constraint-side packing: match pack + violation-program
+        groups.  Programs are grouped by STRUCTURE, so template clones (the
+        synthetic 500-template config) share one traced subgraph with their
+        constraints batched on the C axis.  Rebuilt when constraints or
+        templates change, or when the vocabulary has grown (str-pred tables
+        are vocab-sized)."""
         ordered = self._ordered_constraints()
         vocab = self.interner.snapshot_size()
         key = (self._cs_epoch, vocab)
@@ -115,69 +101,88 @@ class TpuDriver(InterpDriver):
 
         cp = pack_constraints([c for _k, _n, c in ordered], self.interner)
         specs = {}
-        by_kind: Dict[str, List[int]] = {}
+        by_struct: Dict[str, list] = {}
         for i, (kind, _n, _c) in enumerate(ordered):
-            by_kind.setdefault(kind, []).append(i)
-        kind_params = {}
-        for kind, idxs in by_kind.items():
             prog = self.programs.get(kind)
             if not prog:
                 continue
+            sk = prog.structure_key()
+            by_struct.setdefault(sk, [prog, []])[1].append(i)
+        groups = []
+        for _sk, (prog, idxs) in sorted(by_struct.items()):
             for spec in prog.column_specs:
                 specs[spec.key] = spec
             kcs = [ordered[i][2] for i in idxs]
-            kind_params[kind] = pack_params(
-                kcs, prog, self.interner, self.pred_cache, len(kcs)
-            )
-        side = (ordered, cp, by_kind, kind_params, list(specs.values()))
+            packed = pack_params(kcs, prog, self.interner, self.pred_cache, len(kcs))
+            groups.append((prog, np.asarray(idxs, np.int32), packed))
+        side = (ordered, cp, groups, list(specs.values()))
         # key uses the vocab size BEFORE param packing interned new strings;
         # recompute so the cache stays valid next call
         key = (self._cs_epoch, self.interner.snapshot_size())
         self._cs_cache = (key, side)
         return side
 
-    def compute_masks(self, reviews: List[dict]):
-        """-> (ordered constraints, match&violation candidate mask [C, R],
-        autoreject mask [C, R]) as numpy arrays."""
-        ordered, cp, by_kind, kind_params, col_specs = self._constraint_side()
+    def _fused_fn(self):
+        """One jitted function for the whole sweep: match kernel + every
+        violation-program group, combined into the candidate mask.  ONE
+        dispatch and ONE device->host fetch per evaluation — essential when
+        the device sits behind a network relay (each fetch is an RTT)."""
+        side = self._constraint_side()
+        # Keyed on the epoch only: vocabulary growth re-packs arrays but the
+        # table shapes are bucketed (ops/params.py), so the compiled
+        # executable survives new strings.
+        if self._fused is not None and self._fused_key == self._cs_epoch:
+            return self._fused, side
+        _ordered, _cp, groups, _col_specs = side
+        static = [(prog, idxs) for prog, idxs, _packed in groups]
+
+        def fused(rv, cs, cols, group_params):
+            match, autoreject = match_kernel(rv, cs)
+            mask = match
+            R = match.shape[1]
+            for (prog, idxs), (params, elems, tables) in zip(static, group_params):
+                keysets = {
+                    spec.key: cols[spec.key]["ids"]
+                    for spec in prog.column_specs
+                    if spec.kind == "keyset"
+                }
+                prog_cols = {
+                    spec.key: cols[spec.key]
+                    for spec in prog.column_specs
+                    if spec.kind != "keyset"
+                }
+                env = EvalEnv(
+                    prog_cols, params, elems, tables, keysets, len(idxs), R
+                )
+                vmask = eval_program(prog, env)  # [Ck, R]
+                mask = mask.at[idxs].set(mask[idxs] & vmask)
+            return mask, autoreject
+
+        self._fused = jax.jit(fused)
+        self._fused_key = self._cs_epoch
+        return self._fused, side
+
+    def _device_inputs(self, reviews: List[dict]):
+        """Pack review-side arrays + columns; rebuild the constraint side if
+        these reviews interned new strings (pred tables are vocab-sized)."""
+        fn, side = self._fused_fn()
+        ordered, cp, groups, col_specs = side
         rp = pack_reviews(reviews, self.interner, self.store.cached_namespace)
         rows = len(rp.arrays["valid"])
         cols = extract_columns(reviews, col_specs, self.interner, rows)
         if self.interner.snapshot_size() > self._cs_cache[0][1]:
-            # new strings interned from these reviews: str-pred tables must
-            # cover them, so rebuild the constraint side once
-            ordered, cp, by_kind, kind_params, col_specs = self._constraint_side()
+            fn, side = self._fused_fn()
+            ordered, cp, groups, col_specs = side
+        group_params = [packed for _prog, _idxs, packed in groups]
+        return fn, ordered, rp, cp, cols, group_params
 
-        match, autoreject = _match_jit(rp.arrays, cp.arrays)
-        match = np.asarray(match)
-        autoreject = np.asarray(autoreject)
-
-        mask = match.copy()
-        for kind, idxs in by_kind.items():
-            prog = self.programs.get(kind)
-            if not prog or kind not in kind_params:
-                continue
-            params, elems, tables = kind_params[kind]
-            keysets = {
-                spec.key: cols[spec.key]["ids"]
-                for spec in prog.column_specs
-                if spec.kind == "keyset"
-            }
-            prog_cols = {
-                spec.key: cols[spec.key]
-                for spec in prog.column_specs
-                if spec.kind != "keyset"
-            }
-            fn = self._eval_jits.get(kind)
-            if fn is None:
-                fn = _make_eval_jit(prog)
-                self._eval_jits[kind] = fn
-            vmask = np.asarray(
-                fn(prog_cols, params, elems, tables, keysets, len(idxs), rows)
-            )
-            for j, i in enumerate(idxs):
-                mask[i] &= vmask[j]
-        return ordered, mask, autoreject
+    def compute_masks(self, reviews: List[dict]):
+        """-> (ordered constraints, match&violation candidate mask [C, R],
+        autoreject mask [C, R]) as numpy arrays."""
+        fn, ordered, rp, cp, cols, group_params = self._device_inputs(reviews)
+        mask, autoreject = fn(rp.arrays, cp.arrays, cols, group_params)
+        both = np.asarray(jnp.stack([mask, autoreject]))  # one fetch
+        return ordered, both[0], both[1]
 
     # ---- render (exactness filter) ---------------------------------------
 

@@ -13,7 +13,6 @@ pairs, N/X namespace ids, L label pairs, E match expressions, V values.
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 
 from .pack import (
@@ -28,47 +27,63 @@ from .pack import (
 
 def _selector_match(lab_pairs, cs_ml, cs_op, cs_key, cs_vals, cs_nvals):
     """matches_label_selector over [R, L, 2] labels x [C, ...] selectors
-    -> bool[C, R]."""
+    -> bool[C, R].
+
+    The small static widths (Lc matchLabels pairs, E expressions, V values,
+    L label slots) are unrolled as Python loops so no transient ever exceeds
+    [C, R] — materializing [C, E, V, R, L] broadcasts OOMs at audit scale."""
     lab_key = lab_pairs[:, :, 0]  # [R, L]
     lab_val = lab_pairs[:, :, 1]
     lab_ok = lab_key != PAD  # [R, L]
+    L = lab_key.shape[1]
+
+    def key_val_hit(k, v):  # k,v: [C, 1] -> any label slot matches both
+        acc = jnp.zeros((k.shape[0], lab_key.shape[0]), bool)
+        for l in range(L):
+            acc = acc | (
+                (lab_key[None, :, l] == k) & (lab_val[None, :, l] == v)
+                & lab_ok[None, :, l]
+            )
+        return acc  # [C, R]
+
+    def key_hit(k):  # [C, 1] -> any label slot has this key
+        acc = jnp.zeros((k.shape[0], lab_key.shape[0]), bool)
+        for l in range(L):
+            acc = acc | ((lab_key[None, :, l] == k) & lab_ok[None, :, l])
+        return acc
+
+    C = cs_ml.shape[0]
+    R = lab_key.shape[0]
 
     # matchLabels: every (k, v) pair (non-pad) must be satisfied.
-    mlk = cs_ml[:, :, 0][:, :, None, None]  # [C, Lc, 1, 1]
-    mlv = cs_ml[:, :, 1][:, :, None, None]
-    hit = (
-        (lab_key[None, None, :, :] == mlk)
-        & (lab_val[None, None, :, :] == mlv)
-        & lab_ok[None, None, :, :]
-    )  # [C, Lc, R, L]
-    sat = jnp.any(hit, axis=-1)  # [C, Lc, R]
-    pair_pad = (cs_ml[:, :, 0] == PAD)[:, :, None]  # [C, Lc, 1]
-    ml_ok = jnp.all(sat | pair_pad, axis=1)  # [C, R]
+    ml_ok = jnp.ones((C, R), bool)
+    for i in range(cs_ml.shape[1]):
+        k = cs_ml[:, i, 0][:, None]
+        v = cs_ml[:, i, 1][:, None]
+        sat = key_val_hit(k, v)
+        ml_ok = ml_ok & (sat | (k == PAD))
 
     # matchExpressions
-    key = cs_key[:, :, None, None]  # [C, E, 1, 1]
-    key_hit = (lab_key[None, None, :, :] == key) & lab_ok[None, None, :, :]
-    has = jnp.any(key_hit, axis=-1)  # [C, E, R]
-    vals = cs_vals[:, :, :, None, None]  # [C, E, V, 1, 1]
-    val_hit = key_hit[:, :, None, :, :] & (
-        lab_val[None, None, None, :, :] == vals
-    )  # [C, E, V, R, L]
-    val_in = jnp.any(val_hit, axis=(2, 4))  # [C, E, R]
-    nvals = cs_nvals[:, :, None]  # [C, E, 1]
-    op = cs_op[:, :, None]  # [C, E, 1]
-
-    violated = jnp.where(
-        op == 0, ~has | ((nvals > 0) & ~val_in),  # In
-        jnp.where(
-            op == 1, has & (nvals > 0) & val_in,  # NotIn
+    ex_ok = jnp.ones((C, R), bool)
+    for e in range(cs_op.shape[1]):
+        op = cs_op[:, e][:, None]  # [C, 1]
+        key = cs_key[:, e][:, None]
+        has = key_hit(key)  # [C, R]
+        val_in = jnp.zeros((C, R), bool)
+        for v in range(cs_vals.shape[2]):
+            val_in = val_in | key_val_hit(key, cs_vals[:, e, v][:, None])
+        nvals = cs_nvals[:, e][:, None]
+        violated = jnp.where(
+            op == 0, ~has | ((nvals > 0) & ~val_in),  # In
             jnp.where(
-                op == 2, ~has,  # Exists
-                jnp.where(op == 3, has, False),  # DoesNotExist / unknown
+                op == 1, has & (nvals > 0) & val_in,  # NotIn
+                jnp.where(
+                    op == 2, ~has,  # Exists
+                    jnp.where(op == 3, has, False),  # DoesNotExist / unknown
+                ),
             ),
-        ),
-    )
-    expr_pad = (cs_op == -1)[:, :, None]
-    ex_ok = ~jnp.any(violated & ~expr_pad, axis=1)  # [C, R]
+        )
+        ex_ok = ex_ok & ~(violated & (op != -1))
     return ml_ok & ex_ok
 
 
@@ -95,32 +110,34 @@ def match_kernel(rv: dict, cs: dict):
     group = rv["group"][None, :]  # [1, R]
     kind = rv["kind"][None, :]
 
-    # kind selectors: any (group, kind) pair matches
-    kp_g = cs["kind_pairs"][:, :, 0][:, :, None]  # [C, KP, 1]
-    kp_k = cs["kind_pairs"][:, :, 1][:, :, None]
-    pair_ok = (
-        ((kp_g == WILD) | (kp_g == group[:, None, :]))
-        & ((kp_k == WILD) | (kp_k == kind[:, None, :]))
-        & (kp_g != PAD)
-    )
-    kinds_ok = jnp.any(pair_ok, axis=1)  # [C, R]
+    C = cs["kind_pairs"].shape[0]
+    R = group.shape[1]
 
-    # namespaces / excludedNamespaces
+    # kind selectors: any (group, kind) pair matches (KP unrolled)
+    kinds_ok = jnp.zeros((C, R), bool)
+    for p in range(cs["kind_pairs"].shape[1]):
+        kp_g = cs["kind_pairs"][:, p, 0][:, None]  # [C, 1]
+        kp_k = cs["kind_pairs"][:, p, 1][:, None]
+        kinds_ok = kinds_ok | (
+            ((kp_g == WILD) | (kp_g == group))
+            & ((kp_k == WILD) | (kp_k == kind))
+            & (kp_g != PAD)
+        )
+
+    # namespaces / excludedNamespaces (N unrolled)
     ns_name = rv["ns_name"][None, :]  # [1, R]
     ns_def = ns_name != UNDEF
     always = rv["always"][None, :]
-    member_ns = jnp.any(
-        (cs["ns_ids"][:, :, None] == ns_name[:, None, :])
-        & (cs["ns_ids"][:, :, None] != PAD),
-        axis=1,
-    )
-    ns_ok = ~cs["has_ns"][:, None] | always | (ns_def & member_ns)
-    member_ex = jnp.any(
-        (cs["ex_ids"][:, :, None] == ns_name[:, None, :])
-        & (cs["ex_ids"][:, :, None] != PAD),
-        axis=1,
-    )
-    ex_ok = ~cs["has_ex"][:, None] | always | (ns_def & ~member_ex)
+
+    def member(ids):
+        acc = jnp.zeros((C, R), bool)
+        for i in range(ids.shape[1]):
+            col = ids[:, i][:, None]
+            acc = acc | ((col == ns_name) & (col != PAD))
+        return acc
+
+    ns_ok = ~cs["has_ns"][:, None] | always | (ns_def & member(cs["ns_ids"]))
+    ex_ok = ~cs["has_ex"][:, None] | always | (ns_def & ~member(cs["ex_ids"]))
 
     # scope
     scope = cs["scope"][:, None]  # [C, 1]
@@ -157,6 +174,3 @@ def match_kernel(rv: dict, cs: dict):
     match = kinds_ok & ns_ok & ex_ok & scope_ok & ls_ok & nssel_ok & valid
     autoreject = cs["has_nssel"][:, None] & rv["autoreject"][None, :] & valid
     return match, autoreject
-
-
-match_kernel_jit = jax.jit(match_kernel)

@@ -151,9 +151,11 @@ def pack_params(
         else:
             raise ValueError("unsupported StrPred rhs")
         vocab = interner.snapshot_size()
-        mat = np.zeros((len(stack) + 1, vocab), np.uint8)
+        # bucket both table dims so compiled executables survive vocabulary
+        # growth and new predicate values (shape-stable jit cache)
+        mat = np.zeros((_bucket(len(stack) + 1), _bucket(vocab, 256)), np.uint8)
         for (pred, value), row in stack.items():
-            mat[row] = pred_cache[(pred, value)].dense()[:vocab]
+            mat[row, :vocab] = pred_cache[(pred, value)].dense()[:vocab]
         tables[node.pred_id] = (mat, idx)
 
     return params, elems, tables

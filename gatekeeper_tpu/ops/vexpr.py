@@ -160,6 +160,26 @@ class VProgram:
     literals: List[str] = field(default_factory=list)
     exact: bool = True
 
+    def structure_key(self) -> str:
+        """Template-clone batching key: programs with identical structure
+        (same clauses/columns/param layout, parameters varying per
+        constraint) evaluate together on one constraint axis — so N clones
+        of a template family cost one traced subgraph, not N.  Memoized:
+        the IR is immutable after vectorize()."""
+        key = getattr(self, "_structure_key", None)
+        if key is None:
+            key = repr(
+                (
+                    [(c.conds, c.slot_iter) for c in self.clauses],
+                    sorted(s.key for s in self.column_specs),
+                    self.param_scalars,
+                    self.param_arrays,
+                    self.literals,
+                )
+            )
+            self._structure_key = key
+        return key
+
 
 # ---- evaluation -----------------------------------------------------------
 
@@ -186,18 +206,16 @@ class EvalEnv:
         self.R = R
 
 
-def _operand_arrays(op: Operand, env: EvalEnv, axes: str):
-    """Return dict with tcode/sid/num arrays broadcast to `axes` layout.
-    axes is one of 'CR', 'CRS', 'CPR', 'CPRS' (P present inside AnyParam)."""
-    lead = 2 if "P" in axes else 1  # C(,P) leading broadcast dims for columns
+def _operand_arrays(op: Operand, env: EvalEnv, axes: str, pidx=None):
+    """Return dict with tcode/sid/num arrays broadcast to `axes` layout
+    ('CR' or 'CRS').  Inside an AnyParam unroll, `pidx` selects the current
+    parameter element (ParamElemRef arrays are [C, P])."""
 
     def shape_col(a, slot):
-        # col arrays are [R] or [R, S]; prepend C(,P) dims, append S if needed
-        x = jnp.asarray(a)
+        x = jnp.asarray(a)  # [R] or [R, S]
         if slot and not axes.endswith("S"):
             raise ValueError("slot column outside slot context")
-        for _ in range(lead):
-            x = x[None]
+        x = x[None]
         if not slot and axes.endswith("S"):
             x = x[..., None]
         return x
@@ -209,21 +227,20 @@ def _operand_arrays(op: Operand, env: EvalEnv, axes: str):
         d = env.params[op.ppath]
         out = {}
         for k, v in d.items():
-            x = jnp.asarray(v)  # [C]
-            if "P" in axes:
-                x = x[:, None]  # [C, 1]
-            x = x[..., None]  # broadcast over R
+            x = jnp.asarray(v)[..., None]  # [C, 1]
             if axes.endswith("S"):
                 x = x[..., None]
             out[k] = x
         return out
     if isinstance(op, ParamElemRef):
+        if pidx is None:
+            raise ValueError("ParamElemRef outside AnyParam")
         d = env.elems[(op.ppath, op.subpath)]
         out = {}
         for k, v in d.items():
             if k == "mask":
                 continue
-            x = jnp.asarray(v)[:, :, None]  # [C, P, 1]
+            x = jnp.asarray(v)[:, pidx][:, None]  # [C, 1]
             if axes.endswith("S"):
                 x = x[..., None]
             out[k] = x
@@ -255,32 +272,37 @@ def _operand_arrays(op: Operand, env: EvalEnv, axes: str):
     raise TypeError(op)
 
 
-def _eval_node(node: VNode, env: EvalEnv, axes: str):
+def _eval_node(node: VNode, env: EvalEnv, axes: str, pidx=None):
     if isinstance(node, Const):
         return jnp.asarray(node.value)
     if isinstance(node, Truthy):
-        d = _operand_arrays(node.operand, env, axes)
+        d = _operand_arrays(node.operand, env, axes, pidx)
         truthy = (d["tcode"] != T_UNDEF) & (d["tcode"] != T_FALSE)
         return ~truthy if node.negate else truthy
     if isinstance(node, Cmp):
-        return _eval_cmp(node, env, axes)
+        a = _operand_arrays(node.lhs, env, axes, pidx)
+        b = _operand_arrays(node.rhs, env, axes, pidx)
+        return _cmp_values(a, b, node.op, node.unknown_default)
     if isinstance(node, StrPred):
-        return _eval_strpred(node, env, axes)
+        return _eval_strpred(node, env, axes, pidx)
     if isinstance(node, AnyParam):
-        sub_axes = "CP" + axes[1:]  # insert P after C
-        parts = [_eval_node(n, env, sub_axes) for n in node.inner]
+        # unroll the parameter axis: peak transient stays at [C, R(, S)]
         mask = jnp.asarray(env.elems[(node.ppath, ())]["mask"])  # [C, P]
-        m = mask[:, :, None]
-        if axes.endswith("S"):
-            m = m[..., None]
-        acc = m
-        for p in parts:
-            acc = acc & p
-        return jnp.any(acc, axis=1)
+        P = mask.shape[1]
+        acc = None
+        for p in range(P):
+            m = mask[:, p][:, None]
+            if axes.endswith("S"):
+                m = m[..., None]
+            part = m
+            for n in node.inner:
+                part = part & _eval_node(n, env, axes, pidx=p)
+            acc = part if acc is None else (acc | part)
+        return acc if acc is not None else jnp.asarray(False)
     if isinstance(node, SetCountCmp):
         return _eval_setcount(node, env, axes)
     if isinstance(node, BoolOp):
-        parts = [_eval_node(c, env, axes) for c in node.children]
+        parts = [_eval_node(c, env, axes, pidx) for c in node.children]
         if node.op == "not":
             return ~parts[0]
         acc = parts[0]
@@ -290,15 +312,10 @@ def _eval_node(node: VNode, env: EvalEnv, axes: str):
     if isinstance(node, ReduceSlots):
         if axes.endswith("S"):
             raise ValueError("nested slot reduction is not supported")
-        sub_axes = axes + "S"
         mask = _slot_mask(env, node.iter_key)  # [R, S]
-        m = mask[None]
-        if "P" in axes:
-            m = m[None]
-            m = jnp.moveaxis(m, 0, 0)  # [1, 1, R, S]
-        acc = m
+        acc = mask[None]
         for n in node.inner:
-            acc = acc & _eval_node(n, env, sub_axes)
+            acc = acc & _eval_node(n, env, axes + "S", pidx)
         return jnp.any(acc, axis=-1)
     if isinstance(node, AnySlots):
         raise ValueError("AnySlots must be handled at clause level")
@@ -351,24 +368,17 @@ def _RANK_LOOKUP(tcode):
     return jnp.asarray(_RANK)[jnp.clip(tcode, 0, 6)]
 
 
-def _eval_cmp(node: Cmp, env: EvalEnv, axes: str):
-    a = _operand_arrays(node.lhs, env, axes)
-    b = _operand_arrays(node.rhs, env, axes)
-    return _cmp_values(a, b, node.op, node.unknown_default)
-
-
-def _eval_strpred(node: StrPred, env: EvalEnv, axes: str):
+def _eval_strpred(node: StrPred, env: EvalEnv, axes: str, pidx=None):
     table, idx = env.tables[node.pred_id]  # [U, vocab], [C] or [C, P]
-    d = _operand_arrays(node.operand, env, axes)
+    d = _operand_arrays(node.operand, env, axes, pidx)
     sid = d["sid"]
     is_str = d["tcode"] == T_STR
     idx = jnp.asarray(idx)
-    if idx.ndim == 1:  # per-constraint
-        idx_b = idx[:, None]
-        if "P" in axes:
-            idx_b = idx_b[:, None]
-    else:  # [C, P]
-        idx_b = idx[:, :, None]
+    if idx.ndim == 2:  # per param element
+        if pidx is None:
+            raise ValueError("per-element StrPred outside AnyParam")
+        idx = idx[:, pidx]
+    idx_b = idx[:, None]  # [C, 1]
     if axes.endswith("S"):
         idx_b = idx_b[..., None]
     table = jnp.asarray(table)
@@ -393,21 +403,29 @@ def _eval_setcount(node: SetCountCmp, env: EvalEnv, axes: str):
     lids, lmask, lax = side(node.left)
     rids, rmask, rax = side(node.right)
 
+    # Count elements of `left` missing from `right`, with the small static
+    # widths (P param elements, K keyset slots) unrolled so transients stay
+    # at [C, R].
     if lax == "C" and rax == "R":
-        # count over P of params not present in the keyset  -> [C, R]
-        inr = jnp.any(
-            (lids[:, :, None, None] == rids[None, None, :, :])
-            & rmask[None, None, :, :],
-            axis=3,
-        )  # [C, P, R]
-        cnt = jnp.sum(lmask[:, :, None] & ~inr, axis=1)  # [C, R]
+        C, P = lids.shape
+        R, K = rids.shape
+        cnt = jnp.zeros((C, R), jnp.int32)
+        for p in range(P):
+            lid = lids[:, p][:, None]  # [C, 1]
+            inr = jnp.zeros((C, R), bool)
+            for k in range(K):
+                inr = inr | ((lid == rids[None, :, k]) & rmask[None, :, k])
+            cnt = cnt + (lmask[:, p][:, None] & ~inr)
     elif lax == "R" and rax == "C":
-        inr = jnp.any(
-            (lids[None, :, :, None] == rids[:, None, None, :])
-            & rmask[:, None, None, :],
-            axis=3,
-        )  # [C, R, K]
-        cnt = jnp.sum(lmask[None, :, :] & ~inr, axis=2)  # [C, R]
+        R, K = lids.shape
+        C, P = rids.shape
+        cnt = jnp.zeros((C, R), jnp.int32)
+        for k in range(K):
+            lid = lids[None, :, k]  # [1, R]
+            inr = jnp.zeros((C, R), bool)
+            for p in range(P):
+                inr = inr | ((lid == rids[:, p][:, None]) & rmask[:, p][:, None])
+            cnt = cnt + (lmask[None, :, k] & ~inr)
     else:
         raise ValueError("unsupported SetCountCmp side combination")
 

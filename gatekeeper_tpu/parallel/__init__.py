@@ -1,0 +1,1 @@
+from .mesh import audit_mesh, sharded_masks, shardings_for  # noqa: F401

@@ -1,0 +1,92 @@
+"""Multi-chip scaling: shard the resource axis over a device mesh.
+
+The audit sweep is data-parallel over resources (SURVEY.md section 2.4): the
+review-side arrays (leading dim R) shard across the mesh's "data" axis over
+ICI, the constraint-side arrays replicate, and the [C, R] masks come back
+sharded on R.  XLA inserts any collectives; per-constraint reductions
+(violation counts) become psums over the data axis.
+
+This is the framework's distributed backend — the analogue of what the
+reference simply lacks (its audit is one goroutine; multi-pod scale-out is
+independent re-evaluation, pkg/controller/constraintstatus).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def audit_mesh(n_devices: Optional[int] = None) -> Mesh:
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    if len(devs) < n:
+        raise RuntimeError(f"need {n} devices, have {len(devs)}")
+    return Mesh(np.array(devs[:n]), ("data",))
+
+
+def shardings_for(mesh: Mesh, rows: int, tree):
+    """Pytree of NamedShardings: arrays whose leading dim == rows shard on
+    "data"; everything else replicates."""
+    repl = NamedSharding(mesh, P())
+
+    def pick(x):
+        if hasattr(x, "shape") and x.ndim >= 1 and x.shape[0] == rows:
+            return NamedSharding(mesh, P("data", *([None] * (x.ndim - 1))))
+        return repl
+
+    return jax.tree_util.tree_map(pick, tree)
+
+
+def sharded_masks(driver, reviews, mesh: Mesh):
+    """compute_masks, sharded over the mesh: the full evaluation step (match
+    kernel + all violation-program groups) jitted once over the mesh with
+    the resource axis partitioned.  Returns (ordered, mask, autoreject) like
+    TpuDriver.compute_masks."""
+    fn, ordered, rp, cp, cols, group_params = driver._device_inputs(reviews)
+    rows = len(rp.arrays["valid"])
+    if rows % mesh.devices.size != 0:
+        raise ValueError(
+            f"row bucket {rows} not divisible by mesh size {mesh.devices.size}"
+        )
+    args = (rp.arrays, cp.arrays, cols, group_params)
+    in_sh = shardings_for(mesh, rows, args)
+    out_sh = (
+        NamedSharding(mesh, P(None, "data")),
+        NamedSharding(mesh, P(None, "data")),
+    )
+    # fn is the driver's cached jitted callable; re-jit its wrapped function
+    # with explicit shardings under the mesh.
+    raw = fn.__wrapped__
+    sharded = jax.jit(raw, in_shardings=in_sh, out_shardings=out_sh)
+    with mesh:
+        mask, autoreject = sharded(*args)
+    both = np.asarray(jax.device_get((mask, autoreject)))
+    return ordered, both[0], both[1]
+
+
+def sharded_violation_counts(driver, reviews, mesh: Mesh):
+    """Per-constraint violation counts with the reduction on-device:
+    sum over the sharded R axis (an XLA psum over ICI) so only [C] ints
+    cross back to the host."""
+    fn, ordered, rp, cp, cols, group_params = driver._device_inputs(reviews)
+    rows = len(rp.arrays["valid"])
+    args = (rp.arrays, cp.arrays, cols, group_params)
+    in_sh = shardings_for(mesh, rows, args)
+    raw = fn.__wrapped__
+
+    def counted(rv, cs, c, gp):
+        mask, autoreject = raw(rv, cs, c, gp)
+        return mask.sum(axis=1), autoreject.sum(axis=1)
+
+    sharded = jax.jit(
+        counted,
+        in_shardings=in_sh,
+        out_shardings=(NamedSharding(mesh, P()), NamedSharding(mesh, P())),
+    )
+    with mesh:
+        counts, rejects = sharded(*args)
+    return ordered, np.asarray(counts), np.asarray(rejects)

@@ -1,0 +1,269 @@
+"""Synthetic benchmark workloads.
+
+Generates ConstraintTemplates across the policy families that dominate real
+Gatekeeper deployments (label requirements, privileged/host flags, port
+ranges, image-prefix allowlists, field-key allowlists — the same families as
+the reference's PSP/demo corpus, with original Rego), plus synthetic cluster
+resources with a controlled violation rate.  Used by bench.py and
+__graft_entry__.py; mirrors the BASELINE.md synthetic config
+(500 templates x 100k resources).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Tuple
+
+FAMILIES = [
+    # (name stem, rego builder, params builder)
+    "labelreq",
+    "privflag",
+    "hostflags",
+    "portrange",
+    "imageprefix",
+    "fieldkeys",
+]
+
+
+def _rego_labelreq(pkg: str) -> str:
+    return f"""
+package {pkg}
+
+violation[{{"msg": msg, "details": {{"missing": missing}}}}] {{
+  have := {{k | input.review.object.metadata.labels[k]}}
+  want := {{k | k := input.parameters.required[_]}}
+  missing := want - have
+  count(missing) > 0
+  msg := sprintf("missing required labels: %v", [missing])
+}}
+"""
+
+
+def _rego_privflag(pkg: str) -> str:
+    return f"""
+package {pkg}
+
+workloads[c] {{
+  c := input.review.object.spec.containers[_]
+}}
+
+workloads[c] {{
+  c := input.review.object.spec.initContainers[_]
+}}
+
+violation[{{"msg": msg}}] {{
+  c := workloads[_]
+  c.securityContext.privileged
+  msg := sprintf("privileged container forbidden: %v", [c.name])
+}}
+"""
+
+
+def _rego_hostflags(pkg: str) -> str:
+    return f"""
+package {pkg}
+
+uses_host_namespace(o) {{
+  o.spec.hostPID
+}}
+
+uses_host_namespace(o) {{
+  o.spec.hostIPC
+}}
+
+violation[{{"msg": msg}}] {{
+  uses_host_namespace(input.review.object)
+  msg := sprintf("host namespaces forbidden: %v", [input.review.object.metadata.name])
+}}
+"""
+
+
+def _rego_portrange(pkg: str) -> str:
+    return f"""
+package {pkg}
+
+bad_port(o) {{
+  p := o.spec.containers[_].ports[_].hostPort
+  p < input.parameters.low
+}}
+
+bad_port(o) {{
+  p := o.spec.containers[_].ports[_].hostPort
+  p > input.parameters.high
+}}
+
+violation[{{"msg": msg}}] {{
+  bad_port(input.review.object)
+  msg := sprintf("hostPort outside allowed range [%v, %v]", [input.parameters.low, input.parameters.high])
+}}
+"""
+
+
+def _rego_imageprefix(pkg: str) -> str:
+    return f"""
+package {pkg}
+
+violation[{{"msg": msg}}] {{
+  c := input.review.object.spec.containers[_]
+  ok := [hit | p = input.parameters.prefixes[_]; hit = startswith(c.image, p)]
+  not any(ok)
+  msg := sprintf("image %v not from an allowed registry %v", [c.image, input.parameters.prefixes])
+}}
+"""
+
+
+def _rego_fieldkeys(pkg: str) -> str:
+    return f"""
+package {pkg}
+
+allowed(fields) {{
+  input.parameters.kinds[_] == "*"
+}}
+
+allowed(fields) {{
+  allow := {{k | k = input.parameters.kinds[_]}}
+  extra := fields - allow
+  count(extra) == 0
+}}
+
+violation[{{"msg": msg}}] {{
+  fields := {{k | input.review.object.spec.volumes[_][k]; k != "name"}}
+  not allowed(fields)
+  msg := sprintf("volume types %v not allowed", [fields])
+}}
+"""
+
+
+_REGO = {
+    "labelreq": _rego_labelreq,
+    "privflag": _rego_privflag,
+    "hostflags": _rego_hostflags,
+    "portrange": _rego_portrange,
+    "imageprefix": _rego_imageprefix,
+    "fieldkeys": _rego_fieldkeys,
+}
+
+
+def _params(family: str, rng: random.Random) -> dict:
+    # Compliant resources must satisfy every constraint clone (real clusters
+    # converge to compliance), so allowlists always contain the values the
+    # good pods use.
+    if family == "labelreq":
+        return {"required": rng.sample(["owner", "team", "env", "cost", "tier"], 2)}
+    if family == "portrange":
+        return {"low": rng.choice([1, 80, 100]), "high": rng.choice([30000, 60000])}
+    if family == "imageprefix":
+        return {"prefixes": ["registry.corp/"] + rng.sample(
+            ["gcr.io/prod/", "docker.io/library/", "quay.io/app/"], 2
+        )}
+    if family == "fieldkeys":
+        return {"kinds": ["emptyDir"] + rng.sample(
+            ["configMap", "secret", "projected"], 2
+        )}
+    return {}
+
+
+def make_templates(n: int, seed: int = 0) -> Tuple[List[dict], List[dict]]:
+    """n templates cycling the families (each its own CRD kind) + one
+    constraint per template."""
+    rng = random.Random(seed)
+    templates, constraints = [], []
+    for i in range(n):
+        family = FAMILIES[i % len(FAMILIES)]
+        kind = f"Bench{family.capitalize()}{i}"
+        pkg = f"bench{family}{i}"
+        templates.append(
+            {
+                "apiVersion": "templates.gatekeeper.sh/v1beta1",
+                "kind": "ConstraintTemplate",
+                "metadata": {"name": kind.lower()},
+                "spec": {
+                    "crd": {"spec": {"names": {"kind": kind}}},
+                    "targets": [
+                        {
+                            "target": "admission.k8s.gatekeeper.sh",
+                            "rego": _REGO[family](pkg),
+                        }
+                    ],
+                },
+            }
+        )
+        constraints.append(
+            {
+                "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+                "kind": kind,
+                "metadata": {"name": f"c-{kind.lower()}"},
+                "spec": {
+                    "match": {"kinds": [{"apiGroups": [""], "kinds": ["Pod"]}]},
+                    "parameters": _params(family, rng),
+                },
+            }
+        )
+    return templates, constraints
+
+
+def make_pods(n: int, seed: int = 1, violation_rate: float = 0.05) -> List[dict]:
+    """Synthetic Pods; ~violation_rate of them trip at least one family."""
+    rng = random.Random(seed)
+    pods = []
+    for i in range(n):
+        bad = rng.random() < violation_rate
+        containers = []
+        for j in range(rng.randint(1, 3)):
+            ctr = {
+                "name": f"app-{j}",
+                "image": (
+                    "evil.io/x:latest"
+                    if bad and rng.random() < 0.5
+                    else "registry.corp/svc:" + str(rng.randint(1, 40))
+                ),
+            }
+            if bad and rng.random() < 0.3:
+                ctr["securityContext"] = {"privileged": True}
+            if rng.random() < 0.3:
+                ctr["ports"] = [
+                    {"hostPort": 31337 if bad and rng.random() < 0.5 else 8080}
+                ]
+            containers.append(ctr)
+        spec: Dict = {"containers": containers}
+        if bad and rng.random() < 0.2:
+            spec["hostPID"] = True
+        if rng.random() < 0.3:
+            spec["volumes"] = [
+                {"name": "v0",
+                 ("nfs" if bad and rng.random() < 0.4 else "emptyDir"): {}}
+            ]
+        labels = {"owner": "core", "team": "plat", "env": "prod",
+                  "cost": "cc1", "tier": "t1"}
+        if bad and rng.random() < 0.4:
+            labels.pop(rng.choice(list(labels)))
+        pods.append(
+            {
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "metadata": {
+                    "name": f"pod-{i}",
+                    "namespace": f"ns-{i % 50}",
+                    "labels": labels,
+                },
+                "spec": spec,
+            }
+        )
+    return pods
+
+
+def build_driver(n_templates: int, n_resources: int, seed: int = 0):
+    """A TpuDriver loaded with the synthetic workload (via the Client so all
+    validation paths run)."""
+    from ..client.client import Client
+    from ..ops.driver import TpuDriver
+
+    templates, constraints = make_templates(n_templates, seed)
+    client = Client(driver=TpuDriver())
+    for t in templates:
+        client.add_template(t)
+    for c in constraints:
+        client.add_constraint(c)
+    for p in make_pods(n_resources, seed + 1):
+        client.add_data(p)
+    return client
