@@ -35,7 +35,7 @@ def main():
     log(f"devices: {jax.devices()}")
 
     from gatekeeper_tpu.engine.value import thaw
-    from gatekeeper_tpu.utils.synthetic import build_driver, make_pods, make_templates
+    from gatekeeper_tpu.util.synthetic import build_driver, make_pods, make_templates
 
     t0 = time.time()
     client = build_driver(n_templates, n_resources)

@@ -43,6 +43,9 @@ class WatchEvent:
     object: dict
 
 
+_STOP = object()  # sentinel enqueued by Watcher.stop()
+
+
 class Conflict(Exception):
     pass
 
@@ -153,8 +156,9 @@ class InMemoryKube:
                 pass
 
     def _notify(self, gvk: GVK, event: WatchEvent):
+        # each watcher gets its own copy: consumers may mutate the object
         for q in self._watchers.get(gvk, []):
-            q.put(event)
+            q.put(WatchEvent(event.type, copy.deepcopy(event.object)))
 
 
 class Watcher:
@@ -165,12 +169,16 @@ class Watcher:
         self._stopped = False
 
     def next(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
+        if self._stopped:
+            return None
         try:
-            return self.queue.get(timeout=timeout)
+            ev = self.queue.get(timeout=timeout)
         except queue.Empty:
             return None
+        return None if ev is _STOP else ev
 
     def stop(self):
         if not self._stopped:
             self._stopped = True
             self.kube._unwatch(self.gvk, self.queue)
+            self.queue.put(_STOP)  # unblock a consumer parked in next()

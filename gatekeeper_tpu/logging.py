@@ -1,0 +1,72 @@
+"""Structured logging with the reference's stable keys
+(pkg/logging/logging.go:3-22) over stdlib logging, JSON-rendered.
+
+Violation/deny events from the webhook and audit manager log through
+`log_event` with these keys so downstream tooling can parse them the same
+way it parses the reference's zap output.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import time
+
+# logging.go:3-22 — stable structured keys
+PROCESS = "process"
+DETAILS = "details"
+EVENT_TYPE = "event_type"
+TEMPLATE_NAME = "template_name"
+CONSTRAINT_GROUP = "constraint_group"
+CONSTRAINT_API_VERSION = "constraint_api_version"
+CONSTRAINT_KIND = "constraint_kind"
+CONSTRAINT_NAME = "constraint_name"
+CONSTRAINT_NAMESPACE = "constraint_namespace"
+CONSTRAINT_ACTION = "constraint_action"
+AUDIT_ID = "audit_id"
+CONSTRAINT_STATUS = "constraint_status"
+RESOURCE_GROUP = "resource_group"
+RESOURCE_API_VERSION = "resource_api_version"
+RESOURCE_KIND = "resource_kind"
+RESOURCE_NAMESPACE = "resource_namespace"
+RESOURCE_NAME = "resource_name"
+REQUEST_USERNAME = "request_username"
+
+
+class JsonFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        out = {
+            "level": record.levelname.lower(),
+            "ts": time.time(),
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        extra = getattr(record, "kv", None)
+        if extra:
+            out.update(extra)
+        if record.exc_info:
+            out["error"] = self.formatException(record.exc_info)
+        return json.dumps(out, default=str)
+
+
+def setup(level: str = "INFO", stream=None) -> logging.Logger:
+    """Process-wide JSON logger (the reference's zap setup, main.go:121-136)."""
+    root = logging.getLogger("gatekeeper")
+    root.setLevel(level.upper())
+    if not root.handlers:
+        h = logging.StreamHandler(stream or sys.stderr)
+        h.setFormatter(JsonFormatter())
+        root.addHandler(h)
+        root.propagate = False
+    return root
+
+
+def get(name: str) -> logging.Logger:
+    return logging.getLogger(f"gatekeeper.{name}")
+
+
+def log_event(logger: logging.Logger, msg: str, level: int = logging.INFO, **kv):
+    """Structured log line with stable keys (e.g. violation_audited,
+    admission deny — reference policy.go:241-257, audit/manager.go:732-750)."""
+    logger.log(level, msg, extra={"kv": kv})

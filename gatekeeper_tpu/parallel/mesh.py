@@ -28,17 +28,30 @@ def audit_mesh(n_devices: Optional[int] = None) -> Mesh:
     return Mesh(np.array(devs[:n]), ("data",))
 
 
-def shardings_for(mesh: Mesh, rows: int, tree):
-    """Pytree of NamedShardings: arrays whose leading dim == rows shard on
-    "data"; everything else replicates."""
+def shardings_for(mesh: Mesh, rows: int, args):
+    """Shardings for the fused-fn argument tuple
+    (review_arrays, constraint_arrays, cols, group_params): sharding is
+    decided BY POSITION — only the review-side trees (args 0 and 2) shard
+    their row-major arrays on "data"; the constraint side (args 1 and 3)
+    replicates unconditionally, so a constraint-side array whose bucketed
+    leading dim coincides with the row bucket can never be mis-sharded."""
     repl = NamedSharding(mesh, P())
 
-    def pick(x):
+    def row_sharded(x):
         if hasattr(x, "shape") and x.ndim >= 1 and x.shape[0] == rows:
             return NamedSharding(mesh, P("data", *([None] * (x.ndim - 1))))
+        return repl  # e.g. vocab-sized keyset id tables
+
+    def replicated(_x):
         return repl
 
-    return jax.tree_util.tree_map(pick, tree)
+    rv, cs, cols, group_params = args
+    return (
+        jax.tree_util.tree_map(row_sharded, rv),
+        jax.tree_util.tree_map(replicated, cs),
+        jax.tree_util.tree_map(row_sharded, cols),
+        jax.tree_util.tree_map(replicated, group_params),
+    )
 
 
 def sharded_masks(driver, reviews, mesh: Mesh):

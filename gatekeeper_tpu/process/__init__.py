@@ -1,0 +1,1 @@
+from .excluder import AUDIT, STAR, SYNC, WEBHOOK, Excluder  # noqa: F401

@@ -1,0 +1,94 @@
+"""Shared small utilities (reference pkg/util/).
+
+- enforcement-action enum + validation (enforcement_action.go:11-47)
+- GVK packing of reconcile requests for type-erased controllers (pack.go:17-57)
+- pod identity from env (pod_info.go:5-21)
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Tuple
+
+DENY = "deny"
+DRYRUN = "dryrun"
+UNRECOGNIZED = "unrecognized"
+
+SUPPORTED_ENFORCEMENT_ACTIONS = (DENY, DRYRUN)
+KNOWN_ENFORCEMENT_ACTIONS = (DENY, DRYRUN, UNRECOGNIZED)
+
+
+class EnforcementActionError(ValueError):
+    pass
+
+
+def validate_enforcement_action(action: str) -> None:
+    """enforcement_action.go:20-27: only deny/dryrun are supported."""
+    if action not in SUPPORTED_ENFORCEMENT_ACTIONS:
+        raise EnforcementActionError(
+            f"could not find the provided enforcementAction value within the "
+            f"supported list {list(SUPPORTED_ENFORCEMENT_ACTIONS)}"
+        )
+
+
+def get_enforcement_action(constraint: dict) -> str:
+    """enforcement_action.go:29-46: default deny; anything unsupported is
+    classified as 'unrecognized' (never an error)."""
+    spec = constraint.get("spec") or {}
+    action = spec.get("enforcementAction") or DENY
+    if not isinstance(action, str):
+        return UNRECOGNIZED
+    if action not in SUPPORTED_ENFORCEMENT_ACTIONS:
+        return UNRECOGNIZED
+    return action
+
+
+# ---- request packing (pack.go) -------------------------------------------
+#
+# Dynamic (type-erased) controllers receive events for many GVKs over one
+# queue; the GVK rides inside the request name as "gvk:Kind.Version.Group:Name".
+
+
+def pack_request(gvk: Tuple[str, str, str], name: str, namespace: str = "") -> Tuple[str, str]:
+    """EventPacker.Map (pack.go:33-57) -> (packed_name, namespace)."""
+    group, version, kind = gvk
+    version = version or "v1"
+    encoded = f"{kind}.{version}.{group}"
+    return f"gvk:{encoded}:{name}", namespace
+
+
+def unpack_request(packed_name: str, namespace: str = ""):
+    """UnpackRequest (pack.go:17-31) -> (gvk, name, namespace)."""
+    fields = packed_name.split(":", 2)
+    if len(fields) != 3 or fields[0] != "gvk":
+        raise ValueError(f"invalid packed name: {packed_name}")
+    parts = fields[1].split(".", 2)
+    if len(parts) != 3:
+        raise ValueError(f"unable to parse gvk: {fields[1]}")
+    kind, version, group = parts
+    return (group, version, kind), fields[2], namespace
+
+
+# ---- pod identity (pod_info.go) ------------------------------------------
+
+
+def get_pod_name() -> str:
+    return os.environ.get("POD_NAME", "")
+
+
+def get_id() -> str:
+    return get_pod_name()
+
+
+def get_namespace() -> str:
+    return os.environ.get("POD_NAMESPACE", "gatekeeper-system")
+
+
+def nested_get(obj: Any, *path: str, default: Any = None) -> Any:
+    """unstructured.Nested* analogue: walk dict path, default on miss."""
+    node = obj
+    for seg in path:
+        if not isinstance(node, dict) or seg not in node:
+            return default
+        node = node[seg]
+    return node

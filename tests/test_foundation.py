@@ -1,0 +1,215 @@
+"""Foundation-layer tests: util, apis/config, apis/status, operations,
+process excluder, logging (reference parity: pkg/util, apis/, pkg/operations,
+pkg/controller/config/process)."""
+
+import io
+import json
+
+import pytest
+
+from gatekeeper_tpu import operations, util
+from gatekeeper_tpu import logging as gklog
+from gatekeeper_tpu.apis import status as status_api
+from gatekeeper_tpu.apis.config import parse_config
+from gatekeeper_tpu.process.excluder import Excluder
+
+
+class TestEnforcementAction:
+    def test_default_deny(self):
+        assert util.get_enforcement_action({"spec": {}}) == "deny"
+        assert util.get_enforcement_action({}) == "deny"
+
+    def test_dryrun(self):
+        assert util.get_enforcement_action({"spec": {"enforcementAction": "dryrun"}}) == "dryrun"
+
+    def test_unrecognized(self):
+        # reference enforcement_action.go:40-43: unsupported -> unrecognized
+        assert (
+            util.get_enforcement_action({"spec": {"enforcementAction": "warn"}})
+            == "unrecognized"
+        )
+
+    def test_validate_rejects(self):
+        with pytest.raises(util.EnforcementActionError):
+            util.validate_enforcement_action("unrecognized")
+        util.validate_enforcement_action("deny")
+
+
+class TestRequestPacking:
+    def test_roundtrip(self):
+        gvk = ("constraints.gatekeeper.sh", "v1beta1", "K8sRequiredLabels")
+        packed, ns = util.pack_request(gvk, "my-constraint", "")
+        got_gvk, name, namespace = util.unpack_request(packed, ns)
+        assert got_gvk == gvk
+        assert name == "my-constraint"
+        assert namespace == ""
+
+    def test_empty_version_defaults_v1(self):
+        packed, _ = util.pack_request(("", "", "Namespace"), "ns1")
+        gvk, name, _ = util.unpack_request(packed)
+        assert gvk == ("", "v1", "Namespace")
+
+    def test_name_with_colons(self):
+        packed, _ = util.pack_request(("g", "v1", "K"), "a:b:c")
+        _, name, _ = util.unpack_request(packed)
+        assert name == "a:b:c"
+
+    def test_invalid(self):
+        with pytest.raises(ValueError):
+            util.unpack_request("notgvk:x:y")
+
+
+class TestDashPacking:
+    def test_roundtrip(self):
+        packed = status_api.dash_pack("pod-1", "k8srequiredlabels", "ns-must-have-gk")
+        assert status_api.dash_unpack(packed) == [
+            "pod-1",
+            "k8srequiredlabels",
+            "ns-must-have-gk",
+        ]
+
+    def test_escaping(self):
+        # util.go:55-91 semantics: '-' doubles inside tokens
+        assert status_api.dash_pack("a-b", "c") == "a--b-c"
+        assert status_api.dash_unpack("a--b-c") == ["a-b", "c"]
+
+    def test_rejects_empty_and_edge_dash(self):
+        with pytest.raises(status_api.KeyError_):
+            status_api.dash_pack("")
+        with pytest.raises(status_api.KeyError_):
+            status_api.dash_pack("-leading")
+        with pytest.raises(status_api.KeyError_):
+            status_api.dash_pack("trailing-")
+
+    def test_key_for_constraint(self):
+        c = {"kind": "K8sRequiredLabels", "metadata": {"name": "must-have"}}
+        key = status_api.key_for_constraint("pod-abc", c)
+        assert status_api.dash_unpack(key) == ["pod-abc", "k8srequiredlabels", "must-have"]
+
+
+class TestStatusObjects:
+    def test_constraint_status(self):
+        c = {
+            "kind": "K8sRequiredLabels",
+            "metadata": {"name": "must-have", "uid": "u1", "generation": 3},
+        }
+        obj = status_api.new_constraint_status_for_pod("pod-1", "gatekeeper-system", c, ["audit"])
+        assert obj["metadata"]["labels"][status_api.CONSTRAINT_KIND_LABEL] == "K8sRequiredLabels"
+        assert obj["metadata"]["labels"][status_api.POD_LABEL] == "pod-1"
+        assert obj["status"]["constraintUID"] == "u1"
+        assert obj["status"]["observedGeneration"] == 3
+
+    def test_template_status(self):
+        t = {"metadata": {"name": "k8srequiredlabels", "uid": "u2"}}
+        obj = status_api.new_template_status_for_pod("pod-1", "gatekeeper-system", t, ["audit", "webhook"])
+        assert obj["metadata"]["name"] == status_api.key_for_template("pod-1", "k8srequiredlabels")
+        assert obj["status"]["templateUID"] == "u2"
+
+
+class TestConfigParsing:
+    def test_full(self):
+        cfg = parse_config(
+            {
+                "spec": {
+                    "sync": {"syncOnly": [{"group": "", "version": "v1", "kind": "Pod"}]},
+                    "validation": {
+                        "traces": [
+                            {
+                                "user": "alice",
+                                "kind": {"group": "", "version": "v1", "kind": "Namespace"},
+                                "dump": "All",
+                            }
+                        ]
+                    },
+                    "match": [
+                        {"excludedNamespaces": ["kube-system"], "processes": ["*"]}
+                    ],
+                    "readiness": {"statsEnabled": True},
+                }
+            }
+        )
+        assert cfg.sync_only[0].gvk() == ("", "v1", "Pod")
+        assert cfg.traces[0].user == "alice"
+        assert cfg.traces[0].dump == "All"
+        assert cfg.match[0].excluded_namespaces == ["kube-system"]
+        assert cfg.readiness_stats_enabled
+
+    def test_empty(self):
+        cfg = parse_config(None)
+        assert cfg.sync_only == [] and cfg.traces == [] and cfg.match == []
+
+
+class TestOperations:
+    def test_default_all(self):
+        ops = operations.Operations()
+        for op in operations.ALL_OPERATIONS:
+            assert ops.is_assigned(op)
+        assert ops.assigned_string_list() == ["audit", "status", "webhook"]
+
+    def test_subset(self):
+        ops = operations.Operations(["audit"])
+        assert ops.is_assigned("audit")
+        assert not ops.is_assigned("webhook")
+        assert ops.assigned_string_list() == ["audit"]
+
+    def test_unknown_rejected(self):
+        with pytest.raises(operations.OperationError):
+            operations.Operations(["bogus"])
+
+
+class TestExcluder:
+    def _entries(self, raw):
+        from gatekeeper_tpu.apis.config import parse_config
+
+        return parse_config({"spec": {"match": raw}}).match
+
+    def test_star_expands(self):
+        ex = Excluder()
+        ex.add(self._entries([{"excludedNamespaces": ["kube-system"], "processes": ["*"]}]))
+        for p in ("audit", "webhook", "sync"):
+            assert ex.is_namespace_excluded(p, "kube-system")
+        assert not ex.is_namespace_excluded("audit", "default")
+
+    def test_per_process(self):
+        ex = Excluder()
+        ex.add(self._entries([{"excludedNamespaces": ["payments"], "processes": ["audit"]}]))
+        assert ex.is_namespace_excluded("audit", "payments")
+        assert not ex.is_namespace_excluded("webhook", "payments")
+
+    def test_replace_and_equals(self):
+        a, b = Excluder(), Excluder()
+        b.add(self._entries([{"excludedNamespaces": ["x"], "processes": ["sync"]}]))
+        assert not a.equals(b)
+        a.replace(b)
+        assert a.equals(b)
+        assert a.is_namespace_excluded("sync", "x")
+
+
+class TestLogging:
+    def test_json_lines_with_stable_keys(self):
+        buf = io.StringIO()
+        import logging as pylog
+
+        logger = pylog.getLogger("gatekeeper.test")
+        logger.setLevel("INFO")
+        h = pylog.StreamHandler(buf)
+        h.setFormatter(gklog.JsonFormatter())
+        logger.addHandler(h)
+        logger.propagate = False
+        try:
+            gklog.log_event(
+                logger,
+                "denied admission",
+                **{
+                    gklog.PROCESS: "admission",
+                    gklog.EVENT_TYPE: "violation",
+                    gklog.CONSTRAINT_KIND: "K8sRequiredLabels",
+                    gklog.RESOURCE_NAME: "ns1",
+                },
+            )
+        finally:
+            logger.removeHandler(h)
+        line = json.loads(buf.getvalue())
+        assert line["msg"] == "denied admission"
+        assert line["process"] == "admission"
+        assert line["constraint_kind"] == "K8sRequiredLabels"
