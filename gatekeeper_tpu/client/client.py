@@ -107,10 +107,16 @@ class Client:
 
     def remove_template(self, template: dict) -> bool:
         tmpl = ConstraintTemplate.from_dict(template)
-        self._templates.pop(tmpl.kind, None)
-        self._crds.pop(tmpl.kind, None)
-        self._semantic.pop(tmpl.kind, None)
-        return self.driver.delete_template(tmpl.kind)
+        return self.remove_template_by_kind(tmpl.kind)
+
+    def remove_template_by_kind(self, kind: str) -> bool:
+        """Removal path for controllers that only hold a tombstone (the
+        reference deletes by looking up the cached unversioned template,
+        constrainttemplate_controller.go:281-301)."""
+        self._templates.pop(kind, None)
+        self._crds.pop(kind, None)
+        self._semantic.pop(kind, None)
+        return self.driver.delete_template(kind)
 
     def _compile_template(self, template: dict):
         try:

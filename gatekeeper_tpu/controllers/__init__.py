@@ -1,0 +1,1 @@
+from .registry import Dependencies, Manager  # noqa: F401

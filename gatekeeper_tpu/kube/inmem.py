@@ -97,6 +97,11 @@ class InMemoryKube:
                 new_rv = obj.get("metadata", {}).get("resourceVersion")
                 if old_rv != new_rv:
                     raise Conflict(f"{gvk} {key}: resourceVersion mismatch")
+            # no-op detection (as the real apiserver: an update that changes
+            # nothing keeps the resourceVersion and emits no event) — this is
+            # what lets write-back controller loops converge
+            if self._semantically_equal(bucket[key], obj):
+                return copy.deepcopy(bucket[key])
             stored = copy.deepcopy(obj)
             stored.setdefault("metadata", {})["resourceVersion"] = str(next(self._rv))
             # preserve uid across updates
@@ -106,6 +111,18 @@ class InMemoryKube:
             bucket[key] = stored
             self._notify(gvk, WatchEvent("MODIFIED", copy.deepcopy(stored)))
             return copy.deepcopy(stored)
+
+    @staticmethod
+    def _semantically_equal(stored: dict, new: dict) -> bool:
+        def strip(o):
+            out = copy.deepcopy(o)
+            meta = out.get("metadata")
+            if isinstance(meta, dict):
+                meta.pop("resourceVersion", None)
+                meta.pop("uid", None)  # preserved from stored on update
+            return out
+
+        return strip(stored) == strip(new)
 
     def apply(self, obj: dict) -> dict:
         """create-or-update."""

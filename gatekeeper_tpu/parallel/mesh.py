@@ -87,6 +87,10 @@ def sharded_violation_counts(driver, reviews, mesh: Mesh):
     cross back to the host."""
     fn, ordered, rp, cp, cols, group_params = driver._device_inputs(reviews)
     rows = len(rp.arrays["valid"])
+    if rows % mesh.devices.size != 0:
+        raise ValueError(
+            f"row bucket {rows} not divisible by mesh size {mesh.devices.size}"
+        )
     args = (rp.arrays, cp.arrays, cols, group_params)
     in_sh = shardings_for(mesh, rows, args)
     raw = fn.__wrapped__

@@ -1,0 +1,1 @@
+from .tracker import ObjectTracker, Tracker  # noqa: F401

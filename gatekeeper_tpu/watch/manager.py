@@ -1,0 +1,207 @@
+"""Dynamic watch management (reference pkg/watch/manager.go, registrar.go,
+replay.go, controller_switch.go).
+
+Capabilities mirrored:
+- named Registrars declare a desired GVK set (add/remove/replace), events fan
+  out to each registrar's queue (manager.go:280-373)
+- the first registrar for a GVK starts the underlying watch ("informer"),
+  the last one leaving stops it (manager.go:174-239)
+- late joiners get an async REPLAY of currently-listed objects as ADDED
+  events (replay.go:35-120)
+- ControllerSwitch: global teardown gate checked at the top of every
+  reconcile (controller_switch.go:22-44)
+
+TPU-first note: this layer is pure control plane — it feeds reconcilers that
+mutate the Driver's compiled programs / inventory tensors; nothing here
+touches the device.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Dict, Optional, Set, Tuple
+
+from ..kube.inmem import InMemoryKube, WatchEvent
+from .set import GVKSet
+
+GVK = Tuple[str, str, str]
+
+
+class ControllerSwitch:
+    """Global on/off gate (controller_switch.go)."""
+
+    def __init__(self):
+        self._running = True
+        self._lock = threading.RLock()
+
+    def stop(self):
+        with self._lock:
+            self._running = False
+
+    def enter(self) -> bool:
+        with self._lock:
+            return self._running
+
+
+class WatchError(Exception):
+    pass
+
+
+class Registrar:
+    """A named consumer with a desired GVK set (registrar.go:50-75).
+    Events for watched GVKs arrive on `self.events` as (gvk, WatchEvent)."""
+
+    def __init__(self, name: str, manager: "WatchManager"):
+        self.name = name
+        self.manager = manager
+        self.events: "queue.Queue[Tuple[GVK, WatchEvent]]" = queue.Queue()
+
+    def add_watch(self, gvk: GVK):
+        self.manager._add_watch(self, gvk)
+
+    def remove_watch(self, gvk: GVK):
+        self.manager._remove_watch(self, gvk)
+
+    def replace_watch(self, gvks) -> None:
+        """Ensure all and only `gvks` are watched by this registrar
+        (manager.go:242-277)."""
+        self.manager._replace_watch(self, set(gvks))
+
+    def watched(self) -> GVKSet:
+        return self.manager.watched_by(self)
+
+
+class _Pump(threading.Thread):
+    """Per-GVK event pump: reads the kube watcher, fans out to registrars.
+    The single shared watch per GVK is the manager's 'informer'."""
+
+    def __init__(self, manager: "WatchManager", gvk: GVK):
+        super().__init__(daemon=True, name=f"watch-pump-{gvk}")
+        self.manager = manager
+        self.gvk = gvk
+        # replay=False: replay to late joiners is handled per-registrar
+        self.watcher = manager.kube.watch(gvk, replay=False)
+
+    def run(self):
+        while True:
+            ev = self.watcher.next(timeout=0.2)
+            if self.watcher._stopped:
+                return
+            if ev is None:
+                continue
+            self.manager._fan_out(self.gvk, ev)
+
+    def stop(self):
+        self.watcher.stop()
+
+
+class WatchManager:
+    """manager.go: runtime-mutable watches over the in-memory API."""
+
+    def __init__(self, kube: InMemoryKube, metrics_hook: Optional[Callable] = None):
+        self.kube = kube
+        self._lock = threading.RLock()
+        self._registrars: Dict[str, Registrar] = {}
+        # intent: registrar -> set of GVKs (recordKeeper, registrar.go:51-58)
+        self._intent: Dict[Registrar, Set[GVK]] = {}
+        self._pumps: Dict[GVK, _Pump] = {}
+        self._metrics_hook = metrics_hook
+
+    # ---- registrar lifecycle ---------------------------------------------
+
+    def new_registrar(self, name: str) -> Registrar:
+        with self._lock:
+            if name in self._registrars:
+                raise WatchError(f"registrar for {name} already exists")
+            r = Registrar(name, self)
+            self._registrars[name] = r
+            self._intent[r] = set()
+            return r
+
+    def remove_registrar(self, name: str):
+        with self._lock:
+            r = self._registrars.pop(name, None)
+            if r is None:
+                return
+            for gvk in list(self._intent.get(r, ())):
+                self._remove_watch_locked(r, gvk)
+            self._intent.pop(r, None)
+
+    # ---- watch bookkeeping ------------------------------------------------
+
+    def _add_watch(self, r: Registrar, gvk: GVK):
+        with self._lock:
+            if gvk in self._intent[r]:
+                return
+            self._intent[r].add(gvk)
+            if gvk not in self._pumps:
+                pump = _Pump(self, gvk)
+                self._pumps[gvk] = pump
+                pump.start()
+            # replay current objects to the late joiner (replay.go:35-120).
+            # Done SYNCHRONOUSLY under the manager lock: live events fan out
+            # through _fan_out, which needs this lock, so every replayed
+            # ADDED is enqueued before any later live event for this GVK —
+            # a stale replay can never resurrect an object deleted after
+            # the snapshot.  (In-memory lists are cheap; the reference
+            # replays async because its lists hit the API server.)
+            for obj in self.kube.list(gvk):
+                r.events.put((gvk, WatchEvent("ADDED", obj)))
+            self._report()
+
+    def _remove_watch(self, r: Registrar, gvk: GVK):
+        with self._lock:
+            self._remove_watch_locked(r, gvk)
+
+    def _remove_watch_locked(self, r: Registrar, gvk: GVK):
+        self._intent.get(r, set()).discard(gvk)
+        if not any(gvk in s for s in self._intent.values()):
+            pump = self._pumps.pop(gvk, None)
+            if pump:
+                pump.stop()  # last registrar left: stop the informer
+        self._report()
+
+    def _replace_watch(self, r: Registrar, desired: Set[GVK]):
+        with self._lock:
+            current = set(self._intent.get(r, ()))
+        for gvk in current - desired:
+            self._remove_watch(r, gvk)
+        for gvk in desired - current:
+            self._add_watch(r, gvk)
+
+    def _fan_out(self, gvk: GVK, ev: WatchEvent):
+        with self._lock:
+            targets = [r for r, s in self._intent.items() if gvk in s]
+        for r in targets:
+            r.events.put((gvk, ev))
+
+    def _report(self):
+        if self._metrics_hook:
+            try:
+                self._metrics_hook(len(self._pumps), self.intended().size())
+            except Exception:
+                pass
+
+    # ---- introspection ----------------------------------------------------
+
+    def watched_gvks(self) -> GVKSet:
+        with self._lock:
+            return GVKSet(self._pumps.keys())
+
+    def intended(self) -> GVKSet:
+        with self._lock:
+            out: Set[GVK] = set()
+            for s in self._intent.values():
+                out |= s
+            return GVKSet(out)
+
+    def watched_by(self, r: Registrar) -> GVKSet:
+        with self._lock:
+            return GVKSet(self._intent.get(r, ()))
+
+    def stop(self):
+        with self._lock:
+            for pump in self._pumps.values():
+                pump.stop()
+            self._pumps.clear()
