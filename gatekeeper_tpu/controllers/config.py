@@ -51,6 +51,9 @@ class ConfigController(Controller):
             return
         if event.type == "DELETED":
             spec = parse_config(None)
+            if self.tracker:
+                # a config deleted during startup must not block readiness
+                self.tracker.config.cancel_expect(obj)
         else:
             spec = parse_config(obj)
 
@@ -77,6 +80,8 @@ class ConfigController(Controller):
             for o in self.kube.list(g):
                 ns = (o.get("metadata") or {}).get("namespace") or ""
                 if self.excluder.is_namespace_excluded(SYNC, ns):
+                    if self.tracker:
+                        self.tracker.for_data(g).cancel_expect(o)
                     continue
                 self.client.add_data(o)
                 if self.tracker:

@@ -110,6 +110,11 @@ class Manager:
             )
         for c in self.controllers:
             c.start()
+        if self.deps.tracker is not None:
+            # objects deleted between tracker seeding and watch registration
+            # never get a DELETED tombstone; collect them once now that
+            # watches are live (ready_tracker.go:198-218)
+            self.deps.tracker.collect(self.deps.kube)
 
     def stop(self):
         self.switch.stop()

@@ -56,7 +56,10 @@ class ConstraintStatusController(Controller):
             by_pod.append(s)
         by_pod.sort(key=lambda s: s.get("id", ""))
         parent.setdefault("status", {})["byPod"] = by_pod
-        self.kube.update(parent)
+        # optimistic concurrency: a concurrent spec writer bumps the
+        # resourceVersion; Conflict propagates to the controller retry
+        # loop, which re-reads the fresh parent instead of clobbering it
+        self.kube.update(parent, check_version=True)
 
 
 class ConstraintTemplateStatusController(Controller):
@@ -96,4 +99,4 @@ class ConstraintTemplateStatusController(Controller):
         parent["status"]["created"] = bool(by_pod) and all(
             not s.get("errors") for s in by_pod
         )
-        self.kube.update(parent)
+        self.kube.update(parent, check_version=True)

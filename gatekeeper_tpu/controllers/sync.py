@@ -63,8 +63,15 @@ class SyncController(Controller):
                 if key in self._synced:
                     self._synced.discard(key)
                     self._counts[gvk] = max(0, self._counts.get(gvk, 0) - 1)
+            if self.tracker:
+                self.tracker.for_data(gvk).cancel_expect(obj)
         else:
             if self.excluder.is_namespace_excluded(SYNC, ns):
+                # excluded objects must not block readiness: the tracker
+                # expected them from the raw List (sync_controller.go calls
+                # CancelExpect on the skip path)
+                if self.tracker:
+                    self.tracker.for_data(gvk).cancel_expect(obj)
                 return
             self.client.add_data(obj)
             with self._lock:
@@ -74,7 +81,7 @@ class SyncController(Controller):
             if self.tracker:
                 self.tracker.for_data(gvk).observe(obj)
         if self.reporter:
-            self.reporter.report_sync(dict(self._counts), time.monotonic() - t0)
+            self.reporter.report_sync(self.counts(), time.monotonic() - t0)
 
     def counts(self) -> Dict[GVK, int]:
         with self._lock:
@@ -92,4 +99,4 @@ class SyncController(Controller):
                 del self._counts[gvk]
             self._synced = {k for k in self._synced if watched.contains(k[0])}
         if self.reporter:
-            self.reporter.report_sync(dict(self._counts), 0.0)
+            self.reporter.report_sync(self.counts(), 0.0)

@@ -1,0 +1,192 @@
+"""The metric catalog (reference docs/Metrics.md) and the reporter facade.
+
+Every metric the reference documents, with the same names, tags, and bucket
+boundaries, defined against this framework's view registry:
+
+  constraints                                pkg/controller/constraint/stats_reporter.go:13-36
+  constraint_templates                       pkg/controller/constrainttemplate/stats_reporter.go:15-33
+  constraint_template_ingestion_count        .../stats_reporter.go:36-41
+  constraint_template_ingestion_duration_seconds  .../stats_reporter.go:43-48
+  request_count / request_duration_seconds   pkg/webhook/stats_reporter.go:13-25,71-88
+  violations                                 pkg/audit/stats_reporter.go:15-41
+  audit_duration_seconds / audit_last_run_time    pkg/audit/stats_reporter.go:42-53
+  sync / sync_duration_seconds / sync_last_run_time  pkg/controller/sync/stats_reporter.go:14-46
+  watch_manager_watched_gvk / watch_manager_intended_watch_gvk  pkg/watch/stats_reporter.go:13-33
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+from .views import (
+    AGG_COUNT,
+    AGG_DISTRIBUTION,
+    AGG_LAST_VALUE,
+    Measure,
+    Registry,
+    View,
+    global_registry,
+)
+
+# ---- measures ---------------------------------------------------------------
+
+CONSTRAINTS_M = Measure("constraints", "Current number of known constraints")
+CT_M = Measure(
+    "constraint_templates", "Number of observed constraint templates"
+)
+INGEST_DURATION_M = Measure(
+    "constraint_template_ingestion_duration_seconds",
+    "How long it took to ingest a constraint template in seconds",
+    unit="s",
+)
+REQUEST_DURATION_M = Measure(
+    "request_duration_seconds", "The response time in seconds", unit="s"
+)
+VIOLATIONS_M = Measure(
+    "violations", "Total number of violations per constraint"
+)
+AUDIT_DURATION_M = Measure(
+    "audit_duration_seconds", "Latency of audit operation in seconds", unit="s"
+)
+AUDIT_LAST_RUN_M = Measure(
+    "audit_last_run_time", "Timestamp of last audit run time", unit="s"
+)
+SYNC_M = Measure(
+    "sync", "Total number of resources of each kind being cached"
+)
+SYNC_DURATION_M = Measure(
+    "sync_duration_seconds", "Latency of sync operation in seconds", unit="s"
+)
+SYNC_LAST_RUN_M = Measure(
+    "sync_last_run_time", "Timestamp of last sync operation", unit="s"
+)
+WATCHED_GVK_M = Measure(
+    "watch_manager_watched_gvk", "Total number of watched GroupVersionKinds"
+)
+INTENDED_GVK_M = Measure(
+    "watch_manager_intended_watch_gvk",
+    "Total number of GroupVersionKinds with a registered watch intent",
+)
+
+# bucket boundaries copied from the reference's view.Distribution calls
+_INGEST_BUCKETS = (
+    0.01, 0.02, 0.03, 0.04, 0.05, 0.06, 0.07, 0.08, 0.09, 0.1,
+    0.2, 0.3, 0.4, 0.5, 1, 2, 3, 4, 5,
+)
+_REQUEST_BUCKETS = (
+    0.001, 0.002, 0.003, 0.004, 0.005, 0.006, 0.007, 0.008, 0.009, 0.01,
+    0.02, 0.03, 0.04, 0.05,
+)
+_AUDIT_BUCKETS = (0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 1, 2, 3, 4, 5)
+_SYNC_BUCKETS = (
+    0.0001, 0.0002, 0.0003, 0.0004, 0.0005, 0.0006, 0.0007, 0.0008, 0.0009,
+    0.001, 0.002, 0.003, 0.004, 0.005, 0.01, 0.02, 0.03, 0.04, 0.05,
+)
+
+
+def catalog_views():
+    return [
+        View("constraints", CONSTRAINTS_M, AGG_LAST_VALUE,
+             tag_keys=("enforcement_action", "status")),
+        View("constraint_templates", CT_M, AGG_LAST_VALUE,
+             tag_keys=("status",)),
+        View("constraint_template_ingestion_count", INGEST_DURATION_M,
+             AGG_COUNT,
+             description="Total number of constraint template ingestion actions",
+             tag_keys=("status",)),
+        View("constraint_template_ingestion_duration_seconds",
+             INGEST_DURATION_M, AGG_DISTRIBUTION,
+             description="Distribution of how long it took to ingest a "
+                         "constraint template in seconds",
+             tag_keys=("status",), buckets=_INGEST_BUCKETS),
+        View("request_count", REQUEST_DURATION_M, AGG_COUNT,
+             description="The number of requests that are routed to webhook",
+             tag_keys=("admission_status",)),
+        View("request_duration_seconds", REQUEST_DURATION_M, AGG_DISTRIBUTION,
+             tag_keys=("admission_status",), buckets=_REQUEST_BUCKETS),
+        View("violations", VIOLATIONS_M, AGG_LAST_VALUE,
+             tag_keys=("enforcement_action",)),
+        View("audit_duration_seconds", AUDIT_DURATION_M, AGG_DISTRIBUTION,
+             buckets=_AUDIT_BUCKETS),
+        View("audit_last_run_time", AUDIT_LAST_RUN_M, AGG_LAST_VALUE),
+        View("sync", SYNC_M, AGG_LAST_VALUE, tag_keys=("kind", "status")),
+        View("sync_duration_seconds", SYNC_DURATION_M, AGG_DISTRIBUTION,
+             buckets=_SYNC_BUCKETS),
+        View("sync_last_run_time", SYNC_LAST_RUN_M, AGG_LAST_VALUE),
+        View("watch_manager_watched_gvk", WATCHED_GVK_M, AGG_LAST_VALUE),
+        View("watch_manager_intended_watch_gvk", INTENDED_GVK_M,
+             AGG_LAST_VALUE),
+    ]
+
+
+def register_catalog(registry: Optional[Registry] = None) -> Registry:
+    registry = registry or global_registry()
+    registry.register(*catalog_views())
+    return registry
+
+
+class Reporters:
+    """The facade the controllers/webhook/audit call.
+
+    Collapses the reference's per-package StatsReporter types into one
+    object with the per-consumer report methods the call sites use.
+    """
+
+    def __init__(self, registry: Optional[Registry] = None):
+        self.registry = register_catalog(registry)
+
+    # -- constraint controller (report_constraints(totals)) ------------------
+    def report_constraints(self, totals: Dict[tuple, int]):
+        """totals: {(enforcement_action, status): count} — the reference
+        reports every (action,status) cell each reconcile
+        (constraint_controller.go:425-473)."""
+        for (action, status), n in totals.items():
+            self.registry.record(
+                CONSTRAINTS_M, float(n),
+                {"enforcement_action": action, "status": status},
+            )
+
+    # -- constrainttemplate controller ---------------------------------------
+    def report_templates(self, status: str, count: int):
+        self.registry.record(CT_M, float(count), {"status": status})
+
+    def report_ingestion(self, status: str, duration_s: float):
+        self.registry.record(
+            INGEST_DURATION_M, duration_s, {"status": status}
+        )
+
+    # -- webhook --------------------------------------------------------------
+    def report_request(self, admission_status: str, duration_s: float):
+        self.registry.record(
+            REQUEST_DURATION_M, duration_s,
+            {"admission_status": admission_status},
+        )
+
+    # -- audit ----------------------------------------------------------------
+    def report_total_violations(self, enforcement_action: str, count: int):
+        self.registry.record(
+            VIOLATIONS_M, float(count),
+            {"enforcement_action": enforcement_action},
+        )
+
+    def report_audit_duration(self, duration_s: float):
+        self.registry.record(AUDIT_DURATION_M, duration_s)
+
+    def report_audit_last_run(self, ts: Optional[float] = None):
+        self.registry.record(AUDIT_LAST_RUN_M, ts if ts is not None else time.time())
+
+    # -- sync controller ------------------------------------------------------
+    def report_sync(self, counts: Dict[object, int], duration_s: float):
+        for gvk, n in counts.items():
+            kind = gvk[2] if isinstance(gvk, tuple) and len(gvk) == 3 else str(gvk)
+            self.registry.record(
+                SYNC_M, float(n), {"kind": kind, "status": "active"}
+            )
+        self.registry.record(SYNC_DURATION_M, duration_s)
+        self.registry.record(SYNC_LAST_RUN_M, time.time())
+
+    # -- watch manager --------------------------------------------------------
+    def report_gvk_count(self, watched: int, intended: int):
+        self.registry.record(WATCHED_GVK_M, float(watched))
+        self.registry.record(INTENDED_GVK_M, float(intended))

@@ -1,0 +1,120 @@
+"""Prometheus text exposition + standalone metrics HTTP server.
+
+The reference exports views through a Prometheus exporter serving on its
+own HTTP listener at --prometheus-port 8888 (pkg/metrics/exporter.go:14-15,
+prometheus_exporter.go).  Same here: render the registry in the Prometheus
+text format and serve it from a background thread.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .views import (
+    AGG_COUNT,
+    AGG_DISTRIBUTION,
+    AGG_LAST_VALUE,
+    AGG_SUM,
+    DistributionData,
+    Registry,
+    global_registry,
+)
+
+NAMESPACE = "gatekeeper"  # metric name prefix, as the reference's exporter
+
+
+def _escape(value: str) -> str:
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _labels(keys, values) -> str:
+    pairs = [f'{k}="{_escape(v)}"' for k, v in zip(keys, values) if v != ""]
+    return "{" + ",".join(pairs) + "}" if pairs else ""
+
+
+def _fmt(v: float) -> str:
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def render_prometheus(registry: Optional[Registry] = None) -> str:
+    registry = registry or global_registry()
+    lines = []
+    for view, rows in sorted(registry.snapshot(), key=lambda s: s[0].name):
+        full = f"{NAMESPACE}_{view.name}"
+        kind = {
+            AGG_COUNT: "counter",
+            AGG_SUM: "counter",
+            AGG_LAST_VALUE: "gauge",
+            AGG_DISTRIBUTION: "histogram",
+        }[view.aggregation]
+        lines.append(f"# HELP {full} {view.description}")
+        lines.append(f"# TYPE {full} {kind}")
+        for tag_values in sorted(rows):
+            val = rows[tag_values]
+            label_str = _labels(view.tag_keys, tag_values)
+            if isinstance(val, DistributionData):
+                cumulative = 0
+                for bound, n in zip(view.buckets, val.bucket_counts):
+                    cumulative += n
+                    le = _labels(
+                        view.tag_keys + ("le",),
+                        tag_values + (_fmt(bound),),
+                    )
+                    lines.append(f"{full}_bucket{le} {cumulative}")
+                le = _labels(view.tag_keys + ("le",), tag_values + ("+Inf",))
+                lines.append(f"{full}_bucket{le} {val.count}")
+                lines.append(f"{full}_sum{label_str} {repr(val.sum)}")
+                lines.append(f"{full}_count{label_str} {val.count}")
+            else:
+                lines.append(f"{full}{label_str} {_fmt(float(val))}")
+    return "\n".join(lines) + "\n"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    registry: Registry = None
+
+    def do_GET(self):
+        if self.path not in ("/metrics", "/"):
+            self.send_response(404)
+            self.end_headers()
+            return
+        body = render_prometheus(self.registry).encode()
+        self.send_response(200)
+        self.send_header(
+            "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+        )
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):  # quiet
+        pass
+
+
+class MetricsExporter:
+    """Background Prometheus endpoint (reference runner in exporter.go:40-57)."""
+
+    def __init__(self, port: int = 8888, registry: Optional[Registry] = None):
+        self.port = port
+        self.registry = registry or global_registry()
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        handler = type("Handler", (_Handler,), {"registry": self.registry})
+        self._server = ThreadingHTTPServer(("0.0.0.0", self.port), handler)
+        self.port = self._server.server_address[1]  # resolve port 0
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="metrics", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        if self._server:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None

@@ -1,0 +1,148 @@
+"""Measure/View/Registry — the aggregation model behind the metric catalog.
+
+Mirrors the semantics the reference gets from OpenCensus (views over
+measures with tag keys; reference pkg/metrics/record.go): a view names one
+aggregation of one measure, partitioned by tag values.  Supported
+aggregations are the ones the catalog actually uses: count, sum,
+last-value, and bucketed distribution.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+AGG_COUNT = "count"
+AGG_SUM = "sum"
+AGG_LAST_VALUE = "last_value"
+AGG_DISTRIBUTION = "distribution"
+
+
+@dataclass(frozen=True)
+class Measure:
+    name: str
+    description: str = ""
+    unit: str = "1"
+
+
+@dataclass
+class View:
+    name: str
+    measure: Measure
+    aggregation: str
+    description: str = ""
+    tag_keys: Tuple[str, ...] = ()
+    buckets: Tuple[float, ...] = ()  # AGG_DISTRIBUTION only
+
+    def __post_init__(self):
+        if not self.description:
+            self.description = self.measure.description
+        if self.aggregation == AGG_DISTRIBUTION and not self.buckets:
+            raise ValueError(f"view {self.name}: distribution requires buckets")
+
+
+@dataclass
+class DistributionData:
+    bucket_counts: List[int]
+    count: int = 0
+    sum: float = 0.0
+    min: float = float("inf")
+    max: float = float("-inf")
+
+
+@dataclass
+class _ViewState:
+    view: View
+    # tag-value tuple (aligned with view.tag_keys) -> aggregated value
+    rows: Dict[Tuple[str, ...], object] = field(default_factory=dict)
+
+
+class Registry:
+    """Thread-safe collection of registered views and their rows."""
+
+    def __init__(self):
+        self._views: Dict[str, _ViewState] = {}
+        self._lock = threading.Lock()
+
+    def register(self, *views: View) -> None:
+        with self._lock:
+            for v in views:
+                existing = self._views.get(v.name)
+                if existing is not None and existing.view is not v:
+                    # idempotent re-registration of an identical view is fine
+                    if existing.view != v:
+                        raise ValueError(f"view {v.name} already registered")
+                    continue
+                self._views[v.name] = _ViewState(view=v)
+
+    def record(
+        self,
+        measure: Measure,
+        value: float,
+        tags: Optional[Dict[str, str]] = None,
+    ) -> None:
+        """Record one measurement against every view of this measure."""
+        tags = tags or {}
+        with self._lock:
+            for state in self._views.values():
+                v = state.view
+                if v.measure.name != measure.name:
+                    continue
+                key = tuple(tags.get(k, "") for k in v.tag_keys)
+                if v.aggregation == AGG_COUNT:
+                    state.rows[key] = int(state.rows.get(key, 0)) + 1
+                elif v.aggregation == AGG_SUM:
+                    state.rows[key] = float(state.rows.get(key, 0.0)) + value
+                elif v.aggregation == AGG_LAST_VALUE:
+                    state.rows[key] = float(value)
+                elif v.aggregation == AGG_DISTRIBUTION:
+                    dist = state.rows.get(key)
+                    if dist is None:
+                        dist = DistributionData(
+                            bucket_counts=[0] * (len(v.buckets) + 1)
+                        )
+                        state.rows[key] = dist
+                    idx = len(v.buckets)
+                    for i, bound in enumerate(v.buckets):
+                        if value <= bound:
+                            idx = i
+                            break
+                    dist.bucket_counts[idx] += 1
+                    dist.count += 1
+                    dist.sum += value
+                    dist.min = min(dist.min, value)
+                    dist.max = max(dist.max, value)
+
+    def snapshot(self) -> List[Tuple[View, Dict[Tuple[str, ...], object]]]:
+        import copy
+
+        with self._lock:
+            return [
+                (s.view, copy.deepcopy(s.rows)) for s in self._views.values()
+            ]
+
+    def view_rows(self, name: str) -> Dict[Tuple[str, ...], object]:
+        """Test/introspection helper: rows of one view by name."""
+        import copy
+
+        with self._lock:
+            s = self._views.get(name)
+            return copy.deepcopy(s.rows) if s else {}
+
+    def clear(self) -> None:
+        with self._lock:
+            for s in self._views.values():
+                s.rows.clear()
+
+
+_global = Registry()
+
+
+def global_registry() -> Registry:
+    return _global
+
+
+def record(measure: Measure, value: float, tags: Optional[Dict[str, str]] = None):
+    """The analogue of metrics.Record (reference pkg/metrics/record.go)."""
+    _global.record(measure, value, tags)

@@ -20,7 +20,8 @@ from typing import Dict, Optional, Set, Tuple
 from ..apis.config import CONFIG_NAME
 from ..apis.config import GVK as CONFIG_GVK
 from ..apis.config import parse_config
-from ..kube.inmem import InMemoryKube, gvk_of
+from ..kube.inmem import InMemoryKube, obj_key as _key
+from ..util import nested_get
 
 GVK = Tuple[str, str, str]
 
@@ -32,9 +33,8 @@ CONSTRAINTS_GROUP = "constraints.gatekeeper.sh"
 TRY_CANCEL_THRESHOLD = 3
 
 
-def _key(obj: dict) -> Tuple[str, str]:
-    meta = obj.get("metadata") or {}
-    return (meta.get("namespace") or "", meta.get("name") or "")
+def template_constraint_kind(template: dict) -> Optional[str]:
+    return nested_get(template, "spec", "crd", "spec", "names", "kind")
 
 
 class ObjectTracker:
@@ -174,12 +174,7 @@ class Tracker:
         observed once the kind's watch is gone (collectForObjectTracker,
         ready_tracker.go:228-260)."""
         self.templates.cancel_expect(template)
-        kind = (
-            ((template.get("spec") or {}).get("crd") or {})
-            .get("spec", {})
-            .get("names", {})
-            .get("kind")
-        )
+        kind = template_constraint_kind(template)
         if kind:
             with self._lock:
                 tr = self._constraints.get((CONSTRAINTS_GROUP, "v1beta1", kind))
@@ -200,12 +195,7 @@ class Tracker:
 
         # constraints: for each template kind, expect existing CRs
         for t in templates:
-            kind = (
-                ((t.get("spec") or {}).get("crd") or {})
-                .get("spec", {})
-                .get("names", {})
-                .get("kind")
-            )
+            kind = template_constraint_kind(t)
             if not kind:
                 continue
             cgvk = (CONSTRAINTS_GROUP, "v1beta1", kind)
@@ -221,9 +211,13 @@ class Tracker:
         try:
             cfg = kube.get(CONFIG_GVK, CONFIG_NAME, "gatekeeper-system")
         except Exception:
+            # only the singleton name is honored — a config with any other
+            # name is ignored by the config controller, so expecting it
+            # would deadlock readiness (ready_tracker.go skips them)
             for c in kube.list(CONFIG_GVK):
-                cfg = c
-                break
+                if _key(c)[1] == CONFIG_NAME:
+                    cfg = c
+                    break
         if cfg is not None:
             self.config.expect(cfg)
             spec = parse_config(cfg)
@@ -237,6 +231,36 @@ class Tracker:
         with self._lock:
             self._data_populated = True
             self._seeded = True
+
+    def collect(self, kube: InMemoryKube):
+        """Cancel expectations for objects that no longer exist — the
+        periodic deleted-object collection of ready_tracker.go:198-218 /
+        collectForObjectTracker:228-260.  Covers objects deleted in the
+        window between run() seeding and watch registration, when no
+        DELETED tombstone is ever delivered."""
+
+        def _collect(tr: ObjectTracker, gvk: GVK):
+            pending = tr.pending()
+            if not pending:
+                return
+            live = {_key(o) for o in kube.list(gvk)}
+            for ns, name in pending - live:
+                tr.cancel_expect({"metadata": {"namespace": ns, "name": name}})
+
+        _collect(self.templates, TEMPLATES_GVK)
+        _collect(self.config, CONFIG_GVK)
+        with self._lock:
+            items = list(self._constraints.items()) + list(self._data.items())
+        for gvk, tr in items:
+            _collect(tr, gvk)
+        # a template canceled above can never deliver its constraints
+        live_templates = kube.list(TEMPLATES_GVK)
+        live_kinds = {template_constraint_kind(t) for t in live_templates}
+        with self._lock:
+            constraint_items = list(self._constraints.items())
+        for gvk, tr in constraint_items:
+            if gvk[2] not in live_kinds:
+                tr.cancel_all()
 
     # ---- satisfaction -----------------------------------------------------
 

@@ -480,3 +480,87 @@ class TestSyncPrune:
             assert mgr.sync.counts() == {}
         finally:
             mgr.stop()
+
+
+class TestReadinessRegressions:
+    """Deadlock scenarios from review: excluded/deleted/mis-named objects
+    must not block readiness forever."""
+
+    def _config(self, sync_only, match=None):
+        return {
+            "apiVersion": "config.gatekeeper.sh/v1alpha1",
+            "kind": "Config",
+            "metadata": {"name": "config", "namespace": "gatekeeper-system"},
+            "spec": {
+                "sync": {"syncOnly": sync_only},
+                "match": match or [],
+            },
+        }
+
+    def test_excluded_namespace_objects_do_not_block_readiness(self):
+        kube = InMemoryKube()
+        kube.create({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "p1", "namespace": "kube-system"},
+        })
+        kube.create(self._config(
+            [{"group": "", "version": "v1", "kind": "Pod"}],
+            match=[{"excludedNamespaces": ["kube-system"],
+                    "processes": ["sync"]}],
+        ))
+        mgr, kube, client, deps = make_manager(kube=kube)
+        deps.tracker.run(kube)
+        mgr.start()
+        try:
+            assert mgr.drain()
+            assert deps.tracker.wait_satisfied(timeout=5.0)
+        finally:
+            mgr.stop()
+
+    def test_non_singleton_config_name_not_expected(self):
+        kube = InMemoryKube()
+        kube.create({
+            "apiVersion": "config.gatekeeper.sh/v1alpha1",
+            "kind": "Config",
+            "metadata": {"name": "not-the-singleton",
+                         "namespace": "gatekeeper-system"},
+            "spec": {},
+        })
+        mgr, kube, client, deps = make_manager(kube=kube)
+        deps.tracker.run(kube)
+        mgr.start()
+        try:
+            assert mgr.drain()
+            assert deps.tracker.wait_satisfied(timeout=5.0)
+        finally:
+            mgr.stop()
+
+    def test_object_deleted_before_watch_start_is_collected(self):
+        kube = InMemoryKube()
+        kube.create(dict(TEMPLATE))
+        mgr, kube, client, deps = make_manager(kube=kube)
+        deps.tracker.run(kube)  # template now expected
+        # deleted before any watch exists: no tombstone will ever arrive
+        kube.delete(TEMPLATES_GVK, "k8srequiredlabels")
+        mgr.start()  # start() runs tracker.collect(kube)
+        try:
+            assert mgr.drain()
+            assert deps.tracker.wait_satisfied(timeout=5.0)
+        finally:
+            mgr.stop()
+
+    def test_status_write_back_does_not_clobber_spec(self):
+        mgr, kube, client, deps = make_manager()
+        mgr.start()
+        try:
+            kube.create(dict(TEMPLATE))
+            assert mgr.drain()
+            kube.create(dict(CONSTRAINT))
+            assert mgr.drain()
+            time.sleep(0.2)
+            # spec survived the status controller's parent write-backs
+            live = kube.get(CGVK, "ns-must-have-gk")
+            assert live["spec"]["parameters"] == {"labels": ["gatekeeper"]}
+            assert live.get("status", {}).get("byPod")
+        finally:
+            mgr.stop()

@@ -1,0 +1,105 @@
+"""Metrics subsystem: views, catalog, reporter facade, Prometheus rendering.
+
+Covers the behavior the reference's stats_reporter tests assert (recorded
+row values and tags; e.g. pkg/webhook/stats_reporter_test.go) plus the
+exposition endpoint."""
+
+import urllib.request
+
+from gatekeeper_tpu.metrics import (
+    MetricsExporter,
+    Reporters,
+    render_prometheus,
+)
+from gatekeeper_tpu.metrics.views import (
+    AGG_COUNT,
+    AGG_DISTRIBUTION,
+    AGG_LAST_VALUE,
+    Measure,
+    Registry,
+    View,
+)
+
+
+def fresh_reporters():
+    return Reporters(Registry())
+
+
+def test_count_and_distribution_aggregation():
+    reg = Registry()
+    m = Measure("latency", "latency", "s")
+    reg.register(
+        View("req_count", m, AGG_COUNT, tag_keys=("status",)),
+        View("req_hist", m, AGG_DISTRIBUTION, tag_keys=("status",),
+             buckets=(0.01, 0.1, 1.0)),
+    )
+    for v in (0.005, 0.05, 0.5, 5.0):
+        reg.record(m, v, {"status": "allow"})
+    reg.record(m, 0.05, {"status": "deny"})
+
+    assert reg.view_rows("req_count")[("allow",)] == 4
+    assert reg.view_rows("req_count")[("deny",)] == 1
+    dist = reg.view_rows("req_hist")[("allow",)]
+    assert dist.bucket_counts == [1, 1, 1, 1]
+    assert dist.count == 4
+    assert abs(dist.sum - 5.555) < 1e-9
+
+
+def test_last_value_overwrites():
+    reg = Registry()
+    m = Measure("g", "gauge")
+    reg.register(View("g", m, AGG_LAST_VALUE))
+    reg.record(m, 3)
+    reg.record(m, 7)
+    assert reg.view_rows("g")[()] == 7.0
+
+
+def test_reporter_facade_records_catalog_rows():
+    r = fresh_reporters()
+    r.report_request("allow", 0.004)
+    r.report_request("deny", 0.02)
+    r.report_constraints({("deny", "active"): 5, ("dryrun", "error"): 1})
+    r.report_ingestion("active", 0.03)
+    r.report_total_violations("deny", 12)
+    r.report_audit_duration(0.8)
+    r.report_sync({("", "v1", "Pod"): 10}, 0.001)
+    r.report_gvk_count(3, 4)
+
+    reg = r.registry
+    assert reg.view_rows("request_count")[("allow",)] == 1
+    assert reg.view_rows("constraints")[("deny", "active")] == 5.0
+    assert reg.view_rows("violations")[("deny",)] == 12.0
+    assert reg.view_rows("sync")[("Pod", "active")] == 10.0
+    assert reg.view_rows("watch_manager_watched_gvk")[()] == 3.0
+    dist = reg.view_rows("request_duration_seconds")[("deny",)]
+    assert dist.count == 1
+
+
+def test_prometheus_rendering():
+    r = fresh_reporters()
+    r.report_request("allow", 0.004)
+    r.report_audit_duration(2.5)
+    r.report_total_violations("deny", 3)
+    text = render_prometheus(r.registry)
+    assert '# TYPE gatekeeper_request_duration_seconds histogram' in text
+    assert 'gatekeeper_request_count{admission_status="allow"} 1' in text
+    assert 'gatekeeper_violations{enforcement_action="deny"} 3' in text
+    assert 'gatekeeper_audit_duration_seconds_bucket{le="+Inf"} 1' in text
+    # cumulative bucket counts: 2.5 falls in the le=3 bucket
+    assert 'gatekeeper_audit_duration_seconds_bucket{le="3"} 1' in text
+    assert 'gatekeeper_audit_duration_seconds_bucket{le="2"} 0' in text
+
+
+def test_exporter_http_endpoint():
+    r = fresh_reporters()
+    r.report_request("allow", 0.002)
+    exp = MetricsExporter(port=0, registry=r.registry)
+    exp.start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{exp.port}/metrics", timeout=5
+        ) as resp:
+            body = resp.read().decode()
+        assert "gatekeeper_request_count" in body
+    finally:
+        exp.stop()
