@@ -187,25 +187,39 @@ class Client:
     # ---- evaluation -------------------------------------------------------
 
     def review(self, obj: Any, tracing: bool = False) -> Responses:
-        handled, review = self.target.handle_review(obj)
-        if not handled:
-            raise ClientError("review input not handled by target")
-        results, trace = self.driver.review(review, tracing=tracing)
-        for r in results:
-            try:
-                r.resource = self.target.handle_violation(r.review)
-            except Exception:
-                r.resource = None
-        return Responses(
-            by_target={
-                self.target.name: Response(
-                    target=self.target.name,
-                    results=results,
-                    trace=trace,
-                    input=review if tracing else None,
+        return self.review_batch([obj], tracing=tracing)[0]
+
+    def review_batch(self, objs: List[Any], tracing: bool = False) -> List[Responses]:
+        """Batched review: one driver dispatch for N review inputs (the
+        webhook micro-batching path)."""
+        reviews = []
+        for obj in objs:
+            handled, review = self.target.handle_review(obj)
+            if not handled:
+                raise ClientError("review input not handled by target")
+            reviews.append(review)
+        out = []
+        for review, (results, trace) in zip(
+            reviews, self.driver.review_batch(reviews, tracing=tracing)
+        ):
+            for r in results:
+                try:
+                    r.resource = self.target.handle_violation(r.review)
+                except Exception:
+                    r.resource = None
+            out.append(
+                Responses(
+                    by_target={
+                        self.target.name: Response(
+                            target=self.target.name,
+                            results=results,
+                            trace=trace,
+                            input=review if tracing else None,
+                        )
+                    }
                 )
-            }
-        )
+            )
+        return out
 
     def audit(self, tracing: bool = False) -> Responses:
         results, trace = self.driver.audit(tracing=tracing)

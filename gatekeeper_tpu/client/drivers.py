@@ -74,6 +74,10 @@ class Driver(Protocol):
 
     def review(self, review: dict, tracing: bool = False) -> Tuple[List[Result], Optional[str]]: ...
 
+    def review_batch(
+        self, reviews: List[dict], tracing: bool = False
+    ) -> List[Tuple[List[Result], Optional[str]]]: ...
+
     def audit(self, tracing: bool = False) -> Tuple[List[Result], Optional[str]]: ...
 
     def reset(self) -> None: ...
@@ -264,6 +268,14 @@ class InterpDriver:
                         if tracing:
                             trace.append(f"violation {kind}/{name}: {v.get('msg')}")
             return results, ("\n".join(trace) if tracing else None)
+
+    def review_batch(
+        self, reviews: List[dict], tracing: bool = False
+    ) -> List[Tuple[List[Result], Optional[str]]]:
+        """Evaluate several reviews.  The interpreter has no batching gain;
+        the TPU driver overrides this with one fused device dispatch — the
+        webhook micro-batcher targets this seam."""
+        return [self.review(r, tracing=tracing) for r in reviews]
 
     def audit(self, tracing: bool = False) -> Tuple[List[Result], Optional[str]]:
         with self._lock:

@@ -99,4 +99,4 @@ class SyncController(Controller):
                 del self._counts[gvk]
             self._synced = {k for k in self._synced if watched.contains(k[0])}
         if self.reporter:
-            self.reporter.report_sync(self.counts(), 0.0)
+            self.reporter.report_sync(self.counts())

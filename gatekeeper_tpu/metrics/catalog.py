@@ -135,6 +135,7 @@ class Reporters:
 
     def __init__(self, registry: Optional[Registry] = None):
         self.registry = register_catalog(registry)
+        self._sync_kinds: set = set()
 
     # -- constraint controller (report_constraints(totals)) ------------------
     def report_constraints(self, totals: Dict[tuple, int]):
@@ -177,13 +178,26 @@ class Reporters:
         self.registry.record(AUDIT_LAST_RUN_M, ts if ts is not None else time.time())
 
     # -- sync controller ------------------------------------------------------
-    def report_sync(self, counts: Dict[object, int], duration_s: float):
+    def report_sync(self, counts: Dict[object, int],
+                    duration_s: Optional[float] = None):
+        """duration_s=None means a bookkeeping-only update (e.g. prune):
+        gauge rows refresh but no latency sample is recorded."""
+        kinds = set()
         for gvk, n in counts.items():
             kind = gvk[2] if isinstance(gvk, tuple) and len(gvk) == 3 else str(gvk)
+            kinds.add(kind)
             self.registry.record(
                 SYNC_M, float(n), {"kind": kind, "status": "active"}
             )
-        self.registry.record(SYNC_DURATION_M, duration_s)
+        # retract gauge rows for kinds that left the sync set — last_value
+        # rows otherwise report stale counts forever
+        for kind in self._sync_kinds - kinds:
+            self.registry.record(
+                SYNC_M, 0.0, {"kind": kind, "status": "active"}
+            )
+        self._sync_kinds = kinds
+        if duration_s is not None:
+            self.registry.record(SYNC_DURATION_M, duration_s)
         self.registry.record(SYNC_LAST_RUN_M, time.time())
 
     # -- watch manager --------------------------------------------------------

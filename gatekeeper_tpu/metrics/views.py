@@ -69,8 +69,9 @@ class Registry:
         with self._lock:
             for v in views:
                 existing = self._views.get(v.name)
-                if existing is not None and existing.view is not v:
-                    # idempotent re-registration of an identical view is fine
+                if existing is not None:
+                    # idempotent re-registration of an equal view keeps the
+                    # accumulated rows; a conflicting definition is an error
                     if existing.view != v:
                         raise ValueError(f"view {v.name} already registered")
                     continue

@@ -224,34 +224,45 @@ class TpuDriver(InterpDriver):
                 )
 
     def review(self, review: dict, tracing: bool = False):
+        return self.review_batch([review], tracing=tracing)[0]
+
+    def review_batch(self, reviews: List[dict], tracing: bool = False):
+        """N concurrent admission reviews in ONE device dispatch: the mask
+        is [C, N], then each review's positive cells render host-side.
+        This is the micro-batching seam the webhook server drives."""
         from ..engine.value import freeze
 
+        if not reviews:
+            return []
         with self._lock:
-            ordered, mask, autoreject = self.compute_masks([review])
+            ordered, mask, autoreject = self.compute_masks(reviews)
             inventory = self.store.frozen()
-            frozen_review = freeze(review)
-            results: List[Result] = []
-            trace: List[str] = [] if tracing else None
-            for i, (kind, name, constraint) in enumerate(ordered):
-                if autoreject[i, 0]:
-                    if needs_autoreject(constraint, review, self.store.cached_namespace):
-                        results.append(
-                            Result(
-                                msg="Namespace is not cached in OPA.",
-                                metadata={"details": {}},
-                                constraint=constraint,
-                                review=review,
-                                enforcement_action=self._enforcement_action(constraint),
+            out = []
+            for ri, review in enumerate(reviews):
+                frozen_review = freeze(review)
+                results: List[Result] = []
+                trace: List[str] = [] if tracing else None
+                for i, (kind, name, constraint) in enumerate(ordered):
+                    if autoreject[i, ri]:
+                        if needs_autoreject(constraint, review, self.store.cached_namespace):
+                            results.append(
+                                Result(
+                                    msg="Namespace is not cached in OPA.",
+                                    metadata={"details": {}},
+                                    constraint=constraint,
+                                    review=review,
+                                    enforcement_action=self._enforcement_action(constraint),
+                                )
                             )
+                            if tracing:
+                                trace.append(f"autoreject {kind}/{name}")
+                    if mask[i, ri]:
+                        self._render_cell(
+                            results, constraint, kind, review, frozen_review,
+                            inventory, trace,
                         )
-                        if tracing:
-                            trace.append(f"autoreject {kind}/{name}")
-                if mask[i, 0]:
-                    self._render_cell(
-                        results, constraint, kind, review, frozen_review,
-                        inventory, trace,
-                    )
-            return results, ("\n".join(trace) if tracing else None)
+                out.append((results, "\n".join(trace) if tracing else None))
+            return out
 
     def audit(self, tracing: bool = False):
         from ..engine.value import freeze, thaw

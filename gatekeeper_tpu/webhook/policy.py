@@ -1,0 +1,293 @@
+"""The validation handler — /v1/admit semantics (reference
+pkg/webhook/policy.go:142-223).
+
+Order of checks, matching the reference Handle:
+  1. gatekeeper's own service account bypass (policy.go:147-149)
+  2. DELETE uses OldObject; absent OldObject is a 500 (policy.go:151-166)
+  3. gatekeeper resources get dry-run validation: templates through the
+     CRD-synthesis compile, constraints against their template CRD
+     (policy.go:168-179, 310-360) — user errors are 422, internal 500
+  4. namespaces excluded for the webhook process are allowed through
+     (policy.go:192-195)
+  5. review: trace config lookup, Namespace-kind namespace coercion,
+     Namespace augmentation from the cluster (policy.go:363-400)
+  6. deny messages only from enforcementAction==deny; dryrun logs/events
+     only (policy.go:209-222, 225-291)
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, List, Optional
+
+from .. import logging as gklog
+from ..apis.config import CONFIG_NAME, GVK as CONFIG_GVK, parse_config
+from ..kube.inmem import InMemoryKube, NotFound
+from ..process.excluder import WEBHOOK, Excluder
+from ..target.target import AugmentedReview
+from ..util import (
+    DENY as ACTION_DENY,
+    DRYRUN as ACTION_DRYRUN,
+    EnforcementActionError,
+    get_namespace,
+    validate_enforcement_action,
+)
+
+SERVICE_ACCOUNT_NAME = "gatekeeper-admin"
+
+TEMPLATE_GROUP = "templates.gatekeeper.sh"
+CONSTRAINT_GROUP = "constraints.gatekeeper.sh"
+
+# requestResponse values for the request_count metric (policy.go:134-140)
+RESPONSE_ALLOW = "allow"
+RESPONSE_DENY = "deny"
+RESPONSE_ERROR = "error"
+RESPONSE_UNKNOWN = "unknown"
+
+log = gklog.get("webhook")
+
+
+@dataclass
+class AdmissionResponse:
+    allowed: bool
+    message: str = ""
+    code: int = 200
+
+    def to_dict(self, uid: str = "") -> dict:
+        out = {"uid": uid, "allowed": self.allowed}
+        if self.message or not self.allowed:
+            out["status"] = {"message": self.message, "code": self.code}
+        return out
+
+
+def _allowed(msg: str = "") -> AdmissionResponse:
+    return AdmissionResponse(True, msg)
+
+
+def _denied(msg: str, code: int) -> AdmissionResponse:
+    return AdmissionResponse(False, msg, code)
+
+
+class ValidationHandler:
+    def __init__(
+        self,
+        client,                       # gatekeeper_tpu.client.Client
+        kube: Optional[InMemoryKube] = None,
+        excluder: Optional[Excluder] = None,
+        reporter=None,
+        gk_namespace: str = "gatekeeper-system",
+        log_denies: bool = False,
+        emit_admission_events: bool = False,
+        disable_enforcementaction_validation: bool = False,
+        event_recorder: Optional[Callable[[dict], None]] = None,
+        injected_config: Optional[dict] = None,
+    ):
+        self.client = client
+        self.kube = kube
+        self.excluder = excluder or Excluder()
+        self.reporter = reporter
+        self.gk_namespace = gk_namespace
+        self.log_denies = log_denies
+        self.emit_admission_events = emit_admission_events
+        self.disable_enforcementaction_validation = (
+            disable_enforcementaction_validation
+        )
+        self.event_recorder = event_recorder
+        self.injected_config = injected_config
+        self.service_account = (
+            f"system:serviceaccount:{get_namespace()}:{SERVICE_ACCOUNT_NAME}"
+        )
+
+    # ---- entry -------------------------------------------------------------
+
+    def handle(self, req: dict) -> AdmissionResponse:
+        t0 = time.monotonic()
+        if self._is_gk_service_account(req):
+            return _allowed("Gatekeeper does not self-manage")
+
+        is_delete = req.get("operation") == "DELETE"
+        if is_delete:
+            if req.get("oldObject") is None:
+                return _denied(
+                    "For admission webhooks registered for DELETE operations, "
+                    "please use Kubernetes v1.15.0+.",
+                    500,
+                )
+            req = dict(req)
+            req["object"] = req["oldObject"]
+
+        # dry-run validation only gates writes; deleting a gatekeeper
+        # resource must never require it to still compile/validate (an
+        # orphaned constraint would otherwise be undeletable)
+        if not is_delete:
+            user_err, err = self._validate_gatekeeper_resources(req)
+            if err is not None:
+                return _denied(err, 422 if user_err else 500)
+
+        status = RESPONSE_UNKNOWN
+        try:
+            ns = req.get("namespace") or ""
+            if self.excluder.is_namespace_excluded(WEBHOOK, ns):
+                status = RESPONSE_ALLOW
+                return _allowed(
+                    "Namespace is set to be ignored by Gatekeeper config"
+                )
+            try:
+                results = self._review(req)
+            except Exception as e:  # error executing query -> 500
+                log.exception("error executing query")
+                status = RESPONSE_ERROR
+                return _denied(str(e), 500)
+            msgs = self._get_deny_messages(results, req)
+            if msgs:
+                status = RESPONSE_DENY
+                return _denied("\n".join(msgs), 403)
+            status = RESPONSE_ALLOW
+            return _allowed()
+        finally:
+            if self.reporter is not None:
+                self.reporter.report_request(status, time.monotonic() - t0)
+
+    # ---- pieces ------------------------------------------------------------
+
+    def _is_gk_service_account(self, req: dict) -> bool:
+        user = (req.get("userInfo") or {}).get("username", "")
+        return user == self.service_account
+
+    def _validate_gatekeeper_resources(self, req: dict):
+        """-> (user_error, error_message|None)  (policy.go:310-360)."""
+        kind = req.get("kind") or {}
+        group, k = kind.get("group", ""), kind.get("kind", "")
+        obj = req.get("object")
+        if group == TEMPLATE_GROUP and k == "ConstraintTemplate":
+            try:
+                self.client.create_crd(obj)
+            except Exception as e:
+                return True, str(e)
+            return False, None
+        if group == CONSTRAINT_GROUP:
+            try:
+                self.client.validate_constraint(obj)
+            except Exception as e:
+                return True, str(e)
+            action = ((obj or {}).get("spec") or {}).get("enforcementAction")
+            if isinstance(action, str) and action:
+                if not self.disable_enforcementaction_validation:
+                    try:
+                        validate_enforcement_action(action)
+                    except EnforcementActionError as e:
+                        return False, str(e)
+            return False, None
+        return False, None
+
+    def _get_config(self) -> dict:
+        if self.injected_config is not None:
+            return self.injected_config
+        if self.kube is None:
+            return {}
+        try:
+            return self.kube.get(CONFIG_GVK, CONFIG_NAME, self.gk_namespace)
+        except NotFound:
+            return {}
+
+    def _tracing_level(self, req: dict):
+        """(trace, dump) from Config.spec.validation.traces
+        (policy.go:402-423)."""
+        cfg = parse_config(self._get_config())
+        user = (req.get("userInfo") or {}).get("username", "")
+        kind = req.get("kind") or {}
+        gvk = (kind.get("group", ""), kind.get("version", ""), kind.get("kind", ""))
+        trace = dump = False
+        for t in cfg.traces:
+            if t.user != user:
+                continue
+            if t.kind == gvk:
+                trace = True
+                if t.dump.lower() == "all":
+                    dump = True
+        return trace, dump
+
+    def _augmented_review(self, req: dict) -> AugmentedReview:
+        req = dict(req)
+        kind = req.get("kind") or {}
+        # server-side-apply namespace coercion for Namespace objects
+        # (policy.go:365-369, issue #792)
+        if kind.get("kind") == "Namespace" and kind.get("group", "") == "":
+            req["namespace"] = ""
+        ns_obj = None
+        ns = req.get("namespace") or ""
+        if ns and self.kube is not None:
+            # cached client then direct API reader (policy.go:372-385);
+            # with one API abstraction both reads collapse into this get
+            try:
+                ns_obj = self.kube.get(("", "v1", "Namespace"), ns)
+            except NotFound:
+                raise LookupError(f"namespace {ns} not found")
+        return AugmentedReview(admission_request=req, namespace=ns_obj)
+
+    def _review(self, req: dict) -> List:
+        trace, dump = self._tracing_level(req)
+        review = self._augmented_review(req)
+        resp = self.client.review(review, tracing=trace)
+        if trace:
+            log.info(resp.trace_dump())
+        if dump:
+            log.info(self.client.dump())
+        return resp.results()
+
+    def _get_deny_messages(self, results: List, req: dict) -> List[str]:
+        msgs: List[str] = []
+        resource_name = req.get("name") or ""
+        if not resource_name and isinstance(req.get("object"), dict):
+            resource_name = (
+                (req["object"].get("metadata") or {}).get("name") or ""
+            )
+        kind = req.get("kind") or {}
+        for r in results:
+            cname = (r.constraint.get("metadata") or {}).get("name", "")
+            if r.enforcement_action in (ACTION_DENY, ACTION_DRYRUN):
+                kv = {
+                    gklog.PROCESS: "admission",
+                    gklog.EVENT_TYPE: "violation",
+                    gklog.CONSTRAINT_NAME: cname,
+                    gklog.CONSTRAINT_GROUP: CONSTRAINT_GROUP,
+                    gklog.CONSTRAINT_API_VERSION: "v1beta1",
+                    gklog.CONSTRAINT_KIND: r.constraint.get("kind", ""),
+                    gklog.CONSTRAINT_ACTION: r.enforcement_action,
+                    gklog.RESOURCE_GROUP: kind.get("group", ""),
+                    gklog.RESOURCE_API_VERSION: kind.get("version", ""),
+                    gklog.RESOURCE_KIND: kind.get("kind", ""),
+                    gklog.RESOURCE_NAMESPACE: req.get("namespace", ""),
+                    gklog.RESOURCE_NAME: resource_name,
+                    gklog.REQUEST_USERNAME: (req.get("userInfo") or {}).get(
+                        "username", ""
+                    ),
+                }
+                if self.log_denies:
+                    gklog.log_event(log, "denied admission", **kv)
+                if self.emit_admission_events and self.event_recorder:
+                    dryrun = r.enforcement_action == ACTION_DRYRUN
+                    event_msg = (
+                        "Dryrun violation"
+                        if dryrun
+                        else 'Admission webhook "validation.gatekeeper.sh" denied request'
+                    )
+                    self.event_recorder(
+                        {
+                            "reason": "DryrunViolation" if dryrun else "FailedAdmission",
+                            "type": "Warning",
+                            "message": (
+                                f"{event_msg}, "
+                                f"Resource Namespace: {req.get('namespace', '')}, "
+                                f"Constraint: {cname}, Message: {r.msg}"
+                            ),
+                            "annotations": kv,
+                            "namespace": self.gk_namespace,
+                        }
+                    )
+            # only deny prompts a deny admission response (policy.go:286-288)
+            if r.enforcement_action == ACTION_DENY:
+                msgs.append(f"[denied by {cname}] {r.msg}")
+        return msgs

@@ -1,0 +1,209 @@
+"""Webhook HTTPS front end with TPU micro-batching.
+
+The reference serves /v1/admit and /v1/admitlabel from controller-runtime's
+webhook server (pkg/webhook/webhook.go:36-43, main.go:145).  Here the server
+is a threaded HTTP(S) listener whose admission path goes through a
+`MicroBatcher`: concurrent requests inside a short window coalesce into ONE
+batched device dispatch (TpuDriver.review_batch), which is how p99 stays low
+while the TPU runs at batch efficiency (SURVEY.md §7 stage 5).
+"""
+
+from __future__ import annotations
+
+import json
+import ssl
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+
+from .. import logging as gklog
+from .namespacelabel import NamespaceLabelHandler
+from .policy import AdmissionResponse, ValidationHandler
+
+log = gklog.get("webhook.server")
+
+
+class _Pending:
+    __slots__ = ("obj", "event", "result", "error")
+
+    def __init__(self, obj):
+        self.obj = obj
+        self.event = threading.Event()
+        self.result = None
+        self.error: Optional[Exception] = None
+
+
+class MicroBatcher:
+    """Client-compatible wrapper that coalesces concurrent review() calls.
+
+    A caller appends its review to the pending list; the batcher thread
+    sweeps the list every `window_s` (or immediately when `max_batch` is
+    reached) and issues one client.review_batch for the sweep.  A lone
+    request therefore pays at most `window_s` extra latency; a burst pays
+    one dispatch for the whole window.
+    """
+
+    def __init__(self, client, window_s: float = 0.002, max_batch: int = 256):
+        self._client = client
+        self.window_s = window_s
+        self.max_batch = max_batch
+        self._pending: List[_Pending] = []
+        self._cv = threading.Condition()
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._run, name="microbatcher", daemon=True
+        )
+        self._thread.start()
+
+    # anything that isn't review() passes straight through to the client
+    def __getattr__(self, name):
+        return getattr(self._client, name)
+
+    def review(self, obj, tracing: bool = False):
+        if tracing:
+            # traced requests are rare and want their own trace output;
+            # bypass the batch
+            return self._client.review(obj, tracing=True)
+        p = _Pending(obj)
+        with self._cv:
+            self._pending.append(p)
+            self._cv.notify()
+        p.event.wait()
+        if p.error is not None:
+            raise p.error
+        return p.result
+
+    def _run(self):
+        while True:
+            with self._cv:
+                while not self._pending and not self._stop:
+                    self._cv.wait(timeout=0.1)
+                if self._stop and not self._pending:
+                    return
+                # open the batching window: let concurrent arrivals join
+                if len(self._pending) < self.max_batch:
+                    self._cv.wait(timeout=self.window_s)
+                batch = self._pending[: self.max_batch]
+                self._pending = self._pending[self.max_batch:]
+            try:
+                responses = self._client.review_batch([p.obj for p in batch])
+                for p, resp in zip(batch, responses):
+                    p.result = resp
+                    p.event.set()
+            except Exception:
+                # batched failure: fall back to per-request evaluation so one
+                # poisoned review can't fail the whole window
+                for p in batch:
+                    try:
+                        p.result = self._client.review(p.obj)
+                    except Exception as e:
+                        p.error = e
+                    p.event.set()
+
+    def stop(self):
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        self._thread.join(timeout=2.0)
+
+
+class WebhookServer:
+    """HTTP(S) listener for /v1/admit + /v1/admitlabel + health endpoints."""
+
+    def __init__(
+        self,
+        validation_handler: ValidationHandler,
+        label_handler: Optional[NamespaceLabelHandler] = None,
+        port: int = 8443,
+        certfile: Optional[str] = None,
+        keyfile: Optional[str] = None,
+        readiness_check=None,  # callable -> bool (tracker.satisfied)
+    ):
+        self.validation_handler = validation_handler
+        self.label_handler = label_handler or NamespaceLabelHandler()
+        self.port = port
+        self.certfile = certfile
+        self.keyfile = keyfile
+        self.readiness_check = readiness_check
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _send_json(self, code: int, payload: dict):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _send_text(self, code: int, text: str):
+                body = text.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                # healthz/readyz (reference main.go:193-196)
+                if self.path == "/healthz":
+                    self._send_text(200, "ok")
+                elif self.path == "/readyz":
+                    ready = (
+                        outer.readiness_check() if outer.readiness_check else True
+                    )
+                    self._send_text(200 if ready else 500,
+                                    "ok" if ready else "not ready")
+                else:
+                    self._send_text(404, "not found")
+
+            def do_POST(self):
+                if self.path not in ("/v1/admit", "/v1/admitlabel"):
+                    self._send_text(404, "not found")
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    review = json.loads(self.rfile.read(length) or b"{}")
+                    req = review.get("request") or {}
+                    if self.path == "/v1/admit":
+                        resp = outer.validation_handler.handle(req)
+                    else:
+                        resp = outer.label_handler.handle(req)
+                except Exception as e:  # malformed envelope
+                    log.exception("bad admission request")
+                    resp = AdmissionResponse(False, str(e), 500)
+                    req = {}
+                self._send_json(
+                    200,
+                    {
+                        "apiVersion": "admission.k8s.io/v1beta1",
+                        "kind": "AdmissionReview",
+                        "response": resp.to_dict(uid=req.get("uid", "")),
+                    },
+                )
+
+        self._server = ThreadingHTTPServer(("0.0.0.0", self.port), Handler)
+        self.port = self._server.server_address[1]
+        if self.certfile:
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(self.certfile, self.keyfile)
+            self._server.socket = ctx.wrap_socket(
+                self._server.socket, server_side=True
+            )
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="webhook", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        if self._server:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None

@@ -1,0 +1,383 @@
+"""Webhook tests (reference parity: pkg/webhook/policy_test.go +
+namespacelabel_test.go scenarios, plus the HTTP server and micro-batcher)."""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from gatekeeper_tpu.client.client import Client
+from gatekeeper_tpu.kube.inmem import InMemoryKube
+from gatekeeper_tpu.metrics import Reporters
+from gatekeeper_tpu.metrics.views import Registry
+from gatekeeper_tpu.process.excluder import Excluder
+from gatekeeper_tpu.apis.config import MatchEntry
+from gatekeeper_tpu.webhook import (
+    IGNORE_LABEL,
+    MicroBatcher,
+    NamespaceLabelHandler,
+    ValidationHandler,
+    WebhookServer,
+)
+
+from .test_controllers import CONSTRAINT, TEMPLATE
+
+NS_GVK = ("", "v1", "Namespace")
+
+
+def make_handler(**kw):
+    client = Client()
+    kube = InMemoryKube()
+    handler = ValidationHandler(client, kube=kube, **kw)
+    return handler, client, kube
+
+
+def ns_request(name="demo", labels=None, user="alice", operation="CREATE"):
+    obj = {
+        "apiVersion": "v1",
+        "kind": "Namespace",
+        "metadata": {"name": name, "labels": labels or {}},
+    }
+    return {
+        "uid": "uid-1",
+        "kind": {"group": "", "version": "v1", "kind": "Namespace"},
+        "name": name,
+        "namespace": "",
+        "operation": operation,
+        "userInfo": {"username": user},
+        "object": obj,
+    }
+
+
+def pod_request(name="p", namespace="default", labels=None, operation="CREATE"):
+    obj = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": namespace,
+                     "labels": labels or {}},
+    }
+    return {
+        "uid": "uid-2",
+        "kind": {"group": "", "version": "v1", "kind": "Pod"},
+        "name": name,
+        "namespace": namespace,
+        "operation": operation,
+        "userInfo": {"username": "alice"},
+        "object": obj,
+    }
+
+
+class TestValidationHandler:
+    def test_gk_service_account_self_manage_bypass(self):
+        handler, client, kube = make_handler()
+        req = ns_request(
+            user="system:serviceaccount:gatekeeper-system:gatekeeper-admin"
+        )
+        resp = handler.handle(req)
+        assert resp.allowed
+        assert "self-manage" in resp.message
+
+    def test_delete_without_old_object_500(self):
+        handler, client, kube = make_handler()
+        req = ns_request(operation="DELETE")
+        req["object"] = None
+        req["oldObject"] = None
+        resp = handler.handle(req)
+        assert not resp.allowed and resp.code == 500
+
+    def test_delete_uses_old_object(self):
+        handler, client, kube = make_handler()
+        client.add_template(TEMPLATE)
+        client.add_constraint(CONSTRAINT)
+        req = ns_request(operation="DELETE")
+        req["oldObject"] = req.pop("object")
+        resp = handler.handle(req)
+        # old object has no gatekeeper label -> denied
+        assert not resp.allowed and resp.code == 403
+
+    def test_bad_template_is_user_error_422(self):
+        handler, client, kube = make_handler()
+        req = {
+            "uid": "t",
+            "kind": {"group": "templates.gatekeeper.sh", "version": "v1beta1",
+                     "kind": "ConstraintTemplate"},
+            "operation": "CREATE",
+            "userInfo": {"username": "alice"},
+            "object": {
+                "apiVersion": "templates.gatekeeper.sh/v1beta1",
+                "kind": "ConstraintTemplate",
+                "metadata": {"name": "badtemplate"},
+                "spec": {
+                    "crd": {"spec": {"names": {"kind": "BadTemplate"}}},
+                    "targets": [
+                        {"target": "admission.k8s.gatekeeper.sh",
+                         "rego": "not rego at all"}
+                    ],
+                },
+            },
+        }
+        resp = handler.handle(req)
+        assert not resp.allowed and resp.code == 422
+
+    def test_good_template_allowed(self):
+        handler, client, kube = make_handler()
+        req = {
+            "uid": "t",
+            "kind": {"group": "templates.gatekeeper.sh", "version": "v1beta1",
+                     "kind": "ConstraintTemplate"},
+            "operation": "CREATE",
+            "userInfo": {"username": "alice"},
+            "object": TEMPLATE,
+        }
+        assert handler.handle(req).allowed
+
+    def test_constraint_without_template_is_user_error(self):
+        handler, client, kube = make_handler()
+        req = {
+            "uid": "c",
+            "kind": {"group": "constraints.gatekeeper.sh", "version": "v1beta1",
+                     "kind": "K8sRequiredLabels"},
+            "operation": "CREATE",
+            "userInfo": {"username": "alice"},
+            "object": CONSTRAINT,
+        }
+        resp = handler.handle(req)
+        assert not resp.allowed and resp.code == 422
+
+    def test_bad_enforcement_action_500(self):
+        handler, client, kube = make_handler()
+        client.add_template(TEMPLATE)
+        bad = json.loads(json.dumps(CONSTRAINT))
+        bad["spec"]["enforcementAction"] = "everything-is-fine"
+        req = {
+            "uid": "c",
+            "kind": {"group": "constraints.gatekeeper.sh", "version": "v1beta1",
+                     "kind": "K8sRequiredLabels"},
+            "operation": "CREATE",
+            "userInfo": {"username": "alice"},
+            "object": bad,
+        }
+        resp = handler.handle(req)
+        assert not resp.allowed and resp.code == 500
+        # validation disabled -> allowed
+        handler.disable_enforcementaction_validation = True
+        assert handler.handle(req).allowed
+
+    def test_excluded_namespace_allowed(self):
+        excluder = Excluder()
+        excluder.add([MatchEntry(excluded_namespaces=["kube-system"],
+                                 processes=["webhook"])])
+        handler, client, kube = make_handler(excluder=excluder)
+        client.add_template(TEMPLATE)
+        client.add_constraint(CONSTRAINT)
+        resp = handler.handle(pod_request(namespace="kube-system"))
+        assert resp.allowed
+        assert "ignored" in resp.message
+
+    def test_deny_and_allow(self):
+        handler, client, kube = make_handler()
+        client.add_template(TEMPLATE)
+        client.add_constraint(CONSTRAINT)
+        resp = handler.handle(ns_request())
+        assert not resp.allowed and resp.code == 403
+        assert "[denied by ns-must-have-gk]" in resp.message
+        ok = handler.handle(ns_request(labels={"gatekeeper": "yes"}))
+        assert ok.allowed
+
+    def test_dryrun_allows_but_reports(self):
+        events = []
+        handler, client, kube = make_handler(
+            emit_admission_events=True, event_recorder=events.append
+        )
+        client.add_template(TEMPLATE)
+        dry = json.loads(json.dumps(CONSTRAINT))
+        dry["spec"]["enforcementAction"] = "dryrun"
+        client.add_constraint(dry)
+        resp = handler.handle(ns_request())
+        assert resp.allowed
+        assert len(events) == 1
+        assert events[0]["reason"] == "DryrunViolation"
+
+    def test_metrics_reported(self):
+        reporter = Reporters(Registry())
+        handler, client, kube = make_handler(reporter=reporter)
+        client.add_template(TEMPLATE)
+        client.add_constraint(CONSTRAINT)
+        handler.handle(ns_request())
+        handler.handle(ns_request(labels={"gatekeeper": "x"}))
+        rows = reporter.registry.view_rows("request_count")
+        assert rows[("deny",)] == 1
+        assert rows[("allow",)] == 1
+
+    def test_namespace_augmentation_missing_namespace_500(self):
+        handler, client, kube = make_handler()
+        client.add_template(TEMPLATE)
+        client.add_constraint(CONSTRAINT)
+        resp = handler.handle(pod_request(namespace="ghost"))
+        assert not resp.allowed and resp.code == 500
+
+    def test_namespace_kind_coercion_skips_ns_lookup(self):
+        handler, client, kube = make_handler()
+        client.add_template(TEMPLATE)
+        client.add_constraint(CONSTRAINT)
+        req = ns_request()
+        # server-side apply sets namespace == name for Namespace objects;
+        # coercion must clear it instead of failing the ns lookup
+        req["namespace"] = "demo"
+        resp = handler.handle(req)
+        assert resp.code == 403  # evaluated, not errored
+
+    def test_namespace_selector_uses_cluster_namespace(self):
+        handler, client, kube = make_handler()
+        client.add_template(TEMPLATE)
+        kube.create({
+            "apiVersion": "v1", "kind": "Namespace",
+            "metadata": {"name": "prod", "labels": {"env": "prod"}},
+        })
+        c = json.loads(json.dumps(CONSTRAINT))
+        c["spec"]["match"] = {
+            "kinds": [{"apiGroups": [""], "kinds": ["Pod"]}],
+            "namespaceSelector": {"matchLabels": {"env": "prod"}},
+        }
+        client.add_constraint(c)
+        resp = handler.handle(pod_request(namespace="prod"))
+        assert not resp.allowed  # matched via augmented namespace
+
+    def test_trace_config(self, capsys):
+        cfg = {
+            "spec": {
+                "validation": {
+                    "traces": [
+                        {"user": "alice",
+                         "kind": {"group": "", "version": "v1",
+                                  "kind": "Namespace"}}
+                    ]
+                }
+            }
+        }
+        handler, client, kube = make_handler(injected_config=cfg)
+        client.add_template(TEMPLATE)
+        client.add_constraint(CONSTRAINT)
+        trace, dump = handler._tracing_level(ns_request())
+        assert trace and not dump
+        trace, dump = handler._tracing_level(pod_request())
+        assert not trace
+
+
+class TestNamespaceLabelHandler:
+    def test_delete_always_allowed(self):
+        h = NamespaceLabelHandler()
+        assert h.handle({"operation": "DELETE"}).allowed
+
+    def test_non_namespace_allowed(self):
+        h = NamespaceLabelHandler()
+        resp = h.handle(pod_request(labels={IGNORE_LABEL: "1"}))
+        assert resp.allowed and resp.message == "Not a namespace"
+
+    def test_ignore_label_denied_for_non_exempt(self):
+        h = NamespaceLabelHandler()
+        resp = h.handle(ns_request(labels={IGNORE_LABEL: "1"}))
+        assert not resp.allowed and resp.code == 403
+
+    def test_exempt_namespace_allowed(self):
+        h = NamespaceLabelHandler(exempt_namespaces=["demo"])
+        resp = h.handle(ns_request(labels={IGNORE_LABEL: "1"}))
+        assert resp.allowed
+
+    def test_plain_namespace_allowed(self):
+        h = NamespaceLabelHandler()
+        assert h.handle(ns_request()).allowed
+
+
+class TestMicroBatcher:
+    def test_batches_concurrent_requests(self):
+        client = Client()
+        client.add_template(TEMPLATE)
+        client.add_constraint(CONSTRAINT)
+
+        calls = []
+        orig = client.review_batch
+
+        def counting_batch(objs, tracing=False):
+            calls.append(len(objs))
+            return orig(objs, tracing=tracing)
+
+        client.review_batch = counting_batch
+        mb = MicroBatcher(client, window_s=0.05)
+        try:
+            results = [None] * 8
+            reqs = [ns_request(name=f"ns-{i}") for i in range(8)]
+
+            def call(i):
+                from gatekeeper_tpu.target.target import AugmentedReview
+                results[i] = mb.review(AugmentedReview(admission_request=reqs[i]))
+
+            threads = [threading.Thread(target=call, args=(i,)) for i in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert all(len(r.results()) == 1 for r in results)
+            # coalesced: strictly fewer dispatches than requests
+            assert sum(calls) == 8 and len(calls) < 8
+        finally:
+            mb.stop()
+
+
+class TestWebhookServer:
+    def _post(self, port, path, request):
+        body = json.dumps({
+            "apiVersion": "admission.k8s.io/v1beta1",
+            "kind": "AdmissionReview",
+            "request": request,
+        }).encode()
+        r = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(r, timeout=10) as resp:
+            return json.loads(resp.read())
+
+    def test_end_to_end_admit(self):
+        handler, client, kube = make_handler()
+        client.add_template(TEMPLATE)
+        client.add_constraint(CONSTRAINT)
+        srv = WebhookServer(handler, port=0)
+        srv.start()
+        try:
+            out = self._post(srv.port, "/v1/admit", ns_request())
+            assert out["response"]["allowed"] is False
+            assert out["response"]["status"]["code"] == 403
+            assert out["response"]["uid"] == "uid-1"
+            ok = self._post(srv.port, "/v1/admit",
+                            ns_request(labels={"gatekeeper": "x"}))
+            assert ok["response"]["allowed"] is True
+        finally:
+            srv.stop()
+
+    def test_admitlabel_and_health(self):
+        handler, client, kube = make_handler()
+        srv = WebhookServer(
+            handler, NamespaceLabelHandler(), port=0,
+            readiness_check=lambda: False,
+        )
+        srv.start()
+        try:
+            out = self._post(srv.port, "/v1/admitlabel",
+                             ns_request(labels={IGNORE_LABEL: "1"}))
+            assert out["response"]["allowed"] is False
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/healthz", timeout=5
+            ) as r:
+                assert r.status == 200
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/readyz", timeout=5
+                )
+                ready_code = 200
+            except urllib.error.HTTPError as e:
+                ready_code = e.code
+            assert ready_code == 500
+        finally:
+            srv.stop()
