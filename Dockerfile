@@ -1,0 +1,17 @@
+# gatekeeper-tpu container image.
+# The runtime is Python + JAX with the TPU runtime provided by the base
+# image (libtpu comes with the TPU VM image family); no build stage is
+# needed because the compute path JIT-compiles via XLA at startup.
+FROM python:3.11-slim
+
+WORKDIR /app
+COPY gatekeeper_tpu/ /app/gatekeeper_tpu/
+COPY bench.py /app/
+
+# jax[tpu] bundles libtpu so the container actually reaches the reserved
+# chip; plain `jax` would silently fall back to CPU
+RUN pip install --no-cache-dir "jax[tpu]" "numpy" "cryptography" "pyyaml" \
+      -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
+
+USER 65532:65532
+ENTRYPOINT ["python", "-m", "gatekeeper_tpu"]

@@ -1,0 +1,29 @@
+# gatekeeper-tpu build/test entry points (the reference's Makefile roles:
+# native-test, docker-build, deploy).
+
+IMG ?= gatekeeper-tpu:latest
+NAMESPACE ?= gatekeeper-system
+
+.PHONY: test
+test:
+	python -m pytest tests/ -q
+
+.PHONY: bench
+bench:
+	python bench.py
+
+.PHONY: docker-build
+docker-build:
+	docker build -t $(IMG) .
+
+.PHONY: deploy
+deploy:
+	kubectl apply -f deploy/gatekeeper.yaml
+
+.PHONY: uninstall
+uninstall:
+	kubectl delete -f deploy/gatekeeper.yaml --ignore-not-found
+
+.PHONY: lint
+lint:
+	python -m compileall -q gatekeeper_tpu

@@ -46,7 +46,12 @@ class Manager:
         self.deps = deps
         self.switch = deps.switch or ControllerSwitch()
         self.operations = deps.operations or ops_mod.get()
-        self.watch_manager = WatchManager(deps.kube)
+        self.watch_manager = WatchManager(
+            deps.kube,
+            metrics_hook=(
+                deps.reporter.report_gvk_count if deps.reporter else None
+            ),
+        )
         self.controllers: List = []
 
         wm = self.watch_manager

@@ -1,0 +1,326 @@
+"""Process entry point and wiring (reference main.go).
+
+Flag surface mirrors the reference's ~25 process flags (main.go:82-93 plus
+the per-package flags); `App` performs setupControllers' construction order
+(main.go:198-294): cert bootstrap gate -> engine client -> watch manager +
+readiness tracker -> controllers -> webhook / audit by operation role ->
+metrics exporter -> health endpoints.
+
+Run standalone:  python -m gatekeeper_tpu [flags]
+The API store is in-memory (the framework's API-server abstraction,
+kube/inmem.py); a real-cluster client implementing the same surface plugs
+into `App(kube=...)`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+
+from . import logging as gklog
+from . import operations as ops_mod
+from .audit import AuditManager
+from .certs import CertRotator
+from .client.client import Client
+from .client.drivers import InterpDriver
+from .controllers import Dependencies, Manager
+from .kube.inmem import InMemoryKube
+from .metrics import MetricsExporter, Reporters
+from .process.excluder import Excluder
+from .readiness.tracker import Tracker
+from .upgrade import UpgradeManager
+from .util import get_id, get_namespace
+from .webhook import (
+    MicroBatcher,
+    NamespaceLabelHandler,
+    ValidationHandler,
+    WebhookServer,
+)
+
+log = gklog.get("main")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="gatekeeper-tpu",
+        description="TPU-native policy controller (gatekeeper-class)",
+    )
+    # main.go:83-92
+    p.add_argument("--log-level", default="INFO")
+    p.add_argument("--health-addr", default=":9090",
+                   help="address for the health endpoint")
+    p.add_argument("--port", type=int, default=8443,
+                   help="webhook server port")
+    p.add_argument("--cert-dir", default="/tmp/gatekeeper-certs")
+    p.add_argument("--disable-cert-rotation", action="store_true")
+    p.add_argument("--enable-pprof", action="store_true")
+    p.add_argument("--pprof-port", type=int, default=6060)
+    # operations.go:77
+    p.add_argument("--operation", action="append", default=[],
+                   choices=list(ops_mod.ALL_OPERATIONS),
+                   help="operation roles for this process (repeatable; "
+                        "default all)")
+    # metrics exporter.go:14-15
+    p.add_argument("--metrics-backend", default="Prometheus")
+    p.add_argument("--prometheus-port", type=int, default=8888)
+    # webhook policy.go:74-76, namespacelabel.go:25
+    p.add_argument("--log-denies", action="store_true")
+    p.add_argument("--emit-admission-events", action="store_true")
+    p.add_argument("--disable-enforcementaction-validation",
+                   action="store_true")
+    p.add_argument("--exempt-namespace", action="append", default=[],
+                   help="namespaces allowed to set the ignore label "
+                        "(repeatable)")
+    # audit manager.go:48-53
+    p.add_argument("--audit-interval", type=float, default=60.0)
+    p.add_argument("--constraint-violations-limit", type=int, default=20)
+    p.add_argument("--audit-chunk-size", type=int, default=0)
+    p.add_argument("--audit-from-cache", action="store_true")
+    p.add_argument("--emit-audit-events", action="store_true")
+    p.add_argument("--audit-match-kind-only", action="store_true")
+    # TPU-native addition: which evaluation backend
+    p.add_argument("--driver", choices=["interp", "tpu"], default="tpu",
+                   help="evaluation backend (tpu = JAX/XLA batched)")
+    p.add_argument("--webhook-batch-window-ms", type=float, default=2.0,
+                   help="micro-batching window for admission reviews")
+    return p
+
+
+def make_event_recorder(kube: InMemoryKube, component: str):
+    """K8s Event emission (the reference's record.EventRecorder)."""
+
+    def record(event: dict):
+        obj = {
+            "apiVersion": "v1",
+            "kind": "Event",
+            "metadata": {
+                "name": f"gatekeeper-{uuid.uuid4().hex[:12]}",
+                "namespace": event.get("namespace", get_namespace()),
+                "annotations": event.get("annotations") or {},
+            },
+            "type": event.get("type", "Warning"),
+            "reason": event.get("reason", ""),
+            "message": event.get("message", ""),
+            "source": {"component": component},
+        }
+        try:
+            kube.create(obj)
+        except Exception:
+            log.exception("failed to record event")
+
+    return record
+
+
+class HealthServer:
+    """Standalone /healthz + /readyz listener (main.go:193-196) for pods
+    that don't run the webhook server."""
+
+    def __init__(self, port: int, readiness_check=None):
+        self.port = port
+        self.readiness_check = readiness_check
+        self._server: Optional[ThreadingHTTPServer] = None
+
+    def start(self):
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    code, body = 200, b"ok"
+                elif self.path == "/readyz":
+                    ready = (
+                        outer.readiness_check()
+                        if outer.readiness_check else True
+                    )
+                    code, body = (200, b"ok") if ready else (500, b"not ready")
+                else:
+                    code, body = 404, b"not found"
+                self.send_response(code)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._server = ThreadingHTTPServer(("0.0.0.0", self.port), Handler)
+        self.port = self._server.server_address[1]
+        threading.Thread(
+            target=self._server.serve_forever, name="health", daemon=True
+        ).start()
+
+    def stop(self):
+        if self._server:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+
+class App:
+    """The composed process (main.go main + setupControllers)."""
+
+    def __init__(self, args=None, kube: Optional[InMemoryKube] = None):
+        if args is None or isinstance(args, list):
+            args = build_parser().parse_args(args or [])
+        self.args = args
+        gklog.setup(args.log_level)
+        self.kube = kube or InMemoryKube()
+        self.operations = ops_mod.Operations(args.operation or None)
+        self.reporters = Reporters()
+
+        # evaluation backend behind the Driver seam
+        if args.driver == "tpu":
+            from .ops.driver import TpuDriver
+
+            driver = TpuDriver()
+        else:
+            driver = InterpDriver()
+        self.client = Client(driver=driver)
+
+        self.excluder = Excluder()
+        self.tracker = Tracker()
+        self.rotator: Optional[CertRotator] = None
+        if not args.disable_cert_rotation:
+            self.rotator = CertRotator(self.kube)
+
+        self.manager = Manager(
+            Dependencies(
+                kube=self.kube,
+                client=self.client,
+                excluder=self.excluder,
+                tracker=self.tracker,
+                operations=self.operations,
+                pod_id=get_id(),
+                namespace=get_namespace(),
+                reporter=self.reporters,
+            )
+        )
+        self.upgrade = UpgradeManager(self.kube)
+        self.webhook_server: Optional[WebhookServer] = None
+        self.health_server: Optional[HealthServer] = None
+        self.audit_manager: Optional[AuditManager] = None
+        self.metrics_exporter: Optional[MetricsExporter] = None
+        self.micro_batcher: Optional[MicroBatcher] = None
+
+    def start(self):
+        args = self.args
+        # cert bootstrap gates everything (main.go:219-220); write_cert_files
+        # runs ensure_certs synchronously, so readiness is set before start()
+        # spins the refresh thread
+        certfile = keyfile = None
+        if self.rotator is not None:
+            certfile, keyfile = self.rotator.write_cert_files(args.cert_dir)
+
+            def _on_refresh(secret):
+                cf, kf = self.rotator.write_cert_files(args.cert_dir, secret)
+                if self.webhook_server is not None:
+                    self.webhook_server.reload_certs(cf, kf)
+
+            self.rotator.on_refresh = _on_refresh
+            self.rotator.start()
+
+        self.upgrade.upgrade()  # storage-version migration before controllers
+        self.tracker.run(self.kube)
+        self.manager.start()
+
+        if self.operations.is_assigned(ops_mod.WEBHOOK):
+            self.micro_batcher = MicroBatcher(
+                self.client, window_s=args.webhook_batch_window_ms / 1000.0
+            )
+            handler = ValidationHandler(
+                self.micro_batcher,
+                kube=self.kube,
+                excluder=self.excluder,
+                reporter=self.reporters,
+                gk_namespace=get_namespace(),
+                log_denies=args.log_denies,
+                emit_admission_events=args.emit_admission_events,
+                disable_enforcementaction_validation=(
+                    args.disable_enforcementaction_validation
+                ),
+                event_recorder=make_event_recorder(
+                    self.kube, "gatekeeper-webhook"
+                ),
+            )
+            self.webhook_server = WebhookServer(
+                handler,
+                NamespaceLabelHandler(args.exempt_namespace),
+                port=args.port,
+                certfile=certfile,
+                keyfile=keyfile,
+                readiness_check=self.tracker.satisfied,
+            )
+            self.webhook_server.start()
+        else:
+            health_port = int(args.health_addr.rsplit(":", 1)[-1] or 0)
+            self.health_server = HealthServer(
+                health_port, readiness_check=self.tracker.satisfied
+            )
+            self.health_server.start()
+
+        if self.operations.is_assigned(ops_mod.AUDIT):
+            self.audit_manager = AuditManager(
+                self.kube,
+                self.client,
+                excluder=self.excluder,
+                reporter=self.reporters,
+                interval_s=args.audit_interval,
+                violations_limit=args.constraint_violations_limit,
+                chunk_size=args.audit_chunk_size,
+                from_cache=args.audit_from_cache,
+                match_kind_only=args.audit_match_kind_only,
+                emit_audit_events=args.emit_audit_events,
+                event_recorder=make_event_recorder(
+                    self.kube, "gatekeeper-audit"
+                ),
+                gk_namespace=get_namespace(),
+            )
+            self.audit_manager.start()
+
+        self.metrics_exporter = MetricsExporter(
+            port=args.prometheus_port, registry=self.reporters.registry
+        )
+        self.metrics_exporter.start()
+        log.info(
+            "gatekeeper-tpu started",
+            extra={"kv": {
+                "operations": self.operations.assigned_string_list(),
+                "driver": args.driver,
+            }},
+        )
+
+    def stop(self):
+        for component in (
+            self.audit_manager,
+            self.webhook_server,
+            self.health_server,
+            self.metrics_exporter,
+            self.micro_batcher,
+            self.rotator,
+        ):
+            if component is not None:
+                component.stop()
+        self.manager.stop()
+
+    def run_forever(self):
+        self.start()
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            self.stop()
+
+
+def main(argv: Optional[List[str]] = None):
+    App(build_parser().parse_args(argv)).run_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -127,6 +127,14 @@ class WebhookServer:
         self.readiness_check = readiness_check
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        self._ssl_context: Optional[ssl.SSLContext] = None
+
+    def reload_certs(self, certfile: str, keyfile: str):
+        """Hot-swap the serving cert: new handshakes pick up the reloaded
+        chain (cert rotation must not require a listener restart)."""
+        self.certfile, self.keyfile = certfile, keyfile
+        if self._ssl_context is not None:
+            self._ssl_context.load_cert_chain(certfile, keyfile)
 
     def start(self):
         outer = self
@@ -194,6 +202,7 @@ class WebhookServer:
         if self.certfile:
             ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
             ctx.load_cert_chain(self.certfile, self.keyfile)
+            self._ssl_context = ctx
             self._server.socket = ctx.wrap_socket(
                 self._server.socket, server_side=True
             )

@@ -1,0 +1,129 @@
+"""Full-process integration: App wiring (reference main.go setup order),
+driving the whole stack through the API store exactly as a cluster would."""
+
+import json
+import ssl
+import time
+import urllib.request
+
+from gatekeeper_tpu.kube.inmem import InMemoryKube
+from gatekeeper_tpu.main import App, build_parser
+
+from .test_controllers import CONSTRAINT, TEMPLATE
+
+CGVK = ("constraints.gatekeeper.sh", "v1beta1", "K8sRequiredLabels")
+
+
+def make_app(extra_flags=None, kube=None):
+    flags = [
+        "--driver", "interp",
+        "--port", "0",
+        "--prometheus-port", "0",
+        "--health-addr", ":0",
+        "--audit-interval", "0.1",
+        "--cert-dir", "/tmp/gk-test-certs",
+    ] + (extra_flags or [])
+    return App(build_parser().parse_args(flags), kube=kube)
+
+
+def _post_admit(app, request):
+    body = json.dumps({"request": request}).encode()
+    ctx = ssl.create_default_context()
+    ctx.check_hostname = False
+    ctx.verify_mode = ssl.CERT_NONE
+    r = urllib.request.Request(
+        f"https://127.0.0.1:{app.webhook_server.port}/v1/admit", data=body
+    )
+    with urllib.request.urlopen(r, context=ctx, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+class TestApp:
+    def test_full_stack(self):
+        kube = InMemoryKube()
+        app = make_app(kube=kube)
+        app.start()
+        try:
+            # template + constraint arrive via the API store, ingested by
+            # the controllers
+            kube.create(json.loads(json.dumps(TEMPLATE)))
+            assert app.manager.drain()
+            kube.create(json.loads(json.dumps(CONSTRAINT)))
+            assert app.manager.drain()
+            assert app.client.templates() == ["K8sRequiredLabels"]
+
+            # webhook over TLS denies a bad namespace
+            out = _post_admit(app, {
+                "uid": "u1",
+                "kind": {"group": "", "version": "v1", "kind": "Namespace"},
+                "name": "bad-ns", "namespace": "",
+                "operation": "CREATE",
+                "userInfo": {"username": "alice"},
+                "object": {"apiVersion": "v1", "kind": "Namespace",
+                           "metadata": {"name": "bad-ns", "labels": {}}},
+            })
+            assert out["response"]["allowed"] is False
+
+            # audit loop writes status violations
+            kube.create({"apiVersion": "v1", "kind": "Namespace",
+                         "metadata": {"name": "unlabeled"}})
+            deadline = time.monotonic() + 10
+            st = {}
+            while time.monotonic() < deadline:
+                st = kube.get(CGVK, "ns-must-have-gk").get("status") or {}
+                if st.get("violations"):
+                    break
+                time.sleep(0.05)
+            assert any(v["name"] == "unlabeled" for v in st["violations"])
+
+            # metrics endpoint live
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{app.metrics_exporter.port}/metrics",
+                timeout=5,
+            ) as r:
+                text = r.read().decode()
+            assert "gatekeeper_request_count" in text
+            assert "gatekeeper_audit_duration_seconds" in text
+
+            # readiness
+            assert app.tracker.wait_satisfied(timeout=5)
+            with urllib.request.urlopen(
+                urllib.request.Request(
+                    f"https://127.0.0.1:{app.webhook_server.port}/readyz"
+                ),
+                context=ssl._create_unverified_context(),
+                timeout=5,
+            ) as r:
+                assert r.status == 200
+        finally:
+            app.stop()
+
+    def test_audit_only_role(self):
+        kube = InMemoryKube()
+        app = make_app(extra_flags=["--operation", "audit"], kube=kube)
+        app.start()
+        try:
+            assert app.webhook_server is None
+            assert app.audit_manager is not None
+            assert app.health_server is not None
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{app.health_server.port}/healthz",
+                timeout=5,
+            ) as r:
+                assert r.status == 200
+        finally:
+            app.stop()
+
+    def test_upgrade_runs_before_controllers(self):
+        kube = InMemoryKube()
+        old = json.loads(json.dumps(TEMPLATE))
+        old["apiVersion"] = "templates.gatekeeper.sh/v1alpha1"
+        kube.create(old)
+        app = make_app(kube=kube)
+        app.start()
+        try:
+            assert app.manager.drain()
+            # migrated to v1beta1 and ingested
+            assert app.client.templates() == ["K8sRequiredLabels"]
+        finally:
+            app.stop()

@@ -1,0 +1,209 @@
+"""Auxiliary subsystems: syncutil, upgrade manager, cert rotation."""
+
+import ssl
+import threading
+import time
+import urllib.request
+
+from gatekeeper_tpu.certs import CertRotator
+from gatekeeper_tpu.certs.rotator import SECRET_GVK, VWC_GVK, cert_expiry
+from gatekeeper_tpu.kube.inmem import InMemoryKube
+from gatekeeper_tpu.syncutil import SingleRunner, SyncBool, retry_with_backoff
+from gatekeeper_tpu.upgrade import UpgradeManager
+
+
+class TestSyncUtil:
+    def test_syncbool(self):
+        b = SyncBool()
+        assert not b.get()
+        b.set(True)
+        assert b.get()
+
+    def test_single_runner_keys_are_single_use(self):
+        runner = SingleRunner()
+        ran = []
+
+        def work(stop):
+            ran.append(1)
+            stop.wait(timeout=5)
+
+        assert runner.schedule("k", work)
+        assert not runner.schedule("k", work)  # silently ignored
+        runner.cancel("k")
+        runner.wait(timeout=2)
+        assert ran == [1]
+
+    def test_single_runner_cancel_unblocks(self):
+        runner = SingleRunner()
+        finished = threading.Event()
+
+        def work(stop):
+            stop.wait(timeout=30)
+            finished.set()
+
+        runner.schedule("x", work)
+        t0 = time.monotonic()
+        runner.cancel("x")
+        assert finished.wait(timeout=2)
+        assert time.monotonic() - t0 < 2
+
+    def test_retry_with_backoff(self):
+        attempts = []
+
+        def fn():
+            attempts.append(1)
+            return len(attempts) >= 3
+
+        assert retry_with_backoff(fn, initial=0.001)
+        assert len(attempts) == 3
+        attempts.clear()
+        assert not retry_with_backoff(lambda: False, initial=0.001, steps=3)
+
+
+class TestUpgradeManager:
+    def test_migrates_v1alpha1(self):
+        kube = InMemoryKube()
+        kube.create({
+            "apiVersion": "constraints.gatekeeper.sh/v1alpha1",
+            "kind": "K8sRequiredLabels",
+            "metadata": {"name": "old-one"},
+            "spec": {"parameters": {"labels": ["a"]}},
+        })
+        kube.create({
+            "apiVersion": "templates.gatekeeper.sh/v1alpha1",
+            "kind": "ConstraintTemplate",
+            "metadata": {"name": "old-template"},
+            "spec": {},
+        })
+        n = UpgradeManager(kube).upgrade()
+        assert n == 2
+        old = kube.list(("constraints.gatekeeper.sh", "v1alpha1",
+                         "K8sRequiredLabels"))
+        assert old == []
+        new = kube.get(("constraints.gatekeeper.sh", "v1beta1",
+                        "K8sRequiredLabels"), "old-one")
+        assert new["spec"]["parameters"] == {"labels": ["a"]}
+        assert new["apiVersion"] == "constraints.gatekeeper.sh/v1beta1"
+
+    def test_existing_new_version_wins(self):
+        kube = InMemoryKube()
+        kube.create({
+            "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+            "kind": "K",
+            "metadata": {"name": "x"},
+            "spec": {"new": True},
+        })
+        kube.create({
+            "apiVersion": "constraints.gatekeeper.sh/v1alpha1",
+            "kind": "K",
+            "metadata": {"name": "x"},
+            "spec": {"old": True},
+        })
+        UpgradeManager(kube).upgrade()
+        kept = kube.get(("constraints.gatekeeper.sh", "v1beta1", "K"), "x")
+        assert kept["spec"] == {"new": True}
+
+
+class TestCertRotator:
+    def test_generates_secret_and_injects_bundle(self):
+        kube = InMemoryKube()
+        kube.create({
+            "apiVersion": "admissionregistration.k8s.io/v1",
+            "kind": "ValidatingWebhookConfiguration",
+            "metadata":
+                {"name": "gatekeeper-validating-webhook-configuration"},
+            "webhooks": [
+                {"name": "validation.gatekeeper.sh", "clientConfig": {}},
+                {"name": "check-ignore-label.gatekeeper.sh",
+                 "clientConfig": {}},
+            ],
+        })
+        rot = CertRotator(kube)
+        assert not rot.is_ready.is_set()
+        rot.ensure_certs()
+        assert rot.is_ready.is_set()
+        secret = kube.get(SECRET_GVK, rot.secret_name, rot.namespace)
+        data = secret["stringData"]
+        assert data["tls.crt"].startswith("-----BEGIN CERTIFICATE")
+        vwc = kube.get(VWC_GVK, "gatekeeper-validating-webhook-configuration")
+        assert all(w["clientConfig"]["caBundle"] for w in vwc["webhooks"])
+
+    def test_valid_secret_not_regenerated(self):
+        kube = InMemoryKube()
+        rot = CertRotator(kube)
+        s1 = rot.ensure_certs()
+        s2 = rot.ensure_certs()
+        assert s1["stringData"]["tls.crt"] == s2["stringData"]["tls.crt"]
+
+    def test_expiring_cert_refreshed(self):
+        kube = InMemoryKube()
+        rot = CertRotator(kube)
+        secret = rot.ensure_certs()
+        # corrupt the cert: forces regeneration
+        secret["stringData"]["tls.crt"] = "garbage"
+        kube.update(secret)
+        s2 = rot.ensure_certs()
+        assert s2["stringData"]["tls.crt"].startswith("-----BEGIN CERTIFICATE")
+        assert cert_expiry(s2["stringData"]["tls.crt"].encode())
+
+    def test_tls_webhook_server(self, tmp_path):
+        """End-to-end: rotator-issued certs serve real TLS."""
+        from gatekeeper_tpu.client.client import Client
+        from gatekeeper_tpu.webhook import ValidationHandler, WebhookServer
+
+        kube = InMemoryKube()
+        rot = CertRotator(kube)
+        certfile, keyfile = rot.write_cert_files(str(tmp_path))
+        handler = ValidationHandler(Client(), kube=kube)
+        srv = WebhookServer(handler, port=0, certfile=certfile, keyfile=keyfile)
+        srv.start()
+        try:
+            ctx = ssl.create_default_context()
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+            with urllib.request.urlopen(
+                f"https://127.0.0.1:{srv.port}/healthz", context=ctx, timeout=5
+            ) as r:
+                assert r.status == 200
+        finally:
+            srv.stop()
+
+    def test_refresh_reuses_valid_ca(self):
+        import datetime
+        from gatekeeper_tpu.certs import rotator as rot_mod
+
+        kube = InMemoryKube()
+        rot = CertRotator(kube)
+        s1 = rot.ensure_certs()
+        ca1 = s1["stringData"]["ca.crt"]
+        # hook installed after bootstrap, as App wires it
+        refreshed = []
+        rot.on_refresh = lambda s: refreshed.append(s)
+        # expire only the serving cert by shrinking its validity window
+        old_validity = rot_mod.CERT_VALIDITY
+        try:
+            # re-issue a serving cert that is inside the refresh margin
+            rot_mod.CERT_VALIDITY = datetime.timedelta(days=1)
+            tls_crt, tls_key = rot_mod.generate_server_cert(
+                ca1.encode(), s1["stringData"]["ca.key"].encode(),
+                rot.dns_names,
+            )
+            s1["stringData"]["tls.crt"] = tls_crt.decode()
+            s1["stringData"]["tls.key"] = tls_key.decode()
+            kube.update(s1)
+        finally:
+            rot_mod.CERT_VALIDITY = old_validity
+        s2 = rot.ensure_certs()
+        # serving cert re-signed, CA unchanged (caBundle stability)
+        assert s2["stringData"]["ca.crt"] == ca1
+        assert s2["stringData"]["tls.crt"] != s1["stringData"]["tls.crt"]
+        assert len(refreshed) == 1
+
+    def test_key_file_permissions(self, tmp_path):
+        import os
+
+        kube = InMemoryKube()
+        rot = CertRotator(kube)
+        certfile, keyfile = rot.write_cert_files(str(tmp_path / "certs"))
+        assert oct(os.stat(keyfile).st_mode & 0o777) == "0o600"
+        assert oct(os.stat(os.path.dirname(keyfile)).st_mode & 0o777) == "0o700"
