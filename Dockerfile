@@ -4,6 +4,11 @@
 # needed because the compute path JIT-compiles via XLA at startup.
 FROM python:3.11-slim
 
+# g++ builds the native packing extension at image build time (a dev
+# checkout may carry a .so for a different CPython; rebuild for this one)
+RUN apt-get update && apt-get install -y --no-install-recommends g++ \
+    && rm -rf /var/lib/apt/lists/*
+
 WORKDIR /app
 COPY gatekeeper_tpu/ /app/gatekeeper_tpu/
 COPY bench.py /app/
@@ -12,6 +17,12 @@ COPY bench.py /app/
 # chip; plain `jax` would silently fall back to CPU
 RUN pip install --no-cache-dir "jax[tpu]" "numpy" "cryptography" "pyyaml" \
       -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
+
+# prebuild the native extension for this interpreter; runtime user can't
+# write /app, so the .so must exist before dropping privileges
+RUN find /app/gatekeeper_tpu/native -name '_gknative*.so' -delete \
+    && python -c "from gatekeeper_tpu.native import build; build(force=True)" \
+    && chmod 0444 /app/gatekeeper_tpu/native/_gknative*.so
 
 USER 65532:65532
 ENTRYPOINT ["python", "-m", "gatekeeper_tpu"]

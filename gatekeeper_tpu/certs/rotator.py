@@ -193,7 +193,11 @@ class CertRotator:
             self._pem_valid(data.get("ca.crt"), REFRESH_MARGIN)
             and data.get("ca.key")
         )
-        tls_ok = ca_ok and self._pem_valid(data.get("tls.crt"), REFRESH_MARGIN)
+        tls_ok = (
+            ca_ok
+            and self._pem_valid(data.get("tls.crt"), REFRESH_MARGIN)
+            and bool(data.get("tls.key"))
+        )
         if not tls_ok:
             if ca_ok:
                 ca_crt = data["ca.crt"].encode()

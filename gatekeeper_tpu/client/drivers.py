@@ -98,6 +98,9 @@ class InventoryStore:
         self.tree: Dict[str, Any] = {}
         self._frozen = None
         self._lock = threading.Lock()
+        # monotonically increasing write epoch: lets evaluators cache
+        # packed tensors across sweeps over an unchanged inventory
+        self.epoch = 0
 
     def put(self, segments: Tuple[str, ...], obj: Any):
         with self._lock:
@@ -106,6 +109,7 @@ class InventoryStore:
                 node = node.setdefault(seg, {})
             node[segments[-1]] = freeze(obj)
             self._frozen = None
+            self.epoch += 1
 
     def delete(self, segments: Tuple[str, ...]) -> bool:
         with self._lock:
@@ -113,6 +117,7 @@ class InventoryStore:
                 had = bool(self.tree)
                 self.tree = {}
                 self._frozen = None
+                self.epoch += 1
                 return had
             node = self.tree
             for seg in segments[:-1]:
@@ -122,6 +127,7 @@ class InventoryStore:
             if segments[-1] in node:
                 del node[segments[-1]]
                 self._frozen = None
+                self.epoch += 1
                 return True
             return False
 

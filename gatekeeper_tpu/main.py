@@ -213,7 +213,22 @@ class App:
         # runs ensure_certs synchronously, so readiness is set before start()
         # spins the refresh thread
         certfile = keyfile = None
-        if self.rotator is not None:
+        if self.rotator is None:
+            # rotation disabled: serve externally-provided certs from
+            # --cert-dir (the reference's --disable-cert-rotation contract)
+            import os
+
+            cf = os.path.join(args.cert_dir, "tls.crt")
+            kf = os.path.join(args.cert_dir, "tls.key")
+            if os.path.exists(cf) and os.path.exists(kf):
+                certfile, keyfile = cf, kf
+            else:
+                log.warning(
+                    "cert rotation disabled and no certs in %s: webhook "
+                    "will serve PLAIN HTTP (apiserver admission requires "
+                    "HTTPS)", args.cert_dir,
+                )
+        else:
             certfile, keyfile = self.rotator.write_cert_files(args.cert_dir)
 
             def _on_refresh(secret):

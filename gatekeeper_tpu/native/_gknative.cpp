@@ -1,0 +1,627 @@
+// _gknative — C++ fast path for the host-side packing pipeline.
+//
+// The TPU driver's cold-path cost is JSON-dict traversal + string interning
+// (gatekeeper_tpu/ops/pack.py pack_reviews, ops/columns.py extract_columns).
+// Both are pure per-object loops over Python dicts; this module re-implements
+// them against the CPython API, filling caller-allocated numpy buffers via
+// the buffer protocol.  Semantics are pinned by differential tests against
+// the Python implementations (tests/test_native.py).
+//
+// Interning mutates the Python Interner's own dict/list (under the GIL), so
+// ids stay consistent across the C and Python paths.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr int32_t ID_MISSING = -1;   // Interner.MISSING
+constexpr int32_t ID_NON_STRING = -3;  // Interner.NON_STRING
+constexpr int32_t UNDEF = -4;        // pack.py UNDEF
+
+// tcode values (columns.py)
+constexpr int8_t T_UNDEF = 0, T_NULL = 1, T_FALSE = 2, T_TRUE = 3,
+                 T_NUM = 4, T_STR = 5, T_COMP = 6;
+
+PyObject *g_np_empty = nullptr;   // numpy.empty
+PyObject *g_sorted = nullptr;     // builtins.sorted
+PyObject *g_str = nullptr;        // builtins.str
+
+// ---- interner ------------------------------------------------------------
+
+int32_t intern(PyObject *ids, PyObject *strings, PyObject *s) {
+  PyObject *v = PyDict_GetItemWithError(ids, s);  // borrowed
+  if (v) return (int32_t)PyLong_AsLong(v);
+  if (PyErr_Occurred()) return ID_MISSING;  // unhashable: caller clears
+  Py_ssize_t n = PyList_GET_SIZE(strings);
+  PyObject *idobj = PyLong_FromSsize_t(n);
+  if (!idobj) return ID_MISSING;
+  if (PyDict_SetItem(ids, s, idobj) < 0) {
+    Py_DECREF(idobj);
+    return ID_MISSING;
+  }
+  Py_DECREF(idobj);
+  if (PyList_Append(strings, s) < 0) return ID_MISSING;
+  return (int32_t)n;
+}
+
+int32_t intern_value(PyObject *ids, PyObject *strings, PyObject *v) {
+  if (v && PyUnicode_Check(v)) return intern(ids, strings, v);
+  return ID_NON_STRING;
+}
+
+// ---- get_default semantics (target/match.py _get) ------------------------
+// missing key or None -> nullptr ("missing"); non-dict container -> missing.
+
+PyObject *get_field(PyObject *obj, const char *field) {  // borrowed or null
+  if (!obj || !PyDict_Check(obj)) return nullptr;
+  PyObject *v = PyDict_GetItemString(obj, field);
+  if (!v || v == Py_None) return nullptr;
+  return v;
+}
+
+bool is_ns_kind(PyObject *kind) {
+  if (!kind || !PyDict_Check(kind)) return false;
+  PyObject *g = PyDict_GetItemString(kind, "group");
+  PyObject *k = PyDict_GetItemString(kind, "kind");
+  if (!g || !k || !PyUnicode_Check(g) || !PyUnicode_Check(k)) return false;
+  return PyUnicode_GetLength(g) == 0 &&
+         PyUnicode_CompareWithASCIIString(k, "Namespace") == 0;
+}
+
+// ---- buffer helpers ------------------------------------------------------
+
+struct Buf {
+  Py_buffer view{};
+  bool ok = false;
+  ~Buf() {
+    if (ok) PyBuffer_Release(&view);
+  }
+  bool acquire(PyObject *obj) {
+    if (PyObject_GetBuffer(obj, &view, PyBUF_WRITABLE | PyBUF_C_CONTIGUOUS) <
+        0)
+      return false;
+    ok = true;
+    return true;
+  }
+  int32_t *i32() { return static_cast<int32_t *>(view.buf); }
+  int8_t *i8() { return static_cast<int8_t *>(view.buf); }
+  double *f64() { return static_cast<double *>(view.buf); }
+  bool *b() { return static_cast<bool *>(view.buf); }
+};
+
+// allocate a 1-D/2-D int32 numpy array via numpy.empty
+PyObject *np_empty_i32(Py_ssize_t a, Py_ssize_t b = -1) {
+  PyObject *shape =
+      (b < 0) ? Py_BuildValue("(n)", a) : Py_BuildValue("(nn)", a, b);
+  if (!shape) return nullptr;
+  PyObject *arr = PyObject_CallFunction(g_np_empty, "Os", shape, "int32");
+  Py_DECREF(shape);
+  return arr;
+}
+
+bool fill_i32(PyObject *arr, const std::vector<int32_t> &vals) {
+  Buf buf;
+  if (!buf.acquire(arr)) return false;
+  std::memcpy(buf.view.buf, vals.data(), vals.size() * sizeof(int32_t));
+  return true;
+}
+
+// ---- label interning (pack.py _intern_labels) ----------------------------
+// appends (key_id, value_id) pairs sorted by str(key)
+
+void intern_labels(PyObject *ids, PyObject *strings, PyObject *labels,
+                   std::vector<int32_t> &out) {
+  if (!labels || !PyDict_Check(labels)) return;
+  PyObject *keys = PyDict_Keys(labels);
+  if (!keys) {
+    PyErr_Clear();
+    return;
+  }
+  bool all_str = true;
+  Py_ssize_t n = PyList_GET_SIZE(keys);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    if (!PyUnicode_Check(PyList_GET_ITEM(keys, i))) {
+      all_str = false;
+      break;
+    }
+  }
+  if (all_str) {
+    if (PyList_Sort(keys) < 0) PyErr_Clear();
+  } else {
+    // rare: mirror sorted(keys, key=str)
+    PyObject *kw = PyDict_New();
+    PyDict_SetItemString(kw, "key", g_str);
+    PyObject *args = PyTuple_Pack(1, keys);
+    PyObject *srt = PyObject_Call(g_sorted, args, kw);
+    Py_DECREF(args);
+    Py_DECREF(kw);
+    if (srt) {
+      Py_DECREF(keys);
+      keys = srt;
+      n = PyList_GET_SIZE(keys);
+    } else {
+      PyErr_Clear();
+    }
+  }
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *k = PyList_GET_ITEM(keys, i);
+    PyObject *v = PyDict_GetItemWithError(labels, k);
+    if (!v) {
+      PyErr_Clear();
+      continue;
+    }
+    out.push_back(intern_value(ids, strings, k));
+    out.push_back(intern_value(ids, strings, v));
+  }
+  Py_DECREF(keys);
+}
+
+// ==========================================================================
+// pack_reviews_core
+// ==========================================================================
+//
+// Args: reviews(list), ids(dict), strings(list), cached_ns(callable),
+//       dict of preallocated 1-D buffers:
+//         group,kind,ns_name: int32[rows]; ns_mode: int8[rows];
+//         always,ns_empty,is_ns,obj_empty,old_empty,autoreject,valid: bool[rows]
+// Returns: (obj_flat[int32 N,2], obj_counts[int32 n],
+//           old_flat, old_counts, ns_flat, ns_counts)
+
+PyObject *pack_reviews_core(PyObject *, PyObject *args) {
+  PyObject *reviews, *ids, *strings, *cached_ns, *bufs;
+  if (!PyArg_ParseTuple(args, "OOOOO", &reviews, &ids, &strings, &cached_ns,
+                        &bufs))
+    return nullptr;
+  if (!PyList_Check(reviews) || !PyDict_Check(ids) || !PyList_Check(strings) ||
+      !PyDict_Check(bufs)) {
+    PyErr_SetString(PyExc_TypeError, "bad argument types");
+    return nullptr;
+  }
+
+  Buf group, kind, ns_name, ns_mode, always, ns_empty, is_ns, obj_empty,
+      old_empty, autoreject, valid;
+  struct {
+    const char *name;
+    Buf *buf;
+  } needed[] = {
+      {"group", &group},         {"kind", &kind},
+      {"ns_name", &ns_name},     {"ns_mode", &ns_mode},
+      {"always", &always},       {"ns_empty", &ns_empty},
+      {"is_ns", &is_ns},         {"obj_empty", &obj_empty},
+      {"old_empty", &old_empty}, {"autoreject", &autoreject},
+      {"valid", &valid},
+  };
+  for (auto &nb : needed) {
+    PyObject *o = PyDict_GetItemString(bufs, nb.name);
+    if (!o || !nb.buf->acquire(o)) {
+      PyErr_Format(PyExc_ValueError, "missing/bad buffer %s", nb.name);
+      return nullptr;
+    }
+  }
+
+  Py_ssize_t n = PyList_GET_SIZE(reviews);
+  std::vector<int32_t> obj_flat, old_flat, nsl_flat;
+  std::vector<int32_t> obj_counts(n), old_counts(n), ns_counts(n);
+
+  // memoized cached_namespace lookups for this batch
+  PyObject *ns_memo = PyDict_New();
+  if (!ns_memo) return nullptr;
+
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *review = PyList_GET_ITEM(reviews, i);
+    valid.b()[i] = true;
+
+    PyObject *rkind_raw =
+        PyDict_Check(review) ? PyDict_GetItemString(review, "kind") : nullptr;
+    PyObject *rkind =
+        (rkind_raw && PyDict_Check(rkind_raw)) ? rkind_raw : nullptr;
+    // NOTE pack.py uses rkind.get("group", _MISSING) — plain get, null is
+    // a value here (intern_value -> NON_STRING), matching the original
+    PyObject *g = rkind ? PyDict_GetItemString(rkind, "group") : nullptr;
+    PyObject *k = rkind ? PyDict_GetItemString(rkind, "kind") : nullptr;
+    group.i32()[i] = g ? intern_value(ids, strings, g) : UNDEF;
+    kind.i32()[i] = k ? intern_value(ids, strings, k) : UNDEF;
+
+    bool isns = is_ns_kind(rkind_raw);
+    is_ns.b()[i] = isns;
+
+    PyObject *ns = get_field(review, "namespace");  // _get default ""
+    bool nsempty = !ns || (PyUnicode_Check(ns) && PyUnicode_GetLength(ns) == 0);
+    // _get(review,"namespace","") returns "" for missing; == "" only for
+    // string empties; non-string namespace -> not empty
+    if (ns && !PyUnicode_Check(ns)) nsempty = false;
+    ns_empty.b()[i] = nsempty;
+    bool alw = !isns && nsempty;
+    always.b()[i] = alw;
+
+    // get_ns_name
+    if (isns) {
+      PyObject *obj = get_field(review, "object");
+      PyObject *meta = obj ? get_field(obj, "metadata") : nullptr;
+      PyObject *nm = meta ? get_field(meta, "name") : nullptr;
+      ns_name.i32()[i] = nm ? intern_value(ids, strings, nm) : UNDEF;
+    } else {
+      ns_name.i32()[i] = ns ? intern_value(ids, strings, ns) : UNDEF;
+    }
+
+    PyObject *obj = get_field(review, "object");
+    PyObject *old = get_field(review, "oldObject");
+    obj_empty.b()[i] =
+        !obj || (PyDict_Check(obj) && PyDict_GET_SIZE(obj) == 0);
+    old_empty.b()[i] =
+        !old || (PyDict_Check(old) && PyDict_GET_SIZE(old) == 0);
+
+    size_t before = obj_flat.size();
+    PyObject *ometa = obj ? get_field(obj, "metadata") : nullptr;
+    intern_labels(ids, strings, ometa ? get_field(ometa, "labels") : nullptr,
+                  obj_flat);
+    obj_counts[i] = (int32_t)((obj_flat.size() - before) / 2);
+
+    before = old_flat.size();
+    PyObject *olmeta = old ? get_field(old, "metadata") : nullptr;
+    intern_labels(ids, strings, olmeta ? get_field(olmeta, "labels") : nullptr,
+                  old_flat);
+    old_counts[i] = (int32_t)((old_flat.size() - before) / 2);
+
+    // namespaceSelector resolution mode + ns labels
+    before = nsl_flat.size();
+    int8_t mode;
+    PyObject *resolved_ns = nullptr;  // new reference when set
+    if (isns) {
+      mode = 3;
+    } else if (alw) {
+      mode = 0;
+    } else {
+      PyObject *unstable = get_field(review, "_unstable");
+      PyObject *uns = unstable ? get_field(unstable, "namespace") : nullptr;
+      if (uns) {
+        resolved_ns = uns;
+        Py_INCREF(resolved_ns);
+      } else if (ns && PyUnicode_Check(ns)) {
+        PyObject *memo = PyDict_GetItemWithError(ns_memo, ns);
+        if (memo) {
+          resolved_ns = memo;
+          Py_INCREF(resolved_ns);
+        } else {
+          PyErr_Clear();
+          resolved_ns = PyObject_CallFunctionObjArgs(cached_ns, ns, nullptr);
+          if (!resolved_ns) {
+            Py_DECREF(ns_memo);
+            return nullptr;
+          }
+          PyDict_SetItem(ns_memo, ns, resolved_ns);
+        }
+        if (resolved_ns == Py_None) {
+          Py_DECREF(resolved_ns);
+          resolved_ns = nullptr;
+        }
+      }
+      if (!resolved_ns) {
+        mode = 2;
+      } else {
+        mode = 1;
+        PyObject *nmeta = get_field(resolved_ns, "metadata");
+        intern_labels(ids, strings,
+                      nmeta ? get_field(nmeta, "labels") : nullptr, nsl_flat);
+      }
+    }
+    Py_XDECREF(resolved_ns);
+    ns_mode.i8()[i] = mode;
+    ns_counts[i] = (int32_t)((nsl_flat.size() - before) / 2);
+
+    // needs_autoreject for a namespaceSelector constraint (match.py:236):
+    bool rejects = true;
+    PyObject *nsv =
+        PyDict_Check(review) ? PyDict_GetItemString(review, "namespace")
+                             : nullptr;
+    PyObject *ns_str =
+        (nsv && nsv != Py_None && PyUnicode_Check(nsv)) ? nsv : nullptr;
+    // treat null like _get: None -> missing
+    if (nsv == Py_None) ns_str = nullptr;
+    if (ns_str) {
+      PyObject *memo = PyDict_GetItemWithError(ns_memo, ns_str);
+      PyObject *cached;
+      if (memo) {
+        cached = memo;
+        Py_INCREF(cached);
+      } else {
+        PyErr_Clear();
+        cached = PyObject_CallFunctionObjArgs(cached_ns, ns_str, nullptr);
+        if (!cached) {
+          Py_DECREF(ns_memo);
+          return nullptr;
+        }
+        PyDict_SetItem(ns_memo, ns_str, cached);
+      }
+      if (cached != Py_None) rejects = false;
+      Py_DECREF(cached);
+    }
+    if (rejects) {
+      PyObject *unstable = review && PyDict_Check(review)
+                               ? PyDict_GetItemString(review, "_unstable")
+                               : nullptr;
+      if (unstable && PyDict_Check(unstable)) {
+        PyObject *uv = PyDict_GetItemString(unstable, "namespace");
+        if (uv && uv != Py_False) rejects = false;
+      }
+    }
+    if (rejects && ns_str && PyUnicode_GetLength(ns_str) == 0) rejects = false;
+    autoreject.b()[i] = rejects;
+  }
+  Py_DECREF(ns_memo);
+
+  PyObject *ret = PyTuple_New(6);
+  struct {
+    std::vector<int32_t> *flat;
+    std::vector<int32_t> *counts;
+  } outs[] = {{&obj_flat, &obj_counts},
+              {&old_flat, &old_counts},
+              {&nsl_flat, &ns_counts}};
+  for (int j = 0; j < 3; j++) {
+    PyObject *flat_arr =
+        np_empty_i32((Py_ssize_t)outs[j].flat->size() / 2, 2);
+    PyObject *counts_arr = np_empty_i32(n);
+    if (!flat_arr || !counts_arr || !fill_i32(flat_arr, *outs[j].flat) ||
+        !fill_i32(counts_arr, *outs[j].counts)) {
+      Py_XDECREF(flat_arr);
+      Py_XDECREF(counts_arr);
+      Py_DECREF(ret);
+      return nullptr;
+    }
+    PyTuple_SET_ITEM(ret, j * 2, flat_arr);
+    PyTuple_SET_ITEM(ret, j * 2 + 1, counts_arr);
+  }
+  return ret;
+}
+
+// ==========================================================================
+// extract_columns cores
+// ==========================================================================
+
+// walk(obj, path, i): collect values at path; "[]" iterates lists
+void walk(PyObject *obj, PyObject *path, Py_ssize_t i,
+          std::vector<PyObject *> &out) {  // borrowed refs out
+  Py_ssize_t plen = PyTuple_GET_SIZE(path);
+  if (i == plen) {
+    out.push_back(obj);
+    return;
+  }
+  PyObject *seg = PyTuple_GET_ITEM(path, i);
+  if (PyUnicode_CompareWithASCIIString(seg, "[]") == 0) {
+    if (PyList_Check(obj)) {
+      Py_ssize_t n = PyList_GET_SIZE(obj);
+      for (Py_ssize_t j = 0; j < n; j++)
+        walk(PyList_GET_ITEM(obj, j), path, i + 1, out);
+    }
+    return;
+  }
+  if (PyDict_Check(obj)) {
+    PyObject *v = PyDict_GetItemWithError(obj, seg);
+    if (!v) {
+      PyErr_Clear();
+      return;
+    }
+    walk(v, path, i + 1, out);
+  }
+}
+
+// _get_rel: []-free path; nullptr = absent (missing key only; None is a value)
+PyObject *get_rel(PyObject *obj, PyObject *path) {
+  PyObject *cur = obj;
+  Py_ssize_t plen = PyTuple_GET_SIZE(path);
+  for (Py_ssize_t i = 0; i < plen; i++) {
+    if (!PyDict_Check(cur)) return nullptr;
+    PyObject *v = PyDict_GetItemWithError(cur, PyTuple_GET_ITEM(path, i));
+    if (!v) {
+      PyErr_Clear();
+      return nullptr;
+    }
+    cur = v;
+  }
+  return cur;
+}
+
+// encode one value into tcode/sid/num at index idx (columns.py _encode)
+void encode_at(PyObject *v, Py_ssize_t idx, int8_t *tcode, int32_t *sid,
+               double *num, PyObject *ids, PyObject *strings) {
+  if (!v) {
+    tcode[idx] = T_UNDEF;
+  } else if (v == Py_None) {
+    tcode[idx] = T_NULL;
+  } else if (v == Py_True) {
+    tcode[idx] = T_TRUE;
+  } else if (v == Py_False) {
+    tcode[idx] = T_FALSE;
+  } else if (PyUnicode_Check(v)) {
+    tcode[idx] = T_STR;
+    sid[idx] = intern(ids, strings, v);
+  } else if (PyLong_Check(v) || PyFloat_Check(v)) {
+    tcode[idx] = T_NUM;
+    num[idx] = PyFloat_Check(v) ? PyFloat_AS_DOUBLE(v)
+                                : PyLong_AsDouble(v);
+    if (PyErr_Occurred()) {  // int beyond double range
+      PyErr_Clear();
+      num[idx] = HUGE_VAL;
+    }
+  } else {
+    tcode[idx] = T_COMP;
+  }
+}
+
+// extract_scalar(resources, path, tcode_buf, sid_buf, num_buf, ids, strings)
+PyObject *extract_scalar(PyObject *, PyObject *args) {
+  PyObject *resources, *path, *tc, *si, *nu, *ids, *strings;
+  if (!PyArg_ParseTuple(args, "OOOOOOO", &resources, &path, &tc, &si, &nu,
+                        &ids, &strings))
+    return nullptr;
+  Buf tcode, sid, num;
+  if (!tcode.acquire(tc) || !sid.acquire(si) || !num.acquire(nu))
+    return nullptr;
+  Py_ssize_t n = PyList_GET_SIZE(resources);
+  std::vector<PyObject *> hits;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    hits.clear();
+    walk(PyList_GET_ITEM(resources, i), path, 0, hits);
+    encode_at(hits.empty() ? nullptr : hits[0], i, tcode.i8(), sid.i32(),
+              num.f64(), ids, strings);
+  }
+  Py_RETURN_NONE;
+}
+
+// slot_entities(resources, iter_paths) -> (list of list, max_width)
+PyObject *slot_entities(PyObject *, PyObject *args) {
+  PyObject *resources, *iter_paths;
+  if (!PyArg_ParseTuple(args, "OO", &resources, &iter_paths)) return nullptr;
+  Py_ssize_t n = PyList_GET_SIZE(resources);
+  Py_ssize_t np_ = PyTuple_GET_SIZE(iter_paths);
+  PyObject *ents = PyList_New(n);
+  if (!ents) return nullptr;
+  Py_ssize_t maxw = 0;
+  std::vector<PyObject *> hits;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    hits.clear();
+    for (Py_ssize_t p = 0; p < np_; p++)
+      walk(PyList_GET_ITEM(resources, i), PyTuple_GET_ITEM(iter_paths, p), 0,
+           hits);
+    PyObject *row = PyList_New((Py_ssize_t)hits.size());
+    if (!row) {
+      Py_DECREF(ents);
+      return nullptr;
+    }
+    for (size_t j = 0; j < hits.size(); j++) {
+      Py_INCREF(hits[j]);
+      PyList_SET_ITEM(row, (Py_ssize_t)j, hits[j]);
+    }
+    PyList_SET_ITEM(ents, i, row);
+    if ((Py_ssize_t)hits.size() > maxw) maxw = (Py_ssize_t)hits.size();
+  }
+  return Py_BuildValue("(Nn)", ents, maxw);
+}
+
+// encode_slots(entities, rel_path, width, tcode[R,W], sid, num, mask(bool),
+//              ids, strings)
+PyObject *encode_slots(PyObject *, PyObject *args) {
+  PyObject *entities, *rel_path, *tc, *si, *nu, *ma, *ids, *strings;
+  Py_ssize_t width;
+  if (!PyArg_ParseTuple(args, "OOnOOOOOO", &entities, &rel_path, &width, &tc,
+                        &si, &nu, &ma, &ids, &strings))
+    return nullptr;
+  Buf tcode, sid, num, mask;
+  if (!tcode.acquire(tc) || !sid.acquire(si) || !num.acquire(nu) ||
+      !mask.acquire(ma))
+    return nullptr;
+  Py_ssize_t n = PyList_GET_SIZE(entities);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *row = PyList_GET_ITEM(entities, i);
+    Py_ssize_t rn = PyList_GET_SIZE(row);
+    for (Py_ssize_t j = 0; j < width; j++) {
+      Py_ssize_t idx = i * width + j;
+      if (j < rn) {
+        mask.b()[idx] = true;
+        PyObject *v = PyTuple_GET_SIZE(rel_path)
+                          ? get_rel(PyList_GET_ITEM(row, j), rel_path)
+                          : PyList_GET_ITEM(row, j);
+        encode_at(v, idx, tcode.i8(), sid.i32(), num.f64(), ids, strings);
+      } else {
+        encode_at(nullptr, idx, tcode.i8(), sid.i32(), num.f64(), ids,
+                  strings);
+      }
+    }
+  }
+  Py_RETURN_NONE;
+}
+
+// keyset(resources, iter_paths, rel_path, exclude_set, ids, strings)
+//   -> (flat int32 array, counts int32 array)
+PyObject *keyset(PyObject *, PyObject *args) {
+  PyObject *resources, *iter_paths, *rel_path, *exclude, *ids, *strings;
+  if (!PyArg_ParseTuple(args, "OOOOOO", &resources, &iter_paths, &rel_path,
+                        &exclude, &ids, &strings))
+    return nullptr;
+  Py_ssize_t n = PyList_GET_SIZE(resources);
+  Py_ssize_t np_ = PyTuple_GET_SIZE(iter_paths);
+  std::vector<int32_t> flat;
+  std::vector<int32_t> counts(n);
+  std::vector<PyObject *> hits;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    hits.clear();
+    for (Py_ssize_t p = 0; p < np_; p++)
+      walk(PyList_GET_ITEM(resources, i), PyTuple_GET_ITEM(iter_paths, p), 0,
+           hits);
+    size_t before = flat.size();
+    PyObject *seen = PySet_New(nullptr);
+    if (!seen) return nullptr;
+    for (PyObject *h : hits) {
+      PyObject *target =
+          PyTuple_GET_SIZE(rel_path) ? get_rel(h, rel_path) : h;
+      if (!target || !PyDict_Check(target)) continue;
+      PyObject *k, *v;
+      Py_ssize_t pos = 0;
+      while (PyDict_Next(target, &pos, &k, &v)) {
+        if (!PyUnicode_Check(k) || v == Py_False) continue;
+        int ex = PySequence_Contains(exclude, k);
+        if (ex != 0) {
+          if (ex < 0) PyErr_Clear();
+          continue;
+        }
+        int sn = PySet_Contains(seen, k);
+        if (sn != 0) {
+          if (sn < 0) PyErr_Clear();
+          continue;
+        }
+        PySet_Add(seen, k);
+        flat.push_back(intern(ids, strings, k));
+      }
+    }
+    Py_DECREF(seen);
+    counts[i] = (int32_t)(flat.size() - before);
+  }
+  PyObject *flat_arr = np_empty_i32((Py_ssize_t)flat.size());
+  PyObject *counts_arr = np_empty_i32(n);
+  if (!flat_arr || !counts_arr || !fill_i32(flat_arr, flat) ||
+      !fill_i32(counts_arr, counts)) {
+    Py_XDECREF(flat_arr);
+    Py_XDECREF(counts_arr);
+    return nullptr;
+  }
+  return Py_BuildValue("(NN)", flat_arr, counts_arr);
+}
+
+PyMethodDef methods[] = {
+    {"pack_reviews_core", pack_reviews_core, METH_VARARGS,
+     "fill review-side fixed buffers; returns label pair flats+counts"},
+    {"extract_scalar", extract_scalar, METH_VARARGS,
+     "encode first-hit path values into tcode/sid/num buffers"},
+    {"slot_entities", slot_entities, METH_VARARGS,
+     "collect iteration-path entities per resource"},
+    {"encode_slots", encode_slots, METH_VARARGS,
+     "encode per-entity rel-path values into padded buffers"},
+    {"keyset", keyset, METH_VARARGS,
+     "interned truthy keys at paths, dedup, minus exclusions"},
+    {nullptr, nullptr, 0, nullptr}};
+
+PyModuleDef moduledef = {PyModuleDef_HEAD_INIT, "_gknative",
+                         "C++ packing fast path", -1, methods};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__gknative(void) {
+  PyObject *np = PyImport_ImportModule("numpy");
+  if (!np) return nullptr;
+  g_np_empty = PyObject_GetAttrString(np, "empty");
+  Py_DECREF(np);
+  if (!g_np_empty) return nullptr;
+  PyObject *builtins = PyImport_ImportModule("builtins");
+  if (!builtins) return nullptr;
+  g_sorted = PyObject_GetAttrString(builtins, "sorted");
+  g_str = PyObject_GetAttrString(builtins, "str");
+  Py_DECREF(builtins);
+  if (!g_sorted || !g_str) return nullptr;
+  return PyModule_Create(&moduledef);
+}

@@ -138,6 +138,66 @@ def _encode(values: List[Any], interner: Interner, shape) -> Dict[str, np.ndarra
     }
 
 
+def _extract_columns_native(
+    native, resources, specs, interner, rows
+) -> Dict[Tuple, Dict[str, np.ndarray]]:
+    """C++ extraction (same layout/semantics as the Python body below;
+    differentially tested in tests/test_native.py)."""
+    out: Dict[Tuple, Dict[str, np.ndarray]] = {}
+    resources = list(resources)
+    n = len(resources)
+    ids, strings = interner._ids, interner._strings
+
+    slot_groups: Dict[Tuple, List[ColumnSpec]] = {}
+    for spec in specs:
+        if spec.kind == "slot":
+            slot_groups.setdefault(spec.iter_key, []).append(spec)
+    group_entities: Dict[Tuple, list] = {}
+    group_width: Dict[Tuple, int] = {}
+    for ik in slot_groups:
+        ents, maxw = native.slot_entities(resources, tuple(ik))
+        group_entities[ik] = ents
+        group_width[ik] = _bucket(maxw, 1)
+
+    for spec in specs:
+        if spec.kind == "scalar":
+            tcode = np.zeros(rows, np.int8)
+            sid = np.full(rows, Interner.MISSING, np.int32)
+            num = np.zeros(rows, np.float64)
+            native.extract_scalar(
+                resources, spec.rel_path, tcode, sid, num, ids, strings
+            )
+            out[spec.key] = {"tcode": tcode, "sid": sid, "num": num}
+        elif spec.kind == "slot":
+            width = group_width[spec.iter_key]
+            tcode = np.zeros((rows, width), np.int8)
+            sid = np.full((rows, width), Interner.MISSING, np.int32)
+            num = np.zeros((rows, width), np.float64)
+            mask = np.zeros((rows, width), bool)
+            native.encode_slots(
+                group_entities[spec.iter_key], spec.rel_path, width,
+                tcode, sid, num, mask, ids, strings,
+            )
+            out[spec.key] = {"tcode": tcode, "sid": sid, "num": num,
+                             "mask": mask}
+        elif spec.kind == "keyset":
+            flat, counts = native.keyset(
+                resources, tuple(spec.iter_paths), spec.rel_path,
+                tuple(spec.exclude), ids, strings,
+            )
+            width = _bucket(int(counts.max()) if n else 0, 1)
+            arr = np.full((rows, width), Interner.PAD, np.int32)
+            if len(flat):
+                starts = np.cumsum(counts) - counts
+                rows_idx = np.repeat(np.arange(n), counts)
+                cols_idx = np.arange(len(flat)) - np.repeat(starts, counts)
+                arr[rows_idx, cols_idx] = flat
+            out[spec.key] = {"ids": arr}
+        else:
+            raise ValueError(f"unknown column kind {spec.kind}")
+    return out
+
+
 def extract_columns(
     resources: Sequence[dict],
     specs: Sequence[ColumnSpec],
@@ -146,6 +206,14 @@ def extract_columns(
 ) -> Dict[Tuple, Dict[str, np.ndarray]]:
     """Extract requested columns over `resources`, padded to `rows` rows.
     Slot columns in the same iter group share entity extraction and width."""
+    from ..native import load as _load_native
+
+    native = _load_native()
+    if native is not None:
+        return _extract_columns_native(
+            native, resources, specs, interner, rows
+        )
+
     out: Dict[Tuple, Dict[str, np.ndarray]] = {}
 
     # Group slot specs by iteration source so their slot axes align.

@@ -39,6 +39,11 @@ class TpuDriver(InterpDriver):
 
     def __init__(self, target: Optional[K8sValidationTarget] = None):
         super().__init__(target)
+        # eager native build/load: the g++ compile must happen here, not
+        # inside the first admission review under the driver lock
+        from ..native import load as _load_native
+
+        _load_native()
         self.interner = Interner()
         self.programs: Dict[str, Optional[VProgram]] = {}
         self.pred_cache: Dict[Tuple[str, str], PredicateTable] = {}
@@ -48,6 +53,10 @@ class TpuDriver(InterpDriver):
         # mutation and on vocabulary growth (str-pred tables are vocab-sized)
         self._cs_epoch = 0
         self._cs_cache = None
+        # audit-side packing cache: the production audit loop sweeps a
+        # mostly-unchanged inventory every interval; packing is skipped
+        # entirely while the store epoch and constraint side are unchanged
+        self._audit_cache = None
 
     # ---- lifecycle --------------------------------------------------------
 
@@ -264,28 +273,50 @@ class TpuDriver(InterpDriver):
                 out.append((results, "\n".join(trace) if tracing else None))
             return out
 
+    def _audit_masks(self):
+        """Packed audit sweep with epoch caching: reviews + device inputs
+        are rebuilt only when the inventory or constraint side changed."""
+        from ..engine.value import thaw
+
+        key = (self.store.epoch, self._cs_epoch)
+        if self._audit_cache and self._audit_cache[0] == key:
+            _key, reviews, ordered, mask = self._audit_cache
+            return reviews, ordered, mask
+        objs = list(self.store.iter_objects())
+        reviews = []
+        for obj_frozen, api, kind_name, name, ns in objs:
+            obj = thaw(obj_frozen)
+            reviews.append(
+                self.target.make_audit_review(obj, api, kind_name, name, ns)
+            )
+        if not reviews:
+            return [], [], None
+        ordered, mask, _autoreject = self.compute_masks(reviews)
+        # re-read the epochs: packing may have interned new strings and
+        # bumped the constraint-side cache, but the INPUTS are these epochs'
+        self._audit_cache = (key, reviews, ordered, mask)
+        return reviews, ordered, mask
+
     def audit(self, tracing: bool = False):
-        from ..engine.value import freeze, thaw
+        from ..engine.value import freeze
 
         with self._lock:
-            objs = list(self.store.iter_objects())
-            reviews = []
-            for obj_frozen, api, kind_name, name, ns in objs:
-                obj = thaw(obj_frozen)
-                reviews.append(self.target.make_audit_review(obj, api, kind_name, name, ns))
+            reviews, ordered, mask = self._audit_masks()
             if not reviews:
                 return [], ("" if tracing else None)
-            ordered, mask, _autoreject = self.compute_masks(reviews)
             inventory = self.store.frozen()
             results: List[Result] = []
             trace: List[str] = [] if tracing else None
-            # resource-major order, matching InterpDriver.audit
-            for ri, review in enumerate(reviews):
+            # resource-major order, matching InterpDriver.audit; only
+            # reviews with a positive cell pay the freeze + render cost
+            hot_reviews = np.nonzero(mask.any(axis=0))[0]
+            for ri in hot_reviews:
+                review = reviews[ri]
                 frozen_review = freeze(review)
-                for i, (kind, _name, constraint) in enumerate(ordered):
-                    if mask[i, ri]:
-                        self._render_cell(
-                            results, constraint, kind, review, frozen_review,
-                            inventory, trace,
-                        )
+                for i in np.nonzero(mask[:, ri])[0]:
+                    kind, _name, constraint = ordered[i]
+                    self._render_cell(
+                        results, constraint, kind, review, frozen_review,
+                        inventory, trace,
+                    )
             return results, ("\n".join(trace) if tracing else None)

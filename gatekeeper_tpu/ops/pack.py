@@ -51,6 +51,48 @@ class ReviewPack:
     reviews: List[dict]
 
 
+def _pad_flat_pairs(flat: np.ndarray, counts: np.ndarray,
+                    rows: int) -> np.ndarray:
+    """[(total,2) flats + per-row counts] -> padded [rows, W, 2] int32."""
+    n = len(counts)
+    width = _bucket(int(counts.max()) if n else 0, 1)
+    arr = np.full((rows, width, 2), PAD, np.int32)
+    if len(flat):
+        starts = np.cumsum(counts) - counts
+        rows_idx = np.repeat(np.arange(n), counts)
+        cols_idx = np.arange(len(flat)) - np.repeat(starts, counts)
+        arr[rows_idx, cols_idx] = flat
+    return arr
+
+
+def _pack_reviews_native(native, reviews, interner, cached_namespace,
+                         rows: int) -> Optional[Dict[str, np.ndarray]]:
+    n = len(reviews)
+    bufs = {
+        "group": np.full(rows, UNDEF, np.int32),
+        "kind": np.full(rows, UNDEF, np.int32),
+        "ns_name": np.full(rows, UNDEF, np.int32),
+        "ns_mode": np.zeros(rows, np.int8),
+        "always": np.zeros(rows, bool),
+        "ns_empty": np.zeros(rows, bool),
+        "is_ns": np.zeros(rows, bool),
+        "obj_empty": np.ones(rows, bool),
+        "old_empty": np.ones(rows, bool),
+        "autoreject": np.zeros(rows, bool),
+        "valid": np.zeros(rows, bool),
+    }
+    out = native.pack_reviews_core(
+        list(reviews), interner._ids, interner._strings, cached_namespace,
+        bufs,
+    )
+    obj_flat, obj_counts, old_flat, old_counts, ns_flat, ns_counts = out
+    bufs["obj_labels"] = _pad_flat_pairs(obj_flat, obj_counts, rows)
+    bufs["old_labels"] = _pad_flat_pairs(old_flat, old_counts, rows)
+    bufs["ns_labels"] = _pad_flat_pairs(ns_flat, ns_counts, rows)
+    bufs["valid"][:n] = True
+    return bufs
+
+
 def pack_reviews(
     reviews: List[dict],
     interner: Interner,
@@ -59,6 +101,16 @@ def pack_reviews(
 ) -> ReviewPack:
     n = len(reviews)
     rows = _bucket(n, 8) if bucket_rows else max(n, 1)
+
+    from ..native import load as _load_native
+
+    native = _load_native()
+    if native is not None:
+        arrays = _pack_reviews_native(
+            native, reviews, interner, cached_namespace, rows
+        )
+        if arrays is not None:
+            return ReviewPack(n=n, arrays=arrays, reviews=reviews)
 
     group = np.full(rows, UNDEF, np.int32)
     kind = np.full(rows, UNDEF, np.int32)
