@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import sys
 import threading
 import time
 import uuid
@@ -161,6 +162,58 @@ class HealthServer:
             self._server = None
 
 
+class ProfileServer:
+    """--enable-pprof analogue (main.go:91-92,113-119): a debug listener
+    with thread stack dumps and GC stats in place of Go's net/http/pprof."""
+
+    def __init__(self, port: int = 6060):
+        self.port = port
+        self._server: Optional[ThreadingHTTPServer] = None
+
+    def start(self):
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def do_GET(self):
+                import gc
+                import traceback
+
+                if self.path.startswith("/debug/pprof"):
+                    frames = sys._current_frames()
+                    lines = []
+                    for t in threading.enumerate():
+                        frame = frames.get(t.ident)
+                        lines.append(f"--- thread {t.name} ({t.ident}) ---")
+                        if frame:
+                            lines.extend(
+                                s.rstrip()
+                                for s in traceback.format_stack(frame)
+                            )
+                    lines.append(f"--- gc ---\n{gc.get_stats()}")
+                    body = "\n".join(lines).encode()
+                    code = 200
+                else:
+                    body, code = b"not found", 404
+                self.send_response(code)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._server = ThreadingHTTPServer(("0.0.0.0", self.port), Handler)
+        self.port = self._server.server_address[1]
+        threading.Thread(
+            target=self._server.serve_forever, name="pprof", daemon=True
+        ).start()
+
+    def stop(self):
+        if self._server:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+
 class App:
     """The composed process (main.go main + setupControllers)."""
 
@@ -206,6 +259,7 @@ class App:
         self.audit_manager: Optional[AuditManager] = None
         self.metrics_exporter: Optional[MetricsExporter] = None
         self.micro_batcher: Optional[MicroBatcher] = None
+        self.profile_server: Optional[ProfileServer] = None
 
     def start(self):
         args = self.args
@@ -301,6 +355,9 @@ class App:
             port=args.prometheus_port, registry=self.reporters.registry
         )
         self.metrics_exporter.start()
+        if args.enable_pprof:
+            self.profile_server = ProfileServer(args.pprof_port)
+            self.profile_server.start()
         log.info(
             "gatekeeper-tpu started",
             extra={"kv": {
@@ -317,6 +374,7 @@ class App:
             self.metrics_exporter,
             self.micro_batcher,
             self.rotator,
+            self.profile_server,
         ):
             if component is not None:
                 component.stop()

@@ -14,6 +14,7 @@ throughput does.  Templates with no vectorized program get all-true columns
 
 from __future__ import annotations
 
+import os
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -235,14 +236,29 @@ class TpuDriver(InterpDriver):
     def review(self, review: dict, tracing: bool = False):
         return self.review_batch([review], tracing=tracing)[0]
 
+    # Below this many constraint x review cells the device dispatch costs
+    # more than it saves (kernel launch + host<->device transfer — or a
+    # full network RTT when the chip sits behind a relay); small batches
+    # evaluate host-side with the exact native matcher + interpreter.
+    DEVICE_MIN_CELLS = int(os.environ.get("GK_DEVICE_MIN_CELLS", "4096"))
+
     def review_batch(self, reviews: List[dict], tracing: bool = False):
         """N concurrent admission reviews in ONE device dispatch: the mask
         is [C, N], then each review's positive cells render host-side.
-        This is the micro-batching seam the webhook server drives."""
+        This is the micro-batching seam the webhook server drives.
+
+        Hybrid dispatch: batches too small to amortize a device call run
+        through the interpreter path (identical semantics — the device mask
+        is only ever a pruning over-approximation of it)."""
         from ..engine.value import freeze
 
         if not reviews:
             return []
+        n_constraints = sum(len(v) for v in self.constraints.values())
+        if len(reviews) * max(n_constraints, 1) < self.DEVICE_MIN_CELLS:
+            return [
+                InterpDriver.review(self, r, tracing=tracing) for r in reviews
+            ]
         with self._lock:
             ordered, mask, autoreject = self.compute_masks(reviews)
             inventory = self.store.frozen()

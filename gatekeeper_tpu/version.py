@@ -1,0 +1,14 @@
+"""Build version stamping (reference pkg/version/version.go, populated via
+ldflags at Makefile:20-24; here via environment or defaults)."""
+
+from __future__ import annotations
+
+import os
+
+VERSION = os.environ.get("GK_VERSION", "v0.1.0-dev")
+COMMIT = os.environ.get("GK_COMMIT", "unknown")
+BUILD_DATE = os.environ.get("GK_BUILD_DATE", "unknown")
+
+
+def user_agent(component: str = "gatekeeper-tpu") -> str:
+    return f"{component}/{VERSION} ({COMMIT})"

@@ -207,3 +207,56 @@ class TestCertRotator:
         certfile, keyfile = rot.write_cert_files(str(tmp_path / "certs"))
         assert oct(os.stat(keyfile).st_mode & 0o777) == "0o600"
         assert oct(os.stat(os.path.dirname(keyfile)).st_mode & 0o777) == "0o700"
+
+
+class TestSmallPieces:
+    def test_version(self):
+        from gatekeeper_tpu import version
+
+        assert version.VERSION
+        assert "gatekeeper-tpu/" in version.user_agent()
+
+    def test_retry_kube_retries_conflict(self):
+        from gatekeeper_tpu.kube.clients import RetryKube
+
+        kube = InMemoryKube()
+        kube.create({"apiVersion": "v1", "kind": "ConfigMap",
+                     "metadata": {"name": "x"}})
+        rk = RetryKube(kube, backoff_s=0.001)
+        stale = rk.get(("", "v1", "ConfigMap"), "x")
+        kube.update({"apiVersion": "v1", "kind": "ConfigMap",
+                     "metadata": {"name": "x"}, "data": {"a": "1"}})
+        import pytest as _pytest
+
+        stale["data"] = {"b": "2"}
+        with _pytest.raises(Exception):
+            rk.update(stale, check_version=True)  # stays conflicted
+        # non-versioned update goes through
+        rk.update(stale)
+        assert kube.get(("", "v1", "ConfigMap"), "x")["data"] == {"b": "2"}
+
+    def test_noop_kube(self):
+        from gatekeeper_tpu.kube.clients import NoopKube
+        from gatekeeper_tpu.kube.inmem import NotFound
+
+        nk = NoopKube()
+        assert nk.list(("", "v1", "Pod")) == []
+        assert nk.create({"x": 1}) == {"x": 1}
+        import pytest as _pytest
+
+        with _pytest.raises(NotFound):
+            nk.get(("", "v1", "Pod"), "a")
+
+    def test_profile_server(self):
+        from gatekeeper_tpu.main import ProfileServer
+
+        ps = ProfileServer(port=0)
+        ps.start()
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{ps.port}/debug/pprof", timeout=5
+            ) as r:
+                body = r.read().decode()
+            assert "thread MainThread" in body
+        finally:
+            ps.stop()
