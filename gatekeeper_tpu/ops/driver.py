@@ -50,6 +50,15 @@ class TpuDriver(InterpDriver):
         self.pred_cache: Dict[Tuple[str, str], PredicateTable] = {}
         self._fused = None
         self._fused_key = None
+        # multi-chip: data-parallel mesh over every visible device (None on
+        # single-chip).  GK_MESH=0 forces the single-device path; tests pin
+        # bit-parity between both settings.
+        self.mesh_enabled = os.environ.get("GK_MESH", "1") != "0"
+        self._mesh_cache: Optional[tuple] = None
+        # device placement of the replicated constraint side (mesh path):
+        # re-uploading vocab-sized tables to N chips every call would cost
+        # N RTTs behind a network relay; cached on the constraint epoch
+        self._cs_device_cache = None
         # constraint-side packing is invalidated on any template/constraint
         # mutation and on vocabulary growth (str-pred tables are vocab-sized)
         self._cs_epoch = 0
@@ -84,6 +93,7 @@ class TpuDriver(InterpDriver):
         self.programs.clear()
         self._cs_epoch += 1
         self._cs_cache = None
+        self._cs_device_cache = None
         self._fused = None
         self._fused_key = None
 
@@ -186,13 +196,48 @@ class TpuDriver(InterpDriver):
         group_params = [packed for _prog, _idxs, packed in groups]
         return fn, ordered, rp, cp, cols, group_params
 
+    def _mesh(self):
+        """The production device mesh: all visible devices, data-parallel on
+        the resource axis (parallel/mesh.py).  None on single-chip or when
+        mesh_enabled is off."""
+        if not self.mesh_enabled:
+            return None
+        if self._mesh_cache is None:
+            from ..parallel.mesh import maybe_audit_mesh
+
+            self._mesh_cache = (maybe_audit_mesh(),)
+        return self._mesh_cache[0]
+
     def compute_masks(self, reviews: List[dict]):
         """-> (ordered constraints, match&violation candidate mask [C, R],
-        autoreject mask [C, R]) as numpy arrays."""
+        autoreject mask [C, R]) as numpy arrays.
+
+        Multi-chip: when a mesh is available the row axis is padded to a
+        mesh multiple and committed sharded (input placement drives the
+        SPMD compile of the SAME fused jit); results come back trimmed so
+        callers see identical shapes on 1 or N devices."""
         fn, ordered, rp, cp, cols, group_params = self._device_inputs(reviews)
-        mask, autoreject = fn(rp.arrays, cp.arrays, cols, group_params)
+        rows = len(rp.arrays["valid"])
+        args = (rp.arrays, cp.arrays, cols, group_params)
+        mesh = self._mesh()
+        if mesh is not None:
+            from ..parallel.mesh import replicate_tree, shard_review_side
+
+            key = (self._cs_epoch, self.interner.snapshot_size(), id(mesh))
+            if self._cs_device_cache and self._cs_device_cache[0] == key:
+                cs_p, gp_p = self._cs_device_cache[1]
+            else:
+                cs_p, gp_p = replicate_tree(mesh, (cp.arrays, group_params))
+                self._cs_device_cache = (key, (cs_p, gp_p))
+            rv_p, cols_p, _target = shard_review_side(
+                mesh, rows, rp.arrays, cols
+            )
+            with mesh:
+                mask, autoreject = fn(rv_p, cs_p, cols_p, gp_p)
+        else:
+            mask, autoreject = fn(*args)
         both = np.asarray(jnp.stack([mask, autoreject]))  # one fetch
-        return ordered, both[0], both[1]
+        return ordered, both[0][:, :rows], both[1][:, :rows]
 
     # ---- render (exactness filter) ---------------------------------------
 

@@ -6,6 +6,12 @@ ICI, the constraint-side arrays replicate, and the [C, R] masks come back
 sharded on R.  XLA inserts any collectives; per-constraint reductions
 (violation counts) become psums over the data axis.
 
+Integration model (idiomatic JAX): sharding is decided by INPUT PLACEMENT —
+`shard_args` commits the argument trees to the mesh with `jax.device_put`,
+and the driver's ONE fused jitted function compiles an SPMD executable from
+those committed shardings.  No separate "distributed" code path exists for
+the kernels themselves.
+
 This is the framework's distributed backend — the analogue of what the
 reference simply lacks (its audit is one goroutine; multi-pod scale-out is
 independent re-evaluation, pkg/controller/constraintstatus).
@@ -26,6 +32,34 @@ def audit_mesh(n_devices: Optional[int] = None) -> Mesh:
     if len(devs) < n:
         raise RuntimeError(f"need {n} devices, have {len(devs)}")
     return Mesh(np.array(devs[:n]), ("data",))
+
+
+def maybe_audit_mesh() -> Optional[Mesh]:
+    """The production mesh: data-parallel over every visible device, or
+    None when only one device exists (single-chip fast path)."""
+    return audit_mesh() if len(jax.devices()) > 1 else None
+
+
+def pad_rows(rows: int, multiple: int) -> int:
+    """Smallest row count >= rows divisible by the mesh size."""
+    return ((rows + multiple - 1) // multiple) * multiple
+
+
+def _pad_rows_tree(tree, rows: int, target: int):
+    """Zero-pad every row-major array (leading dim == rows) to target rows.
+    Zero padding is semantically inert: the match kernel ANDs every cell
+    with the review-side `valid` flag (ops/matchkernel.py:173-175), which
+    pads to False, so padded rows can never produce a positive cell."""
+    if target == rows:
+        return tree
+
+    def pad(x):
+        if hasattr(x, "shape") and x.ndim >= 1 and x.shape[0] == rows:
+            widths = [(0, target - rows)] + [(0, 0)] * (x.ndim - 1)
+            return np.pad(np.asarray(x), widths)
+        return x
+
+    return jax.tree_util.tree_map(pad, tree)
 
 
 def shardings_for(mesh: Mesh, rows: int, args):
@@ -54,31 +88,61 @@ def shardings_for(mesh: Mesh, rows: int, args):
     )
 
 
+def replicate_tree(mesh: Mesh, tree):
+    """Commit a tree fully replicated onto the mesh (the constraint side —
+    cacheable across calls while the constraint-side epoch is unchanged)."""
+    repl = NamedSharding(mesh, P())
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, repl), tree)
+
+
+def shard_review_side(mesh: Mesh, rows: int, rv, cols):
+    """Pad the row axis to a mesh multiple and commit the review-side trees
+    with row-major arrays partitioned on "data" (everything else, e.g.
+    vocab-sized tables, replicated).  Returns (rv, cols, padded_rows)."""
+    target = pad_rows(rows, mesh.devices.size)
+    rv = _pad_rows_tree(rv, rows, target)
+    cols = _pad_rows_tree(cols, rows, target)
+    repl = NamedSharding(mesh, P())
+
+    def place(x):
+        if hasattr(x, "shape") and x.ndim >= 1 and x.shape[0] == target:
+            sh = NamedSharding(mesh, P("data", *([None] * (x.ndim - 1))))
+        else:
+            sh = repl
+        return jax.device_put(x, sh)
+
+    return (
+        jax.tree_util.tree_map(place, rv),
+        jax.tree_util.tree_map(place, cols),
+        target,
+    )
+
+
+def shard_args(mesh: Mesh, rows: int, args):
+    """Pad the row axis to a mesh multiple and commit every argument to the
+    mesh (row-major review arrays partitioned on "data", everything else
+    replicated).  Returns (sharded_args, padded_rows).  Calling the driver's
+    fused jit on these committed inputs yields an SPMD executable."""
+    rv, cs, cols, group_params = args
+    rv_p, cols_p, target = shard_review_side(mesh, rows, rv, cols)
+    cs_p, gp_p = replicate_tree(mesh, (cs, group_params))
+    return (rv_p, cs_p, cols_p, gp_p), target
+
+
 def sharded_masks(driver, reviews, mesh: Mesh):
     """compute_masks, sharded over the mesh: the full evaluation step (match
     kernel + all violation-program groups) jitted once over the mesh with
     the resource axis partitioned.  Returns (ordered, mask, autoreject) like
-    TpuDriver.compute_masks."""
+    TpuDriver.compute_masks (R axis trimmed back to the single-device
+    bucket so results compare bit-for-bit)."""
     fn, ordered, rp, cp, cols, group_params = driver._device_inputs(reviews)
     rows = len(rp.arrays["valid"])
-    if rows % mesh.devices.size != 0:
-        raise ValueError(
-            f"row bucket {rows} not divisible by mesh size {mesh.devices.size}"
-        )
     args = (rp.arrays, cp.arrays, cols, group_params)
-    in_sh = shardings_for(mesh, rows, args)
-    out_sh = (
-        NamedSharding(mesh, P(None, "data")),
-        NamedSharding(mesh, P(None, "data")),
-    )
-    # fn is the driver's cached jitted callable; re-jit its wrapped function
-    # with explicit shardings under the mesh.
-    raw = fn.__wrapped__
-    sharded = jax.jit(raw, in_shardings=in_sh, out_shardings=out_sh)
+    placed, target = shard_args(mesh, rows, args)
     with mesh:
-        mask, autoreject = sharded(*args)
+        mask, autoreject = fn(*placed)
     both = np.asarray(jax.device_get((mask, autoreject)))
-    return ordered, both[0], both[1]
+    return ordered, both[0][:, :rows], both[1][:, :rows]
 
 
 def sharded_violation_counts(driver, reviews, mesh: Mesh):
@@ -87,12 +151,8 @@ def sharded_violation_counts(driver, reviews, mesh: Mesh):
     cross back to the host."""
     fn, ordered, rp, cp, cols, group_params = driver._device_inputs(reviews)
     rows = len(rp.arrays["valid"])
-    if rows % mesh.devices.size != 0:
-        raise ValueError(
-            f"row bucket {rows} not divisible by mesh size {mesh.devices.size}"
-        )
     args = (rp.arrays, cp.arrays, cols, group_params)
-    in_sh = shardings_for(mesh, rows, args)
+    placed, target = shard_args(mesh, rows, args)
     raw = fn.__wrapped__
 
     def counted(rv, cs, c, gp):
@@ -101,9 +161,8 @@ def sharded_violation_counts(driver, reviews, mesh: Mesh):
 
     sharded = jax.jit(
         counted,
-        in_shardings=in_sh,
         out_shardings=(NamedSharding(mesh, P()), NamedSharding(mesh, P())),
     )
     with mesh:
-        counts, rejects = sharded(*args)
+        counts, rejects = sharded(*placed)
     return ordered, np.asarray(counts), np.asarray(rejects)
