@@ -1,16 +1,26 @@
 #!/usr/bin/env python
-"""Headline benchmark: batched audit sweep throughput on TPU.
+"""Headline benchmark: END-TO-END audit sweep on TPU.
 
 Config (BASELINE.md "synthetic"): N constraint templates x M cluster
-resources, evaluated as one fused device computation (match kernel + all
-vectorized violation programs, counts reduced on device).  The baseline is
-the interpreter oracle (the architectural equivalent of the reference's
-single-threaded topdown evaluation, reference
-vendor/.../topdown/query.go:319) measured on a slice of the same workload.
+resources.  The measured sweep is the production steady state — one object
+mutated since the last sweep — and includes everything the audit manager
+pays: incremental review re-pack, the fused device dispatch (match kernel +
+all vectorized violation programs + on-device per-constraint top-k
+reduction), host render of up to cap violations per constraint
+(--constraint-violations-limit = 20, reference pkg/audit/manager.go:49), and
+the update-list build.
+
+Baseline note (see BASELINE.md): the reference is Go; no Go toolchain exists
+in this image and installs are forbidden, so the reference harness cannot
+run here.  vs_baseline is computed against this repo's Python interpreter
+oracle measured on a slice of the same workload, DERATED by 50x as a
+conservative stand-in for OPA's Go topdown (documented in BASELINE.md;
+the raw interp rate is logged to stderr so the derate is auditable).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 All diagnostics go to stderr.  Override sizes with BENCH_TEMPLATES /
-BENCH_RESOURCES / BENCH_BASELINE_SLICE env vars.
+BENCH_RESOURCES / BENCH_BASELINE_SLICE; select configs with BENCH_CONFIG in
+{synthetic, agilebank, latency, batch1m}.
 """
 
 from __future__ import annotations
@@ -19,6 +29,8 @@ import json
 import os
 import sys
 import time
+
+GO_TOPDOWN_DERATE = 50.0  # conservative Go-vs-Python-interp speed factor
 
 
 def log(msg: str):
@@ -69,20 +81,17 @@ def bench_agilebank():
             total += 1
     log(f"agilebank: {n_cons} constraints x {total} resources")
     c.audit()  # compile + warm
-    t0 = _t.time()
-    results = c.audit().results()
-    dur = _t.time() - t0
-    # audit cache hit: mutate one object to force repack for honest timing
+    # mutate one object so the sweep is honest steady-state, not a cache hit
     c.add_data({"apiVersion": "v1", "kind": "Namespace",
                 "metadata": {"name": "bench-epoch-bump"}})
     t0 = _t.time()
     results = c.audit().results()
-    dur_repack = _t.time() - t0
-    log(f"agilebank audit: cached {dur*1000:.0f}ms / repack "
-        f"{dur_repack*1000:.0f}ms, {len(results)} violations")
+    dur = _t.time() - t0
+    log(f"agilebank end-to-end audit: {dur*1000:.0f}ms, "
+        f"{len(results)} violations")
     print(json.dumps({
         "metric": f"agilebank end-to-end audit ({total} resources)",
-        "value": round(dur_repack, 3),
+        "value": round(dur, 3),
         "unit": "s",
         "vs_baseline": 0,
     }))
@@ -133,22 +142,75 @@ def bench_latency():
     }))
 
 
+def bench_batch1m():
+    """BASELINE config 'mesh': 1M admission-review batch streamed through
+    review_batch in device-sized chunks (the streaming-webhook shape)."""
+    import time as _t
+
+    from gatekeeper_tpu.client.client import Client
+    from gatekeeper_tpu.ops.driver import TpuDriver
+    from gatekeeper_tpu.util.synthetic import make_pods, make_templates
+
+    n_templates = int(os.environ.get("BENCH_TEMPLATES", "10"))
+    n_reviews = int(os.environ.get("BENCH_REVIEWS", "1000000"))
+    chunk = int(os.environ.get("BENCH_CHUNK", "65536"))
+    templates, constraints = make_templates(n_templates)
+    c = Client(driver=TpuDriver())
+    for t in templates:
+        c.add_template(t)
+    for cons in constraints:
+        c.add_constraint(cons)
+    pods = make_pods(min(n_reviews, 4096), seed=5)
+    reqs = []
+    for i in range(len(pods)):
+        p = pods[i]
+        reqs.append({
+            "kind": {"group": "", "version": "v1", "kind": "Pod"},
+            "name": p["metadata"]["name"],
+            "namespace": p["metadata"]["namespace"],
+            "operation": "CREATE",
+            "object": p,
+        })
+    driver = c.driver
+    # warm
+    driver.review_batch(reqs[:chunk] if len(reqs) >= chunk else reqs * (chunk // len(reqs) + 1))
+    t0 = _t.time()
+    done = 0
+    while done < n_reviews:
+        n = min(chunk, n_reviews - done)
+        batch = [reqs[(done + j) % len(reqs)] for j in range(n)]
+        driver.review_batch(batch)
+        done += n
+    dur = _t.time() - t0
+    rate = n_reviews / dur
+    log(f"batch1m: {n_reviews} reviews x {n_templates} constraints in "
+        f"{dur:.1f}s ({rate:.0f} reviews/s)")
+    print(json.dumps({
+        "metric": f"streamed admission reviews/sec ({n_templates} constraints, chunk {chunk})",
+        "value": round(rate, 1),
+        "unit": "reviews/s",
+        "vs_baseline": 0,
+    }))
+
+
 def main():
     config = os.environ.get("BENCH_CONFIG", "synthetic")
     if config == "agilebank":
         return bench_agilebank()
     if config == "latency":
         return bench_latency()
+    if config == "batch1m":
+        return bench_batch1m()
 
     n_templates = int(os.environ.get("BENCH_TEMPLATES", "500"))
     n_resources = int(os.environ.get("BENCH_RESOURCES", "100000"))
     baseline_slice = int(os.environ.get("BENCH_BASELINE_SLICE", "20"))
+    cap = int(os.environ.get("BENCH_CAP", "20"))
 
     import jax
 
     log(f"devices: {jax.devices()}")
 
-    from gatekeeper_tpu.engine.value import thaw
     from gatekeeper_tpu.util.synthetic import build_driver, make_pods, make_templates
 
     t0 = time.time()
@@ -157,48 +219,35 @@ def main():
     log(f"workload built: {n_templates} templates x {n_resources} resources "
         f"in {time.time()-t0:.1f}s")
 
-    reviews = [
-        driver.target.make_audit_review(thaw(o), api, k, n, ns)
-        for o, api, k, n, ns in driver.store.iter_objects()
-    ]
-
+    # ---- cold sweep: review build + pack + XLA compile + device + render
     t0 = time.time()
-    fn, ordered, rp, cp, cols, group_params = driver._device_inputs(reviews)
-    pack_s = time.time() - t0
-    log(f"host packing (ingest-side cost): {pack_s:.1f}s")
+    res, totals = client.audit_capped(cap)
+    cold_s = time.time() - t0
+    n_results = len(res.results())
+    n_capped = sum(1 for v in totals.values() if v[1] == "resources")
+    log(f"cold end-to-end capped audit: {cold_s:.1f}s "
+        f"({n_results} violations kept, {n_capped}/{len(totals)} constraints at cap)")
 
-    raw = fn.__wrapped__
-
-    def counted(rv, cs, c, gp):
-        mask, autoreject = raw(rv, cs, c, gp)
-        return mask.sum(axis=1), autoreject.sum(axis=1)
-
-    counted_jit = jax.jit(counted)
-    args = (rp.arrays, cp.arrays, cols, group_params)
-
-    t0 = time.time()
-    counts, rejects = counted_jit(*args)
-    counts.block_until_ready()
-    log(f"first sweep (incl. compile): {time.time()-t0:.1f}s")
-
+    # ---- steady state: one object mutated since the last sweep ----------
     times = []
-    for _ in range(5):
+    for i in range(5):
+        p = make_pods(1, seed=1000 + i, violation_rate=1.0)[0]
+        p["metadata"]["name"] = f"bench-delta-{i}"
+        client.add_data(p)
         t0 = time.time()
-        counts, rejects = counted_jit(*args)
-        counts.block_until_ready()
+        res, totals = client.audit_capped(cap)
         times.append(time.time() - t0)
     sweep_s = min(times)
-    import numpy as np
+    n_results = len(res.results())
+    log(f"steady-state end-to-end sweep (1 mutation): {sweep_s*1000:.1f}ms "
+        f"({n_results} violations kept)")
 
-    total_violations = int(np.asarray(counts).sum())
-    C, R = len(ordered), len(reviews)
-    cells = C * R
-    evals_per_sec = cells / sweep_s
-    log(f"steady-state sweep: {sweep_s*1000:.1f}ms for {cells} "
-        f"constraint-evals ({evals_per_sec/1e6:.2f}M evals/s), "
-        f"{total_violations} violating cells")
+    # mask-kernel throughput for continuity with round-1 reporting
+    cells = len(driver._ordered_constraints()) * driver._audit_pack.n_rows
+    log(f"device cells per sweep: {cells} "
+        f"({cells/sweep_s/1e6:.1f}M cell-evals/s end-to-end)")
 
-    # ---- baseline: interpreter oracle on a slice --------------------------
+    # ---- baseline: interpreter oracle on a slice, derated (BASELINE.md) --
     from gatekeeper_tpu.client.client import Client
     from gatekeeper_tpu.client.drivers import InterpDriver
 
@@ -215,16 +264,22 @@ def main():
     interp_s = time.time() - t0
     interp_cells = n_templates * baseline_slice
     interp_rate = interp_cells / interp_s
-    log(f"interp baseline: {interp_s:.1f}s for {interp_cells} evals "
-        f"({interp_rate:.0f} evals/s)")
+    est_ref_rate = interp_rate * GO_TOPDOWN_DERATE
+    est_ref_sweep_s = cells / est_ref_rate
+    log(f"interp oracle: {interp_rate:.0f} evals/s; estimated Go-topdown "
+        f"reference ({GO_TOPDOWN_DERATE:.0f}x derate): {est_ref_rate:.0f} "
+        f"evals/s -> {est_ref_sweep_s:.0f}s for this sweep")
 
     print(
         json.dumps(
             {
-                "metric": f"audit constraint-evals/sec ({n_templates} templates x {n_resources} resources, fused TPU sweep)",
-                "value": round(evals_per_sec, 1),
-                "unit": "evals/s",
-                "vs_baseline": round(evals_per_sec / interp_rate, 1),
+                "metric": (
+                    f"end-to-end audit sweep seconds ({n_templates} templates"
+                    f" x {n_resources} resources, cap {cap}, steady-state)"
+                ),
+                "value": round(sweep_s, 3),
+                "unit": "s",
+                "vs_baseline": round(est_ref_sweep_s / sweep_s, 1),
             }
         )
     )

@@ -109,6 +109,7 @@ class AuditManager:
         gk_namespace: str = "gatekeeper-system",
         review_batch: int = DEFAULT_REVIEW_BATCH,
         require_crd: bool = False,
+        exact_totals: bool = False,
     ):
         self.kube = kube
         self.client = client
@@ -124,6 +125,12 @@ class AuditManager:
         self.gk_namespace = gk_namespace
         self.review_batch = review_batch
         self.require_crd = require_crd
+        # --audit-exact-totals: render EVERY violating cell so
+        # status.totalViolations counts violation results exactly (reference
+        # manager.go:188 semantics).  Off by default: the from-cache sweep
+        # uses the driver's cap-aware device reduction, whose totals are
+        # exact below the cap and "violating resources" at/over it.
+        self.exact_totals = exact_totals
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -175,11 +182,40 @@ class AuditManager:
             }
 
             if self.from_cache:
-                results = self.client.audit().results()
+                capped = (
+                    not self.exact_totals
+                    and hasattr(self.client, "audit_capped")
+                )
+                if capped:
+                    responses, driver_totals = self.client.audit_capped(
+                        self.violations_limit
+                    )
+                    results = responses.results()
+                else:
+                    results = self.client.audit().results()
                 self._add_results(
                     results, update_lists, totals_per_constraint,
                     totals_per_action, timestamp,
                 )
+                if capped:
+                    # driver-reported totals override the (capped) result
+                    # iteration counts; constraints are cluster-scoped so
+                    # the key namespace segment is empty
+                    rendered_per: Dict[Tuple[str, str], int] = {}
+                    action_per: Dict[Tuple[str, str], str] = {}
+                    for r in results:
+                        kk = (r.constraint.get("kind", ""),
+                              (r.constraint.get("metadata") or {}).get("name", ""))
+                        rendered_per[kk] = rendered_per.get(kk, 0) + 1
+                        action_per[kk] = r.enforcement_action
+                    for kk, (n, _how) in driver_totals.items():
+                        totals_per_constraint[f"{kk[0]}//{kk[1]}"] = n
+                        extra = n - rendered_per.get(kk, 0)
+                        if extra > 0 and kk in action_per:
+                            a = action_per[kk]
+                            totals_per_action[a] = (
+                                totals_per_action.get(a, 0) + extra
+                            )
             else:
                 self._audit_resources(
                     update_lists, totals_per_constraint, totals_per_action,

@@ -223,6 +223,19 @@ class Client:
 
     def audit(self, tracing: bool = False) -> Responses:
         results, trace = self.driver.audit(tracing=tracing)
+        return self._audit_responses(results, trace)
+
+    def audit_capped(self, cap: int, tracing: bool = False):
+        """Audit keeping at most `cap` violations per constraint, with
+        per-constraint totals reported by the driver:
+        -> (Responses, {(kind, name): (count, "exact"|"resources")}).
+        On the TPU driver the sweep reduces on device to counts + top-k
+        cells so the host render is bounded by C x cap (the
+        --constraint-violations-limit write-back never needs more)."""
+        results, totals, trace = self.driver.audit_capped(cap, tracing=tracing)
+        return self._audit_responses(results, trace), totals
+
+    def _audit_responses(self, results, trace) -> Responses:
         for r in results:
             try:
                 r.resource = self.target.handle_violation(r.review)

@@ -94,6 +94,10 @@ class InventoryStore:
     per write epoch so queries share one immutable inventory tree.
     """
 
+    # change-log entries retained for incremental consumers (the audit pack
+    # cache re-packs only changed rows); beyond this, fall back to rebuild
+    CHANGELOG_MAX = 262_144
+
     def __init__(self):
         self.tree: Dict[str, Any] = {}
         self._frozen = None
@@ -101,6 +105,43 @@ class InventoryStore:
         # monotonically increasing write epoch: lets evaluators cache
         # packed tensors across sweeps over an unchanged inventory
         self.epoch = 0
+        # change log: parallel (epoch, segments) lists; segments None marks
+        # a wipe.  Consumers that fall behind _change_floor must rebuild.
+        self._change_epochs: List[int] = []
+        self._change_segs: List[Optional[Tuple[str, ...]]] = []
+        self._change_floor = 0
+
+    def _log_change(self, segments: Optional[Tuple[str, ...]]):
+        self._change_epochs.append(self.epoch)
+        self._change_segs.append(segments)
+        if len(self._change_epochs) > self.CHANGELOG_MAX:
+            drop = len(self._change_epochs) // 2
+            self._change_floor = self._change_epochs[drop - 1]
+            self._change_epochs = self._change_epochs[drop:]
+            self._change_segs = self._change_segs[drop:]
+
+    def changes_since(self, epoch: int) -> Optional[List[Optional[Tuple[str, ...]]]]:
+        """Segment tuples changed after `epoch` (None entry = wipe), or
+        None when the log no longer reaches back that far."""
+        import bisect
+
+        with self._lock:
+            if epoch < self._change_floor:
+                return None
+            i = bisect.bisect_right(self._change_epochs, epoch)
+            return list(self._change_segs[i:])
+
+    def get(self, segments: Tuple[str, ...]) -> Any:
+        """The frozen object at segments, or None."""
+        with self._lock:
+            node = self.tree
+            for seg in segments[:-1]:
+                node = node.get(seg) if isinstance(node, dict) else None
+                if node is None:
+                    return None
+            if not isinstance(node, dict):
+                return None
+            return node.get(segments[-1])
 
     def put(self, segments: Tuple[str, ...], obj: Any):
         with self._lock:
@@ -110,6 +151,7 @@ class InventoryStore:
             node[segments[-1]] = freeze(obj)
             self._frozen = None
             self.epoch += 1
+            self._log_change(tuple(segments))
 
     def delete(self, segments: Tuple[str, ...]) -> bool:
         with self._lock:
@@ -118,6 +160,7 @@ class InventoryStore:
                 self.tree = {}
                 self._frozen = None
                 self.epoch += 1
+                self._log_change(None)
                 return had
             node = self.tree
             for seg in segments[:-1]:
@@ -128,6 +171,7 @@ class InventoryStore:
                 del node[segments[-1]]
                 self._frozen = None
                 self.epoch += 1
+                self._log_change(tuple(segments))
                 return True
             return False
 
@@ -323,6 +367,35 @@ class InterpDriver:
                                     f"violation {kind}/{cname} on {kind_name}/{name}: {v.get('msg')}"
                                 )
             return results, ("\n".join(trace) if tracing else None)
+
+    def audit_capped(
+        self, cap: int, tracing: bool = False
+    ) -> Tuple[List[Result], Dict[Tuple[str, str], Tuple[int, str]], Optional[str]]:
+        """Audit with at most `cap` violations kept per constraint, plus
+        per-constraint totals: {(kind, name): (count, how)} where how is
+        "exact" (count = total violation results, reference
+        totalViolationsPerConstraint semantics, manager.go:188) or
+        "resources" (cap reached; count = violating resources, the bounded
+        statistic the device sweep can report without rendering every cell).
+        The interpreter renders everything anyway, so totals stay exact; the
+        TPU driver overrides this with a device-reduced top-k sweep."""
+        results, trace = self.audit(tracing=tracing)
+        totals: Dict[Tuple[str, str], Tuple[int, str]] = {}
+        with self._lock:
+            for kind in self.constraints:
+                for cname in self.constraints[kind]:
+                    totals[(kind, cname)] = (0, "exact")
+        kept: List[Result] = []
+        per: Dict[Tuple[str, str], int] = {}
+        for r in results:
+            key = (r.constraint.get("kind", ""),
+                   (r.constraint.get("metadata") or {}).get("name", ""))
+            n, _how = totals.get(key, (0, "exact"))
+            totals[key] = (n + 1, "exact")
+            if per.get(key, 0) < cap:
+                per[key] = per.get(key, 0) + 1
+                kept.append(r)
+        return kept, totals, trace
 
     def dump(self) -> str:
         from ..engine.value import thaw

@@ -105,6 +105,10 @@ class TemplatePolicy:
     def _validate(self):
         # data refs may only touch data.inventory / data.lib (the reference
         # enforces this via regorewriter externs, client.go:291-299).
+        # Records uses_inventory: policies that never read data.inventory
+        # have violations that depend only on (review, parameters), which
+        # lets evaluators memoize rendered cells across inventory changes.
+        self.uses_inventory = False
         for cm in [self.main, *self.libs.values()]:
             for r in cm.module.rules:
                 for node in _walk_rule(r):
@@ -116,6 +120,8 @@ class TemplatePolicy:
                             raise RegoCompileError(
                                 "data references are restricted to data.inventory and data.lib"
                             )
+                        if isinstance(first, Scalar) and first.value == "inventory":
+                            self.uses_inventory = True
         self._check_recursion()
 
     def _check_recursion(self):

@@ -1,0 +1,287 @@
+"""Incremental audit packing: the inventory as resident columnar arrays.
+
+The production audit loop sweeps a mostly-unchanged inventory every interval
+(reference pkg/audit/manager.go:406-431 re-lists everything; here the
+replicated store IS the source).  Rebuilding reviews + packed tensors for
+100k resources costs seconds; this cache keeps the packed row-major arrays
+resident and applies only the store's change log per sweep:
+
+  - one row per cached object, stable across sweeps (tombstoned on delete,
+    reused from a free list)
+  - per-row re-pack on object change (pack_reviews/extract_columns on a
+    single review, written into the row slot with width growth as needed)
+  - Namespace objects re-pack every row in that namespace: packed rows bake
+    in namespaceSelector label resolution + autoreject against the cached
+    Namespace (ops/pack.py ns_mode), and a stale row could UNDER-approximate
+    the device mask, which the exactness filter cannot repair
+  - wipes, subtree deletions, layout changes (new column specs) and
+    change-log overruns fall back to a full rebuild
+
+Array shapes are bucketed (powers of two) so the fused executable survives
+row growth until a bucket boundary.  SURVEY.md section 7 stage 4:
+"inventory store as columnar host arrays with incremental device updates".
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .columns import extract_columns
+from .interning import Interner
+from .pack import PAD, UNDEF, pack_reviews
+
+# fill values per review-pack key: what an empty/padded row must contain
+_RP_FILL = {
+    "group": UNDEF,
+    "kind": UNDEF,
+    "ns_name": UNDEF,
+    "ns_mode": 0,
+    "always": False,
+    "ns_empty": False,
+    "is_ns": False,
+    "obj_empty": True,
+    "old_empty": True,
+    "autoreject": False,
+    "valid": False,
+    "obj_labels": PAD,
+    "old_labels": PAD,
+    "ns_labels": PAD,
+}
+
+# fill values per column leaf (ops/columns.py encoding)
+_COL_FILL = {
+    "tcode": 0,  # T_UNDEF
+    "sid": Interner.MISSING,
+    "num": 0.0,
+    "mask": False,
+    "ids": Interner.PAD,
+}
+
+_NS_PATH_PREFIX = ("cluster", "v1", "Namespace")
+
+
+def _bucket(n: int, minimum: int = 8) -> int:
+    b = max(minimum, 1)
+    while b < n:
+        b *= 2
+    return b
+
+
+def _path_identity(seg: Tuple[str, ...]) -> Optional[Tuple[str, str, str, str]]:
+    """(api, kind, name, namespace) for an object-depth path, else None."""
+    if seg[0] == "cluster" and len(seg) == 4:
+        return seg[1], seg[2], seg[3], ""
+    if seg[0] == "namespace" and len(seg) == 5:
+        return seg[2], seg[3], seg[4], seg[1]
+    return None  # subtree-depth path: caller falls back to rebuild
+
+
+class AuditPackCache:
+    """Resident packed audit inputs, synced to an InventoryStore's change
+    log.  All access happens under the owning driver's lock."""
+
+    # beyond this many pending changes a batch rebuild is cheaper than
+    # per-row packing (native batch pack is ~15us/row vs ~200us/row here)
+    REBUILD_FRACTION = 8
+
+    def __init__(self):
+        self.synced_epoch = -1
+        self.col_keys: Optional[tuple] = None
+        self.reviews: List[Optional[dict]] = []
+        self.row_of: Dict[Tuple[str, ...], int] = {}
+        self.row_path: List[Optional[Tuple[str, ...]]] = []
+        self.row_ns: List[str] = []
+        self.row_gen: List[int] = []  # bumped per re-pack; memo invalidation
+        self.ns_rows: Dict[str, set] = {}
+        self.free: List[int] = []
+        self.rp: Optional[Dict[str, np.ndarray]] = None
+        self.cols: Optional[Dict[Tuple, Dict[str, np.ndarray]]] = None
+        self.capacity = 0
+        self.n_rows = 0
+        self._gen = 0
+
+    # ---- public -----------------------------------------------------------
+
+    def sync(self, driver, col_specs) -> bool:
+        """Bring the resident arrays up to date with driver.store.  Returns
+        True when anything changed (mask-level caches must invalidate)."""
+        store = driver.store
+        keys = tuple(sorted(s.key for s in col_specs))
+        if self.rp is None or self.col_keys != keys:
+            self._rebuild(driver, col_specs)
+            self.col_keys = keys
+            return True
+        if store.epoch == self.synced_epoch:
+            return False
+        changes = store.changes_since(self.synced_epoch)
+        if changes is None or len(changes) > max(
+            1024, self.n_rows // self.REBUILD_FRACTION
+        ):
+            self._rebuild(driver, col_specs)
+            return True
+        seen = set()
+        ordered_changes = []
+        for seg in reversed(changes):  # keep only the LAST change per path
+            if seg is None or _path_identity(seg) is None:
+                self._rebuild(driver, col_specs)
+                return True
+            if seg in seen:
+                continue
+            seen.add(seg)
+            ordered_changes.append(seg)
+        ns_repack: set = set()
+        for seg in reversed(ordered_changes):
+            self._apply(driver, seg, col_specs)
+            if seg[:3] == _NS_PATH_PREFIX:
+                ns_repack.add(seg[3])
+        for ns in ns_repack:
+            for r in list(self.ns_rows.get(ns, ())):
+                review = self.reviews[r]
+                if review is not None:
+                    self._pack_row(driver, r, review, col_specs)
+        self.synced_epoch = store.epoch
+        return True
+
+    # ---- rebuild ----------------------------------------------------------
+
+    def _rebuild(self, driver, col_specs):
+        from ..engine.value import thaw
+
+        store = driver.store
+        objs = list(store.iter_objects())
+        reviews = []
+        paths = []
+        for obj_frozen, api, kind, name, ns in objs:
+            reviews.append(
+                driver.target.make_audit_review(thaw(obj_frozen), api, kind, name, ns)
+            )
+            if ns:
+                paths.append(("namespace", ns, api, kind, name))
+            else:
+                paths.append(("cluster", api, kind, name))
+        rp = pack_reviews(reviews, driver.interner, store.cached_namespace)
+        rows = len(rp.arrays["valid"])
+        cols = extract_columns(reviews, col_specs, driver.interner, rows)
+        self.rp = dict(rp.arrays)
+        self.cols = {k: dict(v) for k, v in cols.items()}
+        self.capacity = rows
+        self.n_rows = len(reviews)
+        self.reviews = list(reviews)
+        self.row_path = list(paths)
+        self.row_of = {p: i for i, p in enumerate(paths)}
+        self.row_ns = [r.get("namespace", "") or "" for r in reviews]
+        self._gen += 1
+        self.row_gen = [self._gen] * len(reviews)
+        self.ns_rows = {}
+        for i, ns in enumerate(self.row_ns):
+            if ns:
+                self.ns_rows.setdefault(ns, set()).add(i)
+        self.free = []
+        self.synced_epoch = store.epoch
+
+    # ---- incremental ------------------------------------------------------
+
+    def _apply(self, driver, seg: Tuple[str, ...], col_specs):
+        from ..engine.value import thaw
+
+        api, kind, name, ns = _path_identity(seg)
+        obj = driver.store.get(seg)
+        row = self.row_of.get(seg)
+        if obj is None:
+            if row is not None:
+                self._tombstone(row, seg)
+            return
+        review = driver.target.make_audit_review(thaw(obj), api, kind, name, ns)
+        if row is None:
+            row = self._alloc_row()
+            self.row_of[seg] = row
+            self.row_path[row] = seg
+        self.reviews[row] = review
+        old_ns = self.row_ns[row]
+        if old_ns and old_ns != ns:
+            self.ns_rows.get(old_ns, set()).discard(row)
+        self.row_ns[row] = ns
+        if ns:
+            self.ns_rows.setdefault(ns, set()).add(row)
+        self._pack_row(driver, row, review, col_specs)
+
+    def _tombstone(self, row: int, seg: Tuple[str, ...]):
+        self.reviews[row] = None
+        self.row_of.pop(seg, None)
+        self.row_path[row] = None
+        ns = self.row_ns[row]
+        if ns:
+            self.ns_rows.get(ns, set()).discard(row)
+        self.row_ns[row] = ""
+        self.rp["valid"][row] = False
+        self._gen += 1
+        self.row_gen[row] = self._gen
+        self.free.append(row)
+
+    def _alloc_row(self) -> int:
+        if self.free:
+            return self.free.pop()
+        if self.n_rows >= self.capacity:
+            self._grow_rows(_bucket(self.n_rows + 1))
+        r = self.n_rows
+        self.n_rows += 1
+        self.reviews.append(None)
+        self.row_path.append(None)
+        self.row_ns.append("")
+        self.row_gen.append(0)
+        return r
+
+    def _grow_rows(self, new_capacity: int):
+        def grow(arr: np.ndarray, fill):
+            out = np.full((new_capacity,) + arr.shape[1:], fill, dtype=arr.dtype)
+            out[: arr.shape[0]] = arr
+            return out
+
+        self.rp = {k: grow(v, _RP_FILL[k]) for k, v in self.rp.items()}
+        self.cols = {
+            ck: {leaf: grow(arr, _COL_FILL[leaf]) for leaf, arr in leaves.items()}
+            for ck, leaves in self.cols.items()
+        }
+        self.capacity = new_capacity
+
+    def _write_leaf(self, holder: dict, key, row: int, src: np.ndarray, fill):
+        """Write one packed row into its slot, growing trailing (width)
+        dims when this row exceeds them.  Rows are reset to the fill value
+        first so narrower rows leave no stale tail."""
+        dst = holder[key]
+        if src.shape != dst.shape[1:]:
+            target = tuple(
+                max(a, b) for a, b in zip(dst.shape[1:], src.shape)
+            )
+            if target != dst.shape[1:]:
+                grown = np.full((dst.shape[0],) + target, fill, dtype=dst.dtype)
+                grown[tuple(slice(0, s) for s in dst.shape)] = dst
+                holder[key] = grown
+                dst = grown
+        dst[row] = fill
+        if src.ndim:
+            dst[(row,) + tuple(slice(0, s) for s in src.shape)] = src
+        else:
+            dst[row] = src
+
+    def _pack_row(self, driver, row: int, review: dict, col_specs):
+        rp1 = pack_reviews(
+            [review], driver.interner, driver.store.cached_namespace,
+            bucket_rows=False,
+        )
+        for key, arr in rp1.arrays.items():
+            self._write_leaf(self.rp, key, row, arr[0], _RP_FILL[key])
+        cols1 = extract_columns([review], col_specs, driver.interner, 1)
+        for ckey, leaves in cols1.items():
+            holder = self.cols.setdefault(ckey, {})
+            for leaf, arr in leaves.items():
+                if leaf not in holder:
+                    holder[leaf] = np.full(
+                        (self.capacity,) + arr.shape[1:],
+                        _COL_FILL[leaf], dtype=arr.dtype,
+                    )
+                self._write_leaf(holder, leaf, row, arr[0], _COL_FILL[leaf])
+        self._gen += 1
+        self.row_gen[row] = self._gen

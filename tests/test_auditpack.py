@@ -1,0 +1,204 @@
+"""Incremental audit packing (ops/auditpack.py): the resident columnar
+arrays must stay bit-identical to a from-scratch rebuild under any sequence
+of store mutations — including the namespace dependency (packed rows bake in
+namespaceSelector resolution against the cached Namespace, so a Namespace
+change must re-pack its dependents or the device mask under-approximates)."""
+
+import copy
+
+import numpy as np
+
+from gatekeeper_tpu.client.client import Client
+from gatekeeper_tpu.ops.driver import TpuDriver
+from gatekeeper_tpu.util.synthetic import make_pods, make_templates
+
+
+NS_TEMPLATE = {
+    "apiVersion": "templates.gatekeeper.sh/v1beta1",
+    "kind": "ConstraintTemplate",
+    "metadata": {"name": "k8snsselector"},
+    "spec": {
+        "crd": {"spec": {"names": {"kind": "K8sNsSelector"}}},
+        "targets": [{
+            "target": "admission.k8s.gatekeeper.sh",
+            "rego": """
+package k8snsselector
+
+violation[{"msg": msg}] {
+  input.review.object.metadata.name
+  msg := "selected namespace resource"
+}
+""",
+        }],
+    },
+}
+
+NS_CONSTRAINT = {
+    "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+    "kind": "K8sNsSelector",
+    "metadata": {"name": "ns-sel"},
+    "spec": {
+        "match": {
+            "kinds": [{"apiGroups": [""], "kinds": ["Pod"]}],
+            "namespaceSelector": {"matchLabels": {"team": "audited"}},
+        },
+    },
+}
+
+
+def _fresh_like(client):
+    """A new TpuDriver-backed client rebuilt from the same logical state."""
+    c2 = Client(driver=TpuDriver())
+    for kind in client.driver.templates:
+        c2.driver.put_template(kind, client.driver.templates[kind])
+        c2.driver.programs[kind] = client.driver.programs[kind]
+    for kind in client.driver.constraints:
+        for name, cons in client.driver.constraints[kind].items():
+            c2.driver.put_constraint(kind, name, copy.deepcopy(cons))
+    from gatekeeper_tpu.engine.value import thaw
+
+    for obj, api, k, n, ns in client.driver.store.iter_objects():
+        segs = (
+            ("namespace", ns, api, k, n) if ns else ("cluster", api, k, n)
+        )
+        c2.driver.store.put(segs, thaw(obj))
+    return c2
+
+
+def _audit_keys(client, cap=10_000):
+    res, _tot = client.audit_capped(cap)
+    return sorted(
+        (r.constraint["kind"], r.constraint["metadata"]["name"], r.msg,
+         str(r.review.get("object", {}).get("metadata", {}).get("name")))
+        for r in res.results()
+    )
+
+
+def _loaded(n_templates=5, n_pods=30):
+    templates, constraints = make_templates(n_templates)
+    c = Client(driver=TpuDriver())
+    for t in templates:
+        c.add_template(t)
+    for cons in constraints:
+        c.add_constraint(cons)
+    for p in make_pods(n_pods, seed=3, violation_rate=0.4):
+        c.add_data(p)
+    return c
+
+
+def test_incremental_update_matches_rebuild():
+    c = _loaded()
+    c.audit_capped(100)  # prime the resident pack
+    # mutate: one pod flips to privileged
+    bad = make_pods(1, seed=99, violation_rate=0.0)[0]
+    bad["metadata"]["name"] = "pod-5"
+    bad["metadata"]["namespace"] = "ns-5"
+    bad["spec"]["containers"][0]["securityContext"] = {"privileged": True}
+    c.add_data(bad)
+    assert _audit_keys(c) == _audit_keys(_fresh_like(c))
+
+
+def test_incremental_add_and_delete_matches_rebuild():
+    c = _loaded()
+    c.audit_capped(100)
+    extra = make_pods(3, seed=50, violation_rate=1.0)
+    for i, p in enumerate(extra):
+        p["metadata"]["name"] = f"extra-{i}"
+        c.add_data(p)
+    # delete two originals
+    c.remove_data({"apiVersion": "v1", "kind": "Pod",
+                   "metadata": {"name": "pod-1", "namespace": "ns-1"}})
+    c.remove_data({"apiVersion": "v1", "kind": "Pod",
+                   "metadata": {"name": "pod-2", "namespace": "ns-2"}})
+    keys = _audit_keys(c)
+    assert keys == _audit_keys(_fresh_like(c))
+    assert not any(k[3] == "pod-1" for k in keys)
+    assert any("extra-0" == k[3] for k in keys)
+
+
+def test_namespace_change_repacks_dependent_rows():
+    """Adding/labeling a cached Namespace flips namespaceSelector matching
+    for every pod in it; a stale packed row would hide the violations."""
+    c = _loaded(n_templates=0, n_pods=0)
+    c.add_template(NS_TEMPLATE)
+    c.add_constraint(NS_CONSTRAINT)
+    pods = make_pods(6, seed=11, violation_rate=0.0)
+    for p in pods:
+        p["metadata"]["namespace"] = "teamspace"
+        c.add_data(p)
+    # namespace not cached -> no match (plus autoreject semantics host-side)
+    c.audit_capped(100)  # prime
+    assert _audit_keys(c) == _audit_keys(_fresh_like(c))
+    # now cache the namespace WITH the selected label: all pods must violate
+    c.add_data({"apiVersion": "v1", "kind": "Namespace",
+                "metadata": {"name": "teamspace",
+                             "labels": {"team": "audited"}}})
+    keys = _audit_keys(c)
+    assert keys == _audit_keys(_fresh_like(c))
+    assert len([k for k in keys if k[0] == "K8sNsSelector"]) == 6
+    # flip the label off: violations must disappear
+    c.add_data({"apiVersion": "v1", "kind": "Namespace",
+                "metadata": {"name": "teamspace",
+                             "labels": {"team": "other"}}})
+    keys = _audit_keys(c)
+    assert keys == _audit_keys(_fresh_like(c))
+    assert not [k for k in keys if k[0] == "K8sNsSelector"]
+
+
+def test_wipe_resets_pack():
+    c = _loaded()
+    c.audit_capped(100)
+    c.wipe_data()
+    assert _audit_keys(c) == []
+    # refill after wipe works
+    for p in make_pods(4, seed=60, violation_rate=1.0):
+        c.add_data(p)
+    assert _audit_keys(c) == _audit_keys(_fresh_like(c))
+
+
+def test_row_growth_past_capacity():
+    c = _loaded(n_templates=3, n_pods=4)
+    c.audit_capped(100)
+    cap0 = c.driver._audit_pack.capacity
+    for p in make_pods(40, seed=70, violation_rate=0.3):
+        p["metadata"]["name"] = "grown-" + p["metadata"]["name"]
+        c.add_data(p)
+    assert _audit_keys(c) == _audit_keys(_fresh_like(c))
+    assert c.driver._audit_pack.capacity > cap0
+
+
+def test_memo_invalidated_on_template_change():
+    c = _loaded(n_templates=4, n_pods=20)
+    k1 = _audit_keys(c, cap=5)
+    assert _audit_keys(c, cap=5) == k1  # memoized second sweep identical
+    # removing a constraint changes the constraint side; memo must not leak
+    kind = sorted(c.driver.constraints)[0]
+    name = sorted(c.driver.constraints[kind])[0]
+    c.driver.delete_constraint(kind, name)
+    k2 = _audit_keys(c, cap=5)
+    assert not [k for k in k2 if k[0] == kind and k[1] == name]
+
+
+def test_full_audit_uses_resident_pack():
+    c = _loaded()
+    exact1 = sorted(
+        (r.constraint["kind"], r.msg,
+         str(r.review.get("object", {}).get("metadata", {}).get("name")))
+        for r in c.audit().results()
+    )
+    # mutate and re-audit through the same resident pack
+    p = make_pods(1, seed=80, violation_rate=1.0)[0]
+    p["metadata"]["name"] = "late-pod"
+    c.add_data(p)
+    exact2 = sorted(
+        (r.constraint["kind"], r.msg,
+         str(r.review.get("object", {}).get("metadata", {}).get("name")))
+        for r in c.audit().results()
+    )
+    fresh = sorted(
+        (r.constraint["kind"], r.msg,
+         str(r.review.get("object", {}).get("metadata", {}).get("name")))
+        for r in _fresh_like(c).audit().results()
+    )
+    assert exact2 == fresh
+    assert exact1 != exact2
