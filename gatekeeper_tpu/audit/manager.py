@@ -33,7 +33,7 @@ from .. import logging as gklog
 from ..kube.inmem import GVK, InMemoryKube, NotFound
 from ..process.excluder import AUDIT, Excluder
 from ..target.target import AugmentedUnstructured
-from ..util import KNOWN_ENFORCEMENT_ACTIONS
+from ..util import KNOWN_ENFORCEMENT_ACTIONS, get_enforcement_action
 
 log = gklog.get("audit")
 
@@ -199,20 +199,25 @@ class AuditManager:
                 )
                 if capped:
                     # driver-reported totals override the (capped) result
-                    # iteration counts; constraints are cluster-scoped so
-                    # the key namespace segment is empty
+                    # iteration counts; the status key must match what
+                    # _add_results derived from the constraint object
                     rendered_per: Dict[Tuple[str, str], int] = {}
-                    action_per: Dict[Tuple[str, str], str] = {}
                     for r in results:
                         kk = (r.constraint.get("kind", ""),
                               (r.constraint.get("metadata") or {}).get("name", ""))
                         rendered_per[kk] = rendered_per.get(kk, 0) + 1
-                        action_per[kk] = r.enforcement_action
                     for kk, (n, _how) in driver_totals.items():
-                        totals_per_constraint[f"{kk[0]}//{kk[1]}"] = n
+                        cobj = None
+                        if hasattr(self.client, "get_constraint"):
+                            cobj = self.client.get_constraint(*kk)
+                        key = (
+                            self._constraint_key(cobj) if cobj
+                            else f"{kk[0]}//{kk[1]}"
+                        )
+                        totals_per_constraint[key] = n
                         extra = n - rendered_per.get(kk, 0)
-                        if extra > 0 and kk in action_per:
-                            a = action_per[kk]
+                        if extra > 0:
+                            a = get_enforcement_action(cobj or {})
                             totals_per_action[a] = (
                                 totals_per_action.get(a, 0) + extra
                             )

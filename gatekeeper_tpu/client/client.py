@@ -165,6 +165,10 @@ class Client:
             raise ClientError("constraint requires kind and metadata.name")
         return self.driver.delete_constraint(kind, name)
 
+    def get_constraint(self, kind: str, name: str) -> Optional[dict]:
+        """The engine's stored constraint object (None if absent)."""
+        return self.driver.get_constraint(kind, name)
+
     # ---- data -------------------------------------------------------------
 
     def add_data(self, obj: Any):
@@ -229,9 +233,9 @@ class Client:
         """Audit keeping at most `cap` violations per constraint, with
         per-constraint totals reported by the driver:
         -> (Responses, {(kind, name): (count, "exact"|"resources")}).
-        On the TPU driver the sweep reduces on device to counts + top-k
-        cells so the host render is bounded by C x cap (the
-        --constraint-violations-limit write-back never needs more)."""
+        On the TPU driver the host render walks the device candidate mask
+        and stops at cap per constraint (the --constraint-violations-limit
+        write-back never needs more)."""
         results, totals, trace = self.driver.audit_capped(cap, tracing=tracing)
         return self._audit_responses(results, trace), totals
 

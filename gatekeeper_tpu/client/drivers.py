@@ -264,6 +264,10 @@ class InterpDriver:
         with self._lock:
             return self.store.delete(segments)
 
+    def get_constraint(self, kind: str, name: str) -> Optional[dict]:
+        with self._lock:
+            return (self.constraints.get(kind) or {}).get(name)
+
     # ---- evaluation -------------------------------------------------------
 
     @staticmethod
@@ -378,7 +382,8 @@ class InterpDriver:
         "resources" (cap reached; count = violating resources, the bounded
         statistic the device sweep can report without rendering every cell).
         The interpreter renders everything anyway, so totals stay exact; the
-        TPU driver overrides this with a device-reduced top-k sweep."""
+        TPU driver overrides this with a cap-bounded render over the device
+        candidate mask."""
         results, trace = self.audit(tracing=tracing)
         totals: Dict[Tuple[str, str], Tuple[int, str]] = {}
         with self._lock:

@@ -59,10 +59,6 @@ class TpuDriver(InterpDriver):
         # re-uploading vocab-sized tables to N chips every call would cost
         # N RTTs behind a network relay; cached on the constraint epoch
         self._cs_device_cache = None
-        # cap-aware audit: fused sweep + per-constraint counts + top-k cell
-        # indices, keyed on (constraint epoch, k)
-        self._topk_jit = None
-        self._topk_key = None
         # resident incremental audit packing (ops/auditpack.py) + rendered
         # cell memo: violations for an unchanged (constraint, row) pair are
         # deterministic unless the template reads data.inventory
@@ -71,7 +67,6 @@ class TpuDriver(InterpDriver):
         self._audit_pack = AuditPackCache()
         self._render_memo: Dict[Tuple, Tuple[int, list]] = {}
         self._render_memo_epoch = -1
-        self._audit_topk_cache = None
         # constraint-side packing is invalidated on any template/constraint
         # mutation and on vocabulary growth (str-pred tables are vocab-sized)
         self._cs_epoch = 0
@@ -109,13 +104,10 @@ class TpuDriver(InterpDriver):
         self._cs_device_cache = None
         self._fused = None
         self._fused_key = None
-        self._topk_jit = None
-        self._topk_key = None
         from .auditpack import AuditPackCache
 
         self._audit_pack = AuditPackCache()
         self._render_memo.clear()
-        self._audit_topk_cache = None
 
     # ---- device evaluation ------------------------------------------------
 
@@ -202,17 +194,25 @@ class TpuDriver(InterpDriver):
         self._fused_key = self._cs_epoch
         return self._fused, side
 
+    def _repack_if_vocab_grew(self, fn, side):
+        """Row packing may have interned new strings; constraint-side string
+        predicate tables are vocab-sized, so re-pack them if so.  Shared by
+        the review and audit input paths — the invalidation rule must stay
+        identical between them."""
+        if self.interner.snapshot_size() > self._cs_cache[0][1]:
+            return self._fused_fn()
+        return fn, side
+
     def _device_inputs(self, reviews: List[dict]):
         """Pack review-side arrays + columns; rebuild the constraint side if
         these reviews interned new strings (pred tables are vocab-sized)."""
         fn, side = self._fused_fn()
-        ordered, cp, groups, col_specs = side
+        _ordered, _cp, _groups, col_specs = side
         rp = pack_reviews(reviews, self.interner, self.store.cached_namespace)
         rows = len(rp.arrays["valid"])
         cols = extract_columns(reviews, col_specs, self.interner, rows)
-        if self.interner.snapshot_size() > self._cs_cache[0][1]:
-            fn, side = self._fused_fn()
-            ordered, cp, groups, col_specs = side
+        fn, side = self._repack_if_vocab_grew(fn, side)
+        ordered, cp, groups, _col_specs = side
         group_params = [packed for _prog, _idxs, packed in groups]
         return fn, ordered, rp, cp, cols, group_params
 
@@ -264,26 +264,6 @@ class TpuDriver(InterpDriver):
         )
         both = np.asarray(jnp.stack([mask, autoreject]))  # one fetch
         return ordered, both[0][:, :rows], both[1][:, :rows]
-
-    def _fused_counts_fn(self):
-        """Fused sweep + on-device per-constraint candidate counts.  The
-        [C, R] mask comes back too: one bulk fetch measures ~equal to the
-        kernel itself (round-1: 127ms total for 500x100k incl. fetch),
-        whereas a device top_k must sort each 100k-wide row — measured 25x
-        slower than this path on v5e.  The CAP bounds host RENDER, and the
-        first-k selection per row is a cheap host flatnonzero."""
-        fn, _side = self._fused_fn()
-        if self._topk_jit is not None and self._topk_key == self._cs_epoch:
-            return self._topk_jit
-        raw = fn.__wrapped__
-
-        def reduced(rv, cs, cols, gp):
-            mask, _autoreject = raw(rv, cs, cols, gp)
-            return mask.sum(axis=1, dtype=jnp.int32), mask
-
-        self._topk_jit = jax.jit(reduced)
-        self._topk_key = self._cs_epoch
-        return self._topk_jit
 
     # ---- render (exactness filter) ---------------------------------------
 
@@ -395,13 +375,10 @@ class TpuDriver(InterpDriver):
         """Sync the resident incremental audit pack (ops/auditpack.py) and
         return the current fused fn + constraint side aligned with it."""
         fn, side = self._fused_fn()
-        ordered, cp, groups, col_specs = side
+        _ordered, _cp, _groups, col_specs = side
         self._audit_pack.sync(self, col_specs)
-        # row packing may have interned new strings; constraint-side string
-        # predicate tables are vocab-sized, so re-pack them if so
-        if self.interner.snapshot_size() > self._cs_cache[0][1]:
-            fn, side = self._fused_fn()
-            ordered, cp, groups, col_specs = side
+        fn, side = self._repack_if_vocab_grew(fn, side)
+        ordered, cp, groups, _col_specs = side
         group_params = [packed for _prog, _idxs, packed in groups]
         return fn, ordered, cp, group_params
 
@@ -480,9 +457,13 @@ class TpuDriver(InterpDriver):
     def audit_capped(self, cap: int, tracing: bool = False):
         """Cap-aware end-to-end audit: the status write-back keeps at most
         `cap` violations per constraint (--constraint-violations-limit,
-        reference manager.go:49), so the sweep reduces ON DEVICE to
-        per-constraint counts + top-k violating cell indices and the host
-        render is bounded by C x ~cap cells instead of every violating cell.
+        reference manager.go:49), so host rendering walks each constraint's
+        candidate cells in row order and stops at the cap.  For templates
+        with a vectorized program the candidate mask is tight-ish and the
+        exact-eval cost is ~C x cap cells; templates with NO program get
+        all-true columns, and for those the walk may exact-eval many cells
+        before accumulating cap violations (same cost the plain audit pays).
+        The device sweep itself is shared with audit() via _audit_masks().
 
         Returns (results, totals, trace) with totals
         {(kind, name): (count, how)}: "exact" when every candidate cell of
@@ -491,41 +472,34 @@ class TpuDriver(InterpDriver):
         cut rendering short (count = device-counted violating resources —
         exact for templates whose vectorized program is exact, an
         over-approximation otherwise)."""
-        from ..engine.value import thaw
-
         if cap is None or cap <= 0:
             return InterpDriver.audit_capped(self, cap or 0, tracing=tracing)
         with self._lock:
-            fn, ordered, cp, group_params = self._audit_inputs()
+            reviews, ordered, mask = self._audit_masks()
             ap = self._audit_pack
             trace: List[str] = [] if tracing else None
-            if ap.n_rows == 0:
-                return [], {}, ("\n".join(trace) if tracing else None)
+            if not reviews or mask is None:
+                # same contract as InterpDriver: every registered constraint
+                # reports an exact zero even when the inventory is empty
+                empty = {
+                    (kind, cname): (0, "exact")
+                    for kind in self.constraints
+                    for cname in self.constraints[kind]
+                }
+                return [], empty, ("\n".join(trace) if tracing else None)
             if self._render_memo_epoch != self._cs_epoch:
                 self._render_memo.clear()
                 self._render_memo_epoch = self._cs_epoch
-            rows = ap.capacity
-            ckey_cache = (self.store.epoch, self._cs_epoch,
-                          self.interner.snapshot_size())
-            if self._audit_topk_cache and self._audit_topk_cache[0] == ckey_cache:
-                counts, mask = self._audit_topk_cache[1]
-            else:
-                reduced = self._fused_counts_fn()
-                counts_d, mask_d = self._dispatch(
-                    reduced, ap.rp, cp.arrays, ap.cols, group_params, rows
-                )
-                counts = np.asarray(counts_d)
-                mask = np.asarray(mask_d)
-                self._audit_topk_cache = (ckey_cache, (counts, mask))
+            counts = mask.sum(axis=1, dtype=np.int64)
             inventory = self.store.frozen()
             frozen_cache: Dict[int, object] = {}
             results: List[Result] = []
             totals: Dict[Tuple[str, str], Tuple[int, str]] = {}
-            R = len(ap.reviews)
+            R = len(reviews)
 
             def render(ri, kind, name, constraint, uses_inv, action):
                 violations = self._memo_cell(
-                    kind, name, ri, constraint, ap.reviews[ri], frozen_cache,
+                    kind, name, ri, constraint, reviews[ri], frozen_cache,
                     inventory, uses_inv, ap.row_gen[ri],
                 )
                 for v in violations:
@@ -534,7 +508,7 @@ class TpuDriver(InterpDriver):
                             msg=str(v.get("msg", "")),
                             metadata={"details": v.get("details", {})},
                             constraint=constraint,
-                            review=ap.reviews[ri],
+                            review=reviews[ri],
                             enforcement_action=action,
                         )
                     )
@@ -554,32 +528,18 @@ class TpuDriver(InterpDriver):
                 )
                 action = self._enforcement_action(constraint)
                 start = len(results)
-                seen = set()
                 capped = False
-                for j in range(k):
-                    if not valid[ci, j]:
-                        break
+                # first-k host selection over this constraint's mask row;
+                # rendering stops at the cap (cost caveat for program-less
+                # templates: see the docstring)
+                for ri in np.nonzero(mask[ci, :R])[0]:
                     if len(results) - start >= cap:
                         capped = True
                         break
-                    ri = int(idx[ci, j])
-                    if ri >= R or ap.reviews[ri] is None:
-                        continue  # padding column / tombstoned row
-                    seen.add(ri)
+                    ri = int(ri)
+                    if reviews[ri] is None:
+                        continue  # tombstoned row (valid=False on device too)
                     render(ri, kind, name, constraint, uses_inv, action)
-                if not capped and n_cells > len(seen):
-                    # more candidate cells than the top-k fetch covered:
-                    # pull just this constraint's mask row from the device
-                    row = np.asarray(mask_d[ci])
-                    for ri in np.nonzero(row[:R])[0]:
-                        ri = int(ri)
-                        if ri in seen or ap.reviews[ri] is None:
-                            continue
-                        if len(results) - start >= cap:
-                            capped = True
-                            break
-                        seen.add(ri)
-                        render(ri, kind, name, constraint, uses_inv, action)
                 if capped:
                     totals[ckey] = (max(n_cells, len(results) - start), "resources")
                 else:

@@ -1,9 +1,10 @@
-"""Cap-aware audit: device top-k reduction vs the exact interpreter path.
+"""Cap-aware audit: cap-bounded host render vs the exact interpreter path.
 
 The status write-back keeps at most --constraint-violations-limit violations
-per constraint (reference pkg/audit/manager.go:49), so the TPU sweep reduces
-on device to per-constraint counts + top-k cell indices and host rendering
-is bounded by C x cap (VERDICT r1 #3)."""
+per constraint (reference pkg/audit/manager.go:49), so the TPU driver walks
+the device candidate mask per constraint in row order and stops rendering at
+the cap, with device-counted "resources" totals for capped constraints
+(VERDICT r1 #3)."""
 
 import numpy as np
 
@@ -102,10 +103,10 @@ def test_capped_on_mesh_matches_single_device():
     assert _result_keys(res_mesh.results()) == _result_keys(res_single.results())
 
 
-def test_fallback_row_fetch_beyond_topk():
-    """cap such that 2*cap < violating cells of a constraint exercises the
-    margin; a loose-mask case exercises the full-row fallback.  Use a high
-    violation rate so every constraint has many cells."""
+def test_capped_resources_totals_match_device_counts():
+    """With a cap far below the violating-cell count, capped constraints
+    must report "resources" totals equal to the device mask's per-constraint
+    cell counts.  Use a high violation rate so every constraint caps."""
     ct = _loaded(TpuDriver(), n_templates=3, n_pods=120, violation_rate=0.9)
     interp = _loaded(InterpDriver(), n_templates=3, n_pods=120, violation_rate=0.9)
     res, totals = ct.audit_capped(2)
@@ -125,6 +126,87 @@ def test_fallback_row_fetch_beyond_topk():
     for kk, (n, how) in totals.items():
         if how == "resources":
             assert n == exact_cells[kk], (kk, n, exact_cells[kk])
+
+
+def test_manager_totals_key_matches_status_key_with_namespace():
+    """A constraint carrying metadata.namespace must have its driver-exact
+    total land under the same status key _add_results uses, not a
+    cluster-scoped 'Kind//name' variant."""
+    from gatekeeper_tpu.audit.manager import AuditManager
+    from gatekeeper_tpu.kube.inmem import InMemoryKube
+
+    kube = InMemoryKube()
+    templates, constraints = make_templates(2)
+    driver = TpuDriver()
+    c = Client(driver=driver)
+    for t in templates:
+        c.add_template(t)
+    for cons in constraints:
+        cons = dict(cons)
+        cons["metadata"] = dict(cons["metadata"], namespace="weird-ns")
+        c.add_constraint(cons)
+        kube.create(dict(cons))
+    for p in make_pods(30, seed=3, violation_rate=0.9):
+        c.add_data(p)
+    mgr = AuditManager(kube=kube, client=c, from_cache=True,
+                       violations_limit=2, interval_s=1e9)
+    mgr.audit_once()
+    wrote = 0
+    for gvk in mgr._constraint_kinds():
+        for obj in kube.list(gvk):
+            status = obj.get("status") or {}
+            if "totalViolations" in status:
+                wrote += 1
+                assert status["totalViolations"] >= len(
+                    status.get("violations") or [])
+    assert wrote, "namespaced constraints must still receive status totals"
+
+
+def test_manager_action_totals_counted_when_nothing_rendered():
+    """violations_limit=0 keeps no results; per-action totals must still
+    reflect the driver-exact counts (review r2 finding)."""
+    from gatekeeper_tpu.audit.manager import AuditManager
+    from gatekeeper_tpu.kube.inmem import InMemoryKube
+
+    kube = InMemoryKube()
+    ct = _loaded(TpuDriver(), n_templates=3, n_pods=30, violation_rate=0.9)
+    _templates, constraints = make_templates(3)
+    for cons in constraints:
+        kube.create(dict(cons))
+
+    seen = {}
+
+    class Reporter:
+        def report_audit_last_run(self, *a):
+            pass
+
+        def report_audit_duration(self, *a):
+            pass
+
+        def report_total_violations(self, action, n):
+            seen[action] = n
+
+    mgr = AuditManager(kube=kube, client=ct, from_cache=True,
+                       violations_limit=0, interval_s=1e9,
+                       reporter=Reporter())
+    mgr.audit_once()
+    assert sum(seen.values()) > 0, seen
+
+
+def test_capped_empty_inventory_totals_contract():
+    """Both drivers report (0, 'exact') for every registered constraint on
+    an empty inventory."""
+    templates, constraints = make_templates(3)
+    for drv in (TpuDriver(), InterpDriver()):
+        c = Client(driver=drv)
+        for t in templates:
+            c.add_template(t)
+        for cons in constraints:
+            c.add_constraint(cons)
+        res, totals = c.audit_capped(5)
+        assert res.results() == []
+        assert len(totals) == len(constraints)
+        assert all(v == (0, "exact") for v in totals.values())
 
 
 def test_audit_manager_uses_capped_totals():
