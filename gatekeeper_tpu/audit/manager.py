@@ -454,4 +454,6 @@ class AuditManager:
             ]
         else:
             status.pop("violations", None)
-        self.kube.update(latest, check_version=True)
+        # Status().Update (manager.go:604): constraint CRDs declare the
+        # status subresource, so the write must go via .../status
+        self.kube.update(latest, check_version=True, subresource="status")

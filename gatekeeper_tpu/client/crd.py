@@ -21,8 +21,11 @@ class CRDError(Exception):
 
 
 def synthesize_crd(kind: str, parameters_schema: Optional[dict], match_schema: dict) -> dict:
-    """Build the constraint CRD (apiextensions v1beta1 shape) for a template
-    kind, per crd_helpers.go:40-155."""
+    """Build the constraint CRD for a template kind, per
+    crd_helpers.go:40-155 — emitted in apiextensions/v1 shape (per-version
+    schema + status subresource) so a real API server accepts it; the
+    reference's v1beta1-era `subresources`/`validation` spec fields are
+    expressed per-version as v1 requires."""
     plural = kind.lower()
     props: Dict[str, Any] = {
         "match": match_schema,
@@ -30,8 +33,41 @@ def synthesize_crd(kind: str, parameters_schema: Optional[dict], match_schema: d
     }
     if parameters_schema is not None:
         props["parameters"] = parameters_schema
+    open_api = {
+        "type": "object",
+        "properties": {
+            "metadata": {
+                "type": "object",
+                "properties": {
+                    "name": {"type": "string", "maxLength": 63}
+                },
+            },
+            # preserve-unknown-fields: template parameter schemas are not
+            # guaranteed structural (the reference's pre-structural-schema
+            # leniency, crd_helpers.go:118-155)
+            "spec": {
+                "type": "object",
+                "x-kubernetes-preserve-unknown-fields": True,
+                "properties": props,
+            },
+            "status": {
+                "type": "object",
+                "x-kubernetes-preserve-unknown-fields": True,
+            },
+        },
+    }
+
+    def version(name: str, storage: bool) -> dict:
+        return {
+            "name": name,
+            "served": True,
+            "storage": storage,
+            "subresources": {"status": {}},
+            "schema": {"openAPIV3Schema": open_api},
+        }
+
     return {
-        "apiVersion": "apiextensions.k8s.io/v1beta1",
+        "apiVersion": "apiextensions.k8s.io/v1",
         "kind": "CustomResourceDefinition",
         "metadata": {
             "name": f"{plural}.{CONSTRAINT_GROUP}",
@@ -46,24 +82,10 @@ def synthesize_crd(kind: str, parameters_schema: Optional[dict], match_schema: d
                 "singular": plural,
             },
             "scope": "Cluster",
-            "subresources": {"status": {}},
             "versions": [
-                {"name": "v1beta1", "served": True, "storage": True},
-                {"name": "v1alpha1", "served": True, "storage": False},
+                version("v1beta1", True),
+                version("v1alpha1", False),
             ],
-            "validation": {
-                "openAPIV3Schema": {
-                    "properties": {
-                        "metadata": {
-                            "properties": {
-                                "name": {"type": "string", "maxLength": 63}
-                            }
-                        },
-                        "spec": {"properties": props},
-                        "status": {},
-                    }
-                }
-            },
         },
     }
 
@@ -96,7 +118,15 @@ def validate_constraint(constraint: dict, crd: dict):
         raise CRDError(f"constraint kind {constraint.get('kind')!r} != {want_kind!r}")
     if not (constraint.get("metadata") or {}).get("name"):
         raise CRDError("constraint has no metadata.name")
-    schema = (((crd.get("spec") or {}).get("validation")) or {}).get("openAPIV3Schema")
+    spec = crd.get("spec") or {}
+    versions = spec.get("versions") or []
+    schema = None
+    if versions:
+        schema = ((versions[0].get("schema") or {})
+                  .get("openAPIV3Schema"))
+    if schema is None:
+        # externally-supplied v1beta1-shaped CRDs keep spec.validation
+        schema = (spec.get("validation") or {}).get("openAPIV3Schema")
     if schema:
         errs: List[str] = []
         _validate_value(constraint, schema, "", errs)

@@ -58,8 +58,9 @@ class ConstraintStatusController(Controller):
         parent.setdefault("status", {})["byPod"] = by_pod
         # optimistic concurrency: a concurrent spec writer bumps the
         # resourceVersion; Conflict propagates to the controller retry
-        # loop, which re-reads the fresh parent instead of clobbering it
-        self.kube.update(parent, check_version=True)
+        # loop, which re-reads the fresh parent instead of clobbering it.
+        # Status().Update (constraintstatus_controller.go:222).
+        self.kube.update(parent, check_version=True, subresource="status")
 
 
 class ConstraintTemplateStatusController(Controller):
@@ -99,4 +100,5 @@ class ConstraintTemplateStatusController(Controller):
         parent["status"]["created"] = bool(by_pod) and all(
             not s.get("errors") for s in by_pod
         )
-        self.kube.update(parent, check_version=True)
+        # Status().Update (constrainttemplatestatus_controller.go:196)
+        self.kube.update(parent, check_version=True, subresource="status")

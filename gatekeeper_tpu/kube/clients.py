@@ -51,13 +51,15 @@ class RetryKube:
     def create(self, obj: dict) -> dict:
         return self._retry(self.inner.create, obj)
 
-    def update(self, obj: dict, check_version: bool = False) -> dict:
+    def update(self, obj: dict, check_version: bool = False,
+               subresource: Optional[str] = None) -> dict:
         if not check_version:
-            return self.inner.update(obj)
+            return self.inner.update(obj, subresource=subresource)
 
         def attempt():
             # refetch-and-reapply on conflict, as RetryClient callers do
-            return self.inner.update(obj, check_version=True)
+            return self.inner.update(obj, check_version=True,
+                                     subresource=subresource)
 
         return self._retry(attempt)
 
@@ -83,7 +85,8 @@ class NoopKube:
     def create(self, obj: dict) -> dict:
         return obj
 
-    def update(self, obj: dict, check_version: bool = False) -> dict:
+    def update(self, obj: dict, check_version: bool = False,
+               subresource: Optional[str] = None) -> dict:
         return obj
 
     def apply(self, obj: dict) -> dict:

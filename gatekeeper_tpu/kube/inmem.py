@@ -59,7 +59,23 @@ class InMemoryKube:
         self._store: Dict[GVK, Dict[Tuple[str, str], dict]] = {}
         self._watchers: Dict[GVK, List[queue.Queue]] = {}
         self._rv = itertools.count(1)
+        self._last_rv = 0
         self._lock = threading.RLock()
+        # global event hook: called as on_event(gvk, WatchEvent) under the
+        # store lock for every ADDED/MODIFIED/DELETED.  The HTTP API-server
+        # shim uses this to keep a complete, ordered event history so
+        # watch?resourceVersion=N resume is gap-free (kube/apiserver.py).
+        self.on_event: Optional[Callable[[GVK, "WatchEvent"], None]] = None
+
+    def _next_rv(self) -> str:
+        self._last_rv = next(self._rv)
+        return str(self._last_rv)
+
+    def current_rv(self) -> str:
+        """Most recently issued resourceVersion (list-level RV, as the real
+        API server stamps on ListMeta)."""
+        with self._lock:
+            return str(self._last_rv)
 
     # ---- CRUD -------------------------------------------------------------
 
@@ -72,7 +88,7 @@ class InMemoryKube:
                 raise Conflict(f"{gvk} {key} already exists")
             stored = copy.deepcopy(obj)
             meta = stored.setdefault("metadata", {})
-            meta["resourceVersion"] = str(next(self._rv))
+            meta["resourceVersion"] = self._next_rv()
             meta.setdefault("uid", f"uid-{meta.get('name', '')}-{meta['resourceVersion']}")
             bucket[key] = stored
             self._notify(gvk, WatchEvent("ADDED", copy.deepcopy(stored)))
@@ -85,7 +101,12 @@ class InMemoryKube:
             except KeyError:
                 raise NotFound(f"{gvk} {namespace}/{name}")
 
-    def update(self, obj: dict, check_version: bool = False) -> dict:
+    def update(self, obj: dict, check_version: bool = False,
+               subresource: Optional[str] = None) -> dict:
+        """Whole-object replace, or — with subresource='status' — a status
+        write that leaves spec/metadata untouched (the real API server's
+        PUT .../status; reference audit manager.go:604 and the status
+        controllers write through Status().Update)."""
         with self._lock:
             gvk = gvk_of(obj)
             key = obj_key(obj)
@@ -97,13 +118,22 @@ class InMemoryKube:
                 new_rv = obj.get("metadata", {}).get("resourceVersion")
                 if old_rv != new_rv:
                     raise Conflict(f"{gvk} {key}: resourceVersion mismatch")
+            if subresource == "status":
+                merged = copy.deepcopy(bucket[key])
+                if "status" in obj:
+                    merged["status"] = copy.deepcopy(obj["status"])
+                else:
+                    merged.pop("status", None)
+                obj = merged
+            elif subresource is not None:
+                raise NotFound(f"{gvk} {key}: no subresource {subresource}")
             # no-op detection (as the real apiserver: an update that changes
             # nothing keeps the resourceVersion and emits no event) — this is
             # what lets write-back controller loops converge
             if self._semantically_equal(bucket[key], obj):
                 return copy.deepcopy(bucket[key])
             stored = copy.deepcopy(obj)
-            stored.setdefault("metadata", {})["resourceVersion"] = str(next(self._rv))
+            stored.setdefault("metadata", {})["resourceVersion"] = self._next_rv()
             # preserve uid across updates
             stored["metadata"].setdefault(
                 "uid", bucket[key].get("metadata", {}).get("uid")
@@ -137,7 +167,11 @@ class InMemoryKube:
             obj = bucket.pop((namespace, name), None)
             if obj is None:
                 return False
-            self._notify(gvk, WatchEvent("DELETED", copy.deepcopy(obj)))
+            # stamp a fresh RV on the final state so the DELETED event is
+            # ordered after every prior event in resourceVersion terms
+            final = copy.deepcopy(obj)
+            final.setdefault("metadata", {})["resourceVersion"] = self._next_rv()
+            self._notify(gvk, WatchEvent("DELETED", final))
             return True
 
     def list(self, gvk: GVK, namespace: Optional[str] = None) -> List[dict]:
@@ -173,6 +207,9 @@ class InMemoryKube:
                 pass
 
     def _notify(self, gvk: GVK, event: WatchEvent):
+        if self.on_event is not None:
+            self.on_event(gvk, WatchEvent(event.type,
+                                          copy.deepcopy(event.object)))
         # each watcher gets its own copy: consumers may mutate the object
         for q in self._watchers.get(gvk, []):
             q.put(WatchEvent(event.type, copy.deepcopy(event.object)))

@@ -7,9 +7,11 @@ readiness tracker -> controllers -> webhook / audit by operation role ->
 metrics exporter -> health endpoints.
 
 Run standalone:  python -m gatekeeper_tpu [flags]
-The API store is in-memory (the framework's API-server abstraction,
-kube/inmem.py); a real-cluster client implementing the same surface plugs
-into `App(kube=...)`.
+The API store is selected by --api-server: in-cluster service-account or
+kubeconfig auth over HTTPS (kube/http_client.py HttpKube — the real-cluster
+client), an explicit URL, or the in-memory store (kube/inmem.py) for
+standalone/dev runs.  Any object implementing the same surface plugs into
+`App(kube=...)`.
 """
 
 from __future__ import annotations
@@ -89,7 +91,37 @@ def build_parser() -> argparse.ArgumentParser:
                    help="evaluation backend (tpu = JAX/XLA batched)")
     p.add_argument("--webhook-batch-window-ms", type=float, default=2.0,
                    help="micro-batching window for admission reviews")
+    # API-server selection (rest.InClusterConfig / kubeconfig in the
+    # reference's manager construction, main.go:140-151)
+    p.add_argument("--api-server", default="auto",
+                   help="API store: 'auto' (in-cluster, else $KUBECONFIG, "
+                        "else in-memory), 'inmem', 'in-cluster', "
+                        "'kubeconfig', or an explicit https:// URL")
     return p
+
+
+def make_kube(spec: str = "auto"):
+    """Resolve the --api-server flag to a kube client."""
+    from .kube.http_client import HttpKube
+
+    if spec == "inmem":
+        return InMemoryKube()
+    if spec == "in-cluster":
+        return HttpKube.in_cluster()
+    if spec == "kubeconfig":
+        return HttpKube.from_kubeconfig()
+    if spec.startswith(("http://", "https://")):
+        return HttpKube(spec)
+    # auto: prefer in-cluster, then kubeconfig, then in-memory
+    import os
+
+    if os.environ.get("KUBERNETES_SERVICE_HOST"):
+        return HttpKube.in_cluster()
+    kc = os.environ.get("KUBECONFIG")
+    if kc and os.path.exists(kc):
+        return HttpKube.from_kubeconfig(kc)
+    log.warning("no cluster detected; using the in-memory API store")
+    return InMemoryKube()
 
 
 def make_event_recorder(kube: InMemoryKube, component: str):
@@ -222,7 +254,8 @@ class App:
             args = build_parser().parse_args(args or [])
         self.args = args
         gklog.setup(args.log_level)
-        self.kube = kube or InMemoryKube()
+        self.kube = kube if kube is not None else make_kube(
+            getattr(args, "api_server", "inmem"))
         self.operations = ops_mod.Operations(args.operation or None)
         self.reporters = Reporters()
 
