@@ -429,23 +429,30 @@ class KubeApiServer:
     def _serve_list(self, h, info: _TypeInfo, namespace: str, params: dict):
         limit = int(params.get("limit") or 0)
         cont_token = params.get("continue") or ""
-        meta = {"resourceVersion": self.kube.current_rv()}
         if cont_token:
             # consistent-snapshot continuation, as the real apiserver:
-            # later pages come from the snapshot taken at the first page,
-            # so churn between pages cannot skip or duplicate objects
+            # later pages come from the snapshot taken at the first page —
+            # INCLUDING its resourceVersion, so a list+watch that paginates
+            # resumes the watch from the snapshot RV and cannot skip events
+            # that landed between pages
             with self._lock:
-                items = self._continuations.pop(cont_token, None)
-            if items is None:
+                popped = self._continuations.pop(cont_token, None)
+            if popped is None:
                 return self._send(h, 410, _status_doc(
                     410, "Expired", "continue token expired"))
+            snapshot_rv, items = popped
         else:
+            # RV read BEFORE the list: a write interleaving between the two
+            # reads then yields duplicate replay on watch resume (safe),
+            # never a skipped event
+            snapshot_rv = self.kube.current_rv()
             items = self.kube.list(info.gvk, namespace or None)
+        meta = {"resourceVersion": snapshot_rv}
         if limit and limit < len(items):
             page, remainder = items[:limit], items[limit:]
             token = f"c{next(self._cont_seq)}"
             with self._lock:
-                self._continuations[token] = remainder
+                self._continuations[token] = (snapshot_rv, remainder)
                 while len(self._continuations) > 64:  # bound leaked tokens
                     self._continuations.pop(
                         next(iter(self._continuations)))

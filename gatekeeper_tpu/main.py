@@ -89,6 +89,10 @@ def build_parser() -> argparse.ArgumentParser:
     # TPU-native addition: which evaluation backend
     p.add_argument("--driver", choices=["interp", "tpu"], default="tpu",
                    help="evaluation backend (tpu = JAX/XLA batched)")
+    p.add_argument("--sync-compile", action="store_true",
+                   help="block evaluations on template-ingest XLA "
+                        "recompiles instead of serving from the "
+                        "interpreter while compiling in the background")
     p.add_argument("--webhook-batch-window-ms", type=float, default=2.0,
                    help="micro-batching window for admission reviews")
     # API-server selection (rest.InClusterConfig / kubeconfig in the
@@ -112,6 +116,10 @@ def make_kube(spec: str = "auto"):
         return HttpKube.from_kubeconfig()
     if spec.startswith(("http://", "https://")):
         return HttpKube(spec)
+    if spec != "auto":
+        # a typo must not silently fall back to the in-memory store — the
+        # process would report healthy while enforcing nothing
+        raise ValueError(f"unrecognized --api-server value: {spec!r}")
     # auto: prefer in-cluster, then kubeconfig, then in-memory
     import os
 
@@ -263,7 +271,12 @@ class App:
         if args.driver == "tpu":
             from .ops.driver import TpuDriver
 
-            driver = TpuDriver()
+            # production default: template ingest hands the XLA recompile
+            # to a background thread; evals serve from the interpreter
+            # until the fused executable is warm (SURVEY §7 hard-part 3)
+            driver = TpuDriver(
+                async_compile=not getattr(args, "sync_compile", False)
+            )
         else:
             driver = InterpDriver()
         self.client = Client(driver=driver)

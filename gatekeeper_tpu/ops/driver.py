@@ -38,7 +38,11 @@ class TpuDriver(InterpDriver):
     management (templates/constraints/store) and render fallback from
     InterpDriver."""
 
-    def __init__(self, target: Optional[K8sValidationTarget] = None):
+    def __init__(
+        self,
+        target: Optional[K8sValidationTarget] = None,
+        async_compile: Optional[bool] = None,
+    ):
         super().__init__(target)
         # eager native build/load: the g++ compile must happen here, not
         # inside the first admission review under the driver lock
@@ -75,39 +79,76 @@ class TpuDriver(InterpDriver):
         # mostly-unchanged inventory every interval; packing is skipped
         # entirely while the store epoch and constraint side are unchanged
         self._audit_cache = None
+        # async ingestion (SURVEY §7 hard-part 3): template/constraint
+        # mutations hand the XLA re-compile to a background thread and
+        # reviews serve from the interpreter until the new fused
+        # executable is warm (ops/asynccompile.py)
+        self._compiler = None
+        if async_compile is None:
+            async_compile = os.environ.get("GK_ASYNC_COMPILE", "0") == "1"
+        if async_compile:
+            from .asynccompile import AsyncCompiler
+
+            self._compiler = AsyncCompiler(self)
 
     # ---- lifecycle --------------------------------------------------------
 
+    def _epoch_bumped(self):
+        if self._compiler is not None:
+            self._compiler.kick()
+
+    def wait_ready(self, timeout: float = 120.0) -> bool:
+        """Block until the fused executable for the current constraint-side
+        epoch is compiled (no-op when async compile is off)."""
+        if self._compiler is None:
+            return True
+        return self._compiler.wait(timeout)
+
     def put_template(self, kind: str, artifact: CompiledTemplate):
-        super().put_template(kind, artifact)
-        self.programs[kind] = vectorize(artifact.policy)
-        self._cs_epoch += 1
+        # all mutators hold the driver lock for their FULL body (the async
+        # compiler snapshots under this lock) and bump the epoch last, so a
+        # kicked compile never sees half-applied state
+        with self._lock:
+            super().put_template(kind, artifact)
+            self.programs[kind] = vectorize(artifact.policy)
+            self._cs_epoch += 1
+        self._epoch_bumped()
 
     def delete_template(self, kind: str) -> bool:
-        self.programs.pop(kind, None)
-        self._cs_epoch += 1
-        return super().delete_template(kind)
+        with self._lock:
+            self.programs.pop(kind, None)
+            out = super().delete_template(kind)
+            self._cs_epoch += 1
+        self._epoch_bumped()
+        return out
 
     def put_constraint(self, kind: str, name: str, constraint: dict):
-        super().put_constraint(kind, name, constraint)
-        self._cs_epoch += 1
+        with self._lock:
+            super().put_constraint(kind, name, constraint)
+            self._cs_epoch += 1
+        self._epoch_bumped()
 
     def delete_constraint(self, kind: str, name: str) -> bool:
-        self._cs_epoch += 1
-        return super().delete_constraint(kind, name)
+        with self._lock:
+            out = super().delete_constraint(kind, name)
+            self._cs_epoch += 1
+        self._epoch_bumped()
+        return out
 
     def reset(self):
-        super().reset()
-        self.programs.clear()
-        self._cs_epoch += 1
-        self._cs_cache = None
-        self._cs_device_cache = None
-        self._fused = None
-        self._fused_key = None
-        from .auditpack import AuditPackCache
+        with self._lock:
+            super().reset()
+            self.programs.clear()
+            self._cs_cache = None
+            self._cs_device_cache = None
+            self._fused = None
+            self._fused_key = None
+            from .auditpack import AuditPackCache
 
-        self._audit_pack = AuditPackCache()
-        self._render_memo.clear()
+            self._audit_pack = AuditPackCache()
+            self._render_memo.clear()
+            self._cs_epoch += 1
+        self._epoch_bumped()
 
     # ---- device evaluation ------------------------------------------------
 
@@ -337,7 +378,13 @@ class TpuDriver(InterpDriver):
         if not reviews:
             return []
         n_constraints = sum(len(v) for v in self.constraints.values())
-        if len(reviews) * max(n_constraints, 1) < self.DEVICE_MIN_CELLS:
+        if len(reviews) * max(n_constraints, 1) < self.DEVICE_MIN_CELLS or (
+            # async ingestion: while the background XLA compile for the
+            # latest template/constraint epoch is in flight, admission
+            # reviews serve from the interpreter instead of blocking
+            self._compiler is not None
+            and not self._compiler.ready()
+        ):
             return [
                 InterpDriver.review(self, r, tracing=tracing) for r in reviews
             ]
@@ -406,6 +453,10 @@ class TpuDriver(InterpDriver):
     def audit(self, tracing: bool = False):
         from ..engine.value import freeze
 
+        # audit is the throughput path: prefer waiting for the background
+        # compile (which holds the driver lock only for host packing) over
+        # an interpreter sweep of the whole inventory
+        self.wait_ready()
         with self._lock:
             reviews, ordered, mask = self._audit_masks()
             if not reviews:
@@ -474,6 +525,7 @@ class TpuDriver(InterpDriver):
         over-approximation otherwise)."""
         if cap is None or cap <= 0:
             return InterpDriver.audit_capped(self, cap or 0, tracing=tracing)
+        self.wait_ready()
         with self._lock:
             reviews, ordered, mask = self._audit_masks()
             ap = self._audit_pack
