@@ -101,11 +101,12 @@ class KubeApiServer:
         self._history: Dict[GVK, deque] = {}
         self._compacted_below: Dict[GVK, int] = {}
         self._subscribers: Dict[GVK, List[queue.Queue]] = {}
-        # snapshot continuations for paginated lists: token -> remainder
+        # snapshot continuations for paginated lists:
+        # token -> (snapshot resourceVersion, remaining items)
         import itertools
 
         self._cont_seq = itertools.count(1)
-        self._continuations: Dict[str, List[dict]] = {}
+        self._continuations: Dict[str, Tuple[str, List[dict]]] = {}
         self.kube.on_event = self._record_event
         # register types for any CRDs already present in the store
         for crd in self.kube.list(

@@ -18,7 +18,9 @@ ever compiled.
 
 from __future__ import annotations
 
+import logging
 import threading
+from typing import Optional
 
 import jax
 
@@ -76,15 +78,26 @@ class AsyncCompiler:
                 self._thread.start()
             self._cond.notify_all()
 
-    def wait(self, timeout: float = 120.0) -> bool:
+    def wait(self, timeout: Optional[float] = 120.0) -> bool:
+        """Block until the fused executable matches the live epoch.
+        timeout=None waits indefinitely; the audit path instead uses the
+        driver's bounded AUDIT_COMPILE_WAIT_S so pathological epoch churn
+        can never wedge the audit loop permanently (driver.py:100-105).  A
+        stopped compiler returns False immediately: the sync path is then
+        the only one left."""
         import time
 
-        deadline = time.monotonic() + timeout
+        deadline = None if timeout is None else time.monotonic() + timeout
         with self._cond:
             while not self.ready():
-                left = deadline - time.monotonic()
-                if left <= 0:
+                if self._stopped:
                     return False
+                if deadline is None:
+                    left = 0.05
+                else:
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        return False
                 # bounded wait: the target epoch itself can move under us
                 self._cond.wait(min(left, 0.05))
         return True
@@ -110,7 +123,13 @@ class AsyncCompiler:
             except Exception:
                 # fail open: a broken background compile must not wedge
                 # evaluation off-device forever — the synchronous path will
-                # surface the error on the next direct call
+                # surface the error on the next direct call.  Logged loudly:
+                # a persistently broken compile otherwise stays invisible
+                # until it resurfaces as a blocking sync compile (advisor r2)
+                logging.getLogger("gatekeeper_tpu.asynccompile").exception(
+                    "background XLA compile failed for epoch %d; "
+                    "falling open to the synchronous path", epoch,
+                )
                 with self._cond:
                     if d._cs_epoch == epoch:
                         self._ready_epoch = epoch
@@ -134,8 +153,14 @@ class AsyncCompiler:
                 [dict(_PROBE_REVIEW)]
             )
             rows = len(rp.arrays["valid"])
+            # the constraint-side cache key the inputs were packed for —
+            # read under the lock; _dispatch must not key the device cache
+            # on a LATER epoch a concurrent mutation may have created
+            cs_key = (d._cs_epoch, d.interner.snapshot_size())
         # XLA trace + compile OUTSIDE the lock — the whole point
-        out = d._dispatch(fn, rp.arrays, cp.arrays, cols, group_params, rows)
+        out = d._dispatch(
+            fn, rp.arrays, cp.arrays, cols, group_params, rows, cs_key=cs_key
+        )
         jax.block_until_ready(out)
         with self._cond:
             if d._cs_epoch == epoch:

@@ -97,12 +97,38 @@ class TpuDriver(InterpDriver):
         if self._compiler is not None:
             self._compiler.kick()
 
-    def wait_ready(self, timeout: float = 120.0) -> bool:
+    # Audit-path compile wait: long enough that no realistic template storm
+    # (bench: 500 templates ≈ tens of seconds) ever falls through to the
+    # synchronous compile under the driver lock (advisor r2), but bounded so
+    # pathological epoch churn (mutations forever outpacing compiles) cannot
+    # wedge the audit loop permanently.
+    AUDIT_COMPILE_WAIT_S = 600.0
+
+    def wait_ready(self, timeout: Optional[float] = 120.0) -> bool:
         """Block until the fused executable for the current constraint-side
-        epoch is compiled (no-op when async compile is off)."""
+        epoch is compiled (no-op when async compile is off).  timeout=None
+        waits indefinitely."""
         if self._compiler is None:
             return True
         return self._compiler.wait(timeout)
+
+    def _wait_ready_for_audit(self):
+        import time
+
+        t0 = time.monotonic()
+        if not self.wait_ready(timeout=self.AUDIT_COMPILE_WAIT_S):
+            import logging
+
+            waited = time.monotonic() - t0
+            stopped = self._compiler is not None and self._compiler._stopped
+            logging.getLogger("gatekeeper_tpu.driver").warning(
+                "audit waited %.1fs for the background compile without it "
+                "becoming ready (%s); proceeding with a synchronous compile "
+                "under the driver lock",
+                waited,
+                "compiler stopped" if stopped
+                else "sustained template/constraint churn?",
+            )
 
     def put_template(self, kind: str, artifact: CompiledTemplate):
         # all mutators hold the driver lock for their FULL body (the async
@@ -269,23 +295,37 @@ class TpuDriver(InterpDriver):
             self._mesh_cache = (maybe_audit_mesh(),)
         return self._mesh_cache[0]
 
-    def _dispatch(self, fn, rv_arrays, cp_arrays, cols, group_params, rows):
+    def _dispatch(self, fn, rv_arrays, cp_arrays, cols, group_params, rows,
+                  cs_key=None):
         """Call a fused device function with mesh-aware placement: on a
         multi-chip mesh the review side is padded + sharded on "data" and
         the replicated constraint side is served from the epoch-keyed device
         cache (re-uploading vocab-sized tables to N chips every call would
-        cost N RTTs behind a network relay)."""
+        cost N RTTs behind a network relay).
+
+        cs_key: (cs_epoch, vocab) the inputs were packed for, captured under
+        the driver lock.  The async compile thread dispatches UNLOCKED, so
+        reading self._cs_epoch here could key stale constraint arrays under
+        a newer epoch (advisor r2); callers that hold the lock may omit it."""
         mesh = self._mesh()
         if mesh is None:
             return fn(rv_arrays, cp_arrays, cols, group_params)
         from ..parallel.mesh import replicate_tree, shard_review_side
 
-        key = (self._cs_epoch, self.interner.snapshot_size(), id(mesh))
-        if self._cs_device_cache and self._cs_device_cache[0] == key:
-            cs_p, gp_p = self._cs_device_cache[1]
+        if cs_key is None:
+            cs_key = (self._cs_epoch, self.interner.snapshot_size())
+        key = (cs_key[0], cs_key[1], id(mesh))
+        # single read: the compile thread runs unlocked, and a concurrent
+        # reset() may None the cache between a check and a re-read
+        cache = self._cs_device_cache
+        if cache and cache[0] == key:
+            cs_p, gp_p = cache[1]
         else:
             cs_p, gp_p = replicate_tree(mesh, (cp_arrays, group_params))
-            self._cs_device_cache = (key, (cs_p, gp_p))
+            # never cache under a key the live epoch has moved past: a later
+            # eval with an unchanged vocab would hit misaligned mask rows
+            if cs_key[0] == self._cs_epoch:
+                self._cs_device_cache = (key, (cs_p, gp_p))
         rv_p, cols_p, _target = shard_review_side(mesh, rows, rv_arrays, cols)
         with mesh:
             return fn(rv_p, cs_p, cols_p, gp_p)
@@ -377,7 +417,8 @@ class TpuDriver(InterpDriver):
 
         if not reviews:
             return []
-        n_constraints = sum(len(v) for v in self.constraints.values())
+        with self._lock:  # concurrent ingest may resize the dicts (RLock)
+            n_constraints = sum(len(v) for v in self.constraints.values())
         if len(reviews) * max(n_constraints, 1) < self.DEVICE_MIN_CELLS or (
             # async ingestion: while the background XLA compile for the
             # latest template/constraint epoch is in flight, admission
@@ -455,8 +496,8 @@ class TpuDriver(InterpDriver):
 
         # audit is the throughput path: prefer waiting for the background
         # compile (which holds the driver lock only for host packing) over
-        # an interpreter sweep of the whole inventory
-        self.wait_ready()
+        # an interpreter sweep of the whole inventory (advisor r2)
+        self._wait_ready_for_audit()
         with self._lock:
             reviews, ordered, mask = self._audit_masks()
             if not reviews:
@@ -525,7 +566,7 @@ class TpuDriver(InterpDriver):
         over-approximation otherwise)."""
         if cap is None or cap <= 0:
             return InterpDriver.audit_capped(self, cap or 0, tracing=tracing)
-        self.wait_ready()
+        self._wait_ready_for_audit()
         with self._lock:
             reviews, ordered, mask = self._audit_masks()
             ap = self._audit_pack
