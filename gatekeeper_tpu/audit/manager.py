@@ -333,14 +333,16 @@ class AuditManager:
                 continue
             if "*" not in matched and gvk[2] not in matched:
                 continue
-            objs = self.kube.list(gvk)
-            # API chunking (--audit-chunk-size) bounds host memory per page;
-            # each page then fills device-width review batches
-            pages = (
-                [objs[i:i + self.chunk_size]
-                 for i in range(0, len(objs), self.chunk_size)]
-                if self.chunk_size else [objs]
-            )
+            # STREAMED paging (--audit-chunk-size): each page arrives via
+            # the kube surface's limit+continue chunking, so host memory is
+            # bounded by the chunk size, not the cluster size (reference
+            # manager.go:342-396); each page then fills device-width review
+            # batches.  Kube clients without list_pages fall back to one
+            # full-list page.
+            if self.chunk_size and hasattr(self.kube, "list_pages"):
+                pages = self.kube.list_pages(gvk, limit=self.chunk_size)
+            else:
+                pages = iter([self.kube.list(gvk)])
             for page in pages:
                 for obj in page:
                     ns = (obj.get("metadata") or {}).get("namespace") or ""

@@ -133,10 +133,14 @@ class Manager:
 
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
-            if all(c.registrar.events.empty() for c in self.controllers):
+            if self.watch_manager.replays_active() == 0 and all(
+                c.registrar.events.empty() for c in self.controllers
+            ):
                 # one more tick for in-flight reconciles
                 time.sleep(0.05)
-                if all(c.registrar.events.empty() for c in self.controllers):
+                if self.watch_manager.replays_active() == 0 and all(
+                    c.registrar.events.empty() for c in self.controllers
+                ):
                     return True
             time.sleep(0.01)
         return False

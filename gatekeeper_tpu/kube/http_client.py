@@ -388,10 +388,18 @@ class HttpKube:
         items, _ = self._list_rv(gvk, namespace, limit)
         return items
 
-    def _list_rv(self, gvk: GVK, namespace: Optional[str] = None,
-                 limit: int = 500) -> Tuple[List[dict], str]:
+    def list_pages(self, gvk: GVK, namespace: Optional[str] = None,
+                   limit: int = 500):
+        """Stream the list one API page (`limit` + `continue` token) at a
+        time: host memory stays bounded by the page size regardless of
+        cluster size.  The audit's chunked discovery sweep consumes this
+        (reference manager.go:342-396)."""
+        for page, _rv in self._pages_rv(gvk, namespace, limit):
+            yield page
+
+    def _pages_rv(self, gvk: GVK, namespace: Optional[str] = None,
+                  limit: int = 500):
         path = self._path(gvk, namespace or "")
-        items: List[dict] = []
         cont = ""
         rv = "0"
         api_version = _group_version(gvk)
@@ -401,15 +409,24 @@ class HttpKube:
                 q += f"&continue={cont}"
             status, doc = self._request("GET", path + q)
             self._check(status, doc, f"list {path}")
-            for it in doc.get("items", []):
+            page = doc.get("items", [])
+            for it in page:
                 # list items omit apiVersion/kind; restore them
                 it.setdefault("apiVersion", api_version)
                 it.setdefault("kind", gvk[2])
-                items.append(it)
             rv = doc.get("metadata", {}).get("resourceVersion", rv)
             cont = doc.get("metadata", {}).get("continue", "")
+            yield page, rv
             if not cont:
-                return items, rv
+                return
+
+    def _list_rv(self, gvk: GVK, namespace: Optional[str] = None,
+                 limit: int = 500) -> Tuple[List[dict], str]:
+        items: List[dict] = []
+        rv = "0"
+        for page, rv in self._pages_rv(gvk, namespace, limit):
+            items.extend(page)
+        return items, rv
 
     def list_gvks(self) -> List[GVK]:
         """Discovery-mode enumeration (ServerPreferredResources,

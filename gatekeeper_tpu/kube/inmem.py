@@ -182,6 +182,18 @@ class InMemoryKube:
                     out.append(copy.deepcopy(obj))
             return out
 
+    def list_pages(self, gvk: GVK, namespace: Optional[str] = None,
+                   limit: int = 500):
+        """Page-streamed list (API parity with HttpKube.list_pages): the
+        in-memory store has no wire to chunk, but the audit's streaming
+        consumer is written against this surface."""
+        objs = self.list(gvk, namespace)
+        if limit and limit > 0:
+            for i in range(0, len(objs), limit):
+                yield objs[i:i + limit]
+        else:
+            yield objs
+
     def list_gvks(self) -> List[GVK]:
         """Discovery: every GVK with stored objects (the analogue of
         ServerPreferredResources in audit discovery mode)."""

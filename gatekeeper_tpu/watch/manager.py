@@ -72,6 +72,87 @@ class Registrar:
         return self.manager.watched_by(self)
 
 
+def _obj_key(obj: dict) -> Tuple[str, str]:
+    meta = obj.get("metadata") or {}
+    return (meta.get("namespace", "") or "", meta.get("name", "") or "")
+
+
+class _Replay(threading.Thread):
+    """Async late-joiner replay for one (registrar, gvk)
+    (reference pkg/watch/replay.go:35-120): the snapshot list runs OFF the
+    manager lock — with an HTTP-backed kube a large list takes seconds, and
+    running it under the lock would stall all live fan-out for every GVK —
+    with retry/backoff on list errors and cancellation on watch removal.
+
+    Ordering contract (no stale resurrection): while the replay is in
+    flight, live events for this (registrar, gvk) are BUFFERED here instead
+    of delivered.  The final splice (under the manager lock, atomic w.r.t.
+    fan-out) enqueues the replayed ADDEDs — skipping any object key that
+    has a buffered live event, which carries strictly newer state — and
+    then the buffered events in arrival order.  An object deleted after the
+    snapshot therefore always surfaces its DELETED after (or instead of)
+    its replayed ADDED."""
+
+    MAX_BACKOFF = 2.0
+    # list retries before giving up on the snapshot: the buffered live
+    # events are then delivered (and the failure logged) so a persistently
+    # unlistable GVK neither starves the registrar nor wedges drain()
+    MAX_ATTEMPTS = 8
+
+    def __init__(self, manager: "WatchManager", registrar: "Registrar",
+                 gvk: GVK):
+        super().__init__(daemon=True, name=f"watch-replay-{registrar.name}-{gvk}")
+        self.manager = manager
+        self.registrar = registrar
+        self.gvk = gvk
+        self.cancelled = threading.Event()
+        self.pending: list = []  # live events buffered during replay
+
+    def cancel(self):
+        self.cancelled.set()
+
+    def run(self):
+        import logging
+
+        backoff = 0.05
+        objs = None
+        for _attempt in range(self.MAX_ATTEMPTS):
+            if self.cancelled.is_set():
+                break
+            try:
+                objs = self.manager.kube.list(self.gvk)
+                break
+            except Exception:
+                self.cancelled.wait(backoff)
+                backoff = min(backoff * 2, self.MAX_BACKOFF)
+        else:
+            logging.getLogger("gatekeeper_tpu.watch").warning(
+                "replay list for %s/%s failed %d times; delivering live "
+                "events without the snapshot",
+                self.registrar.name, self.gvk, self.MAX_ATTEMPTS,
+            )
+        with self.manager._lock:
+            # de-register the gate first — but only if it is still OURS: a
+            # remove+re-add churn may have cancelled this replay and
+            # installed a newer one under the same key, whose gate must
+            # survive (its ordering contract depends on it)
+            key = (self.registrar.name, self.gvk)
+            if self.manager._replays.get(key) is self:
+                del self.manager._replays[key]
+            if self.cancelled.is_set():
+                return  # watch removed mid-replay: drop snapshot + buffer
+            fresher = {
+                _obj_key(ev.object) for ev in self.pending if ev.object
+            }
+            for obj in objs or ():
+                if _obj_key(obj) not in fresher:
+                    self.registrar.events.put(
+                        (self.gvk, WatchEvent("ADDED", obj))
+                    )
+            for ev in self.pending:
+                self.registrar.events.put((self.gvk, ev))
+
+
 class _Pump(threading.Thread):
     """Per-GVK event pump: reads the kube watcher, fans out to registrars.
     The single shared watch per GVK is the manager's 'informer'."""
@@ -106,6 +187,10 @@ class WatchManager:
         # intent: registrar -> set of GVKs (recordKeeper, registrar.go:51-58)
         self._intent: Dict[Registrar, Set[GVK]] = {}
         self._pumps: Dict[GVK, _Pump] = {}
+        # in-flight late-joiner replays, keyed (registrar name, gvk); live
+        # events for these route into the replay's buffer (ordering
+        # contract in _Replay)
+        self._replays: Dict[Tuple[str, GVK], _Replay] = {}
         self._metrics_hook = metrics_hook
 
     # ---- registrar lifecycle ---------------------------------------------
@@ -139,15 +224,14 @@ class WatchManager:
                 pump = _Pump(self, gvk)
                 self._pumps[gvk] = pump
                 pump.start()
-            # replay current objects to the late joiner (replay.go:35-120).
-            # Done SYNCHRONOUSLY under the manager lock: live events fan out
-            # through _fan_out, which needs this lock, so every replayed
-            # ADDED is enqueued before any later live event for this GVK —
-            # a stale replay can never resurrect an object deleted after
-            # the snapshot.  (In-memory lists are cheap; the reference
-            # replays async because its lists hit the API server.)
-            for obj in self.kube.list(gvk):
-                r.events.put((gvk, WatchEvent("ADDED", obj)))
+            # async replay of current objects to the late joiner
+            # (replay.go:35-120): the snapshot list runs off the manager
+            # lock so a slow/large list never stalls live fan-out; the
+            # replay gate installed here preserves the no-stale-resurrection
+            # ordering (see _Replay docstring)
+            replay = _Replay(self, r, gvk)
+            self._replays[(r.name, gvk)] = replay
+            replay.start()
             self._report()
 
     def _remove_watch(self, r: Registrar, gvk: GVK):
@@ -156,6 +240,9 @@ class WatchManager:
 
     def _remove_watch_locked(self, r: Registrar, gvk: GVK):
         self._intent.get(r, set()).discard(gvk)
+        replay = self._replays.pop((r.name, gvk), None)
+        if replay is not None:
+            replay.cancel()  # teardown during replay: drop snapshot+buffer
         if not any(gvk in s for s in self._intent.values()):
             pump = self._pumps.pop(gvk, None)
             if pump:
@@ -172,7 +259,19 @@ class WatchManager:
 
     def _fan_out(self, gvk: GVK, ev: WatchEvent):
         with self._lock:
-            targets = [r for r, s in self._intent.items() if gvk in s]
+            # buffer-vs-deliver decided under the lock, atomically with the
+            # replay's final splice: a registrar mid-replay buffers (the
+            # splice re-orders it after the snapshot), everyone else gets
+            # the event directly
+            targets = []
+            for r, s in self._intent.items():
+                if gvk not in s:
+                    continue
+                replay = self._replays.get((r.name, gvk))
+                if replay is not None and not replay.cancelled.is_set():
+                    replay.pending.append(ev)
+                else:
+                    targets.append(r)
         for r in targets:
             r.events.put((gvk, ev))
 
@@ -184,6 +283,12 @@ class WatchManager:
                 pass
 
     # ---- introspection ----------------------------------------------------
+
+    def replays_active(self) -> int:
+        """In-flight late-joiner replays (drain/quiesce helpers must treat
+        a pending replay as undelivered events)."""
+        with self._lock:
+            return len(self._replays)
 
     def watched_gvks(self) -> GVKSet:
         with self._lock:
@@ -202,6 +307,9 @@ class WatchManager:
 
     def stop(self):
         with self._lock:
+            for replay in self._replays.values():
+                replay.cancel()
+            self._replays.clear()
             for pump in self._pumps.values():
                 pump.stop()
             self._pumps.clear()
