@@ -296,6 +296,12 @@ def main():
         t0 = time.time()
         res, totals = client.audit_capped(cap)
         times.append(time.time() - t0)
+        s = driver.last_sweep_stats
+        log(f"  sweep {i}: {times[-1]*1000:.1f}ms | pack {s.get('pack_ms', 0):.1f} "
+            f"device {s.get('device_ms', 0):.1f} fetch {s.get('fetch_ms', 0):.1f} "
+            f"render {s.get('render_ms', 0):.1f} ms | fetch {s.get('fetch_bytes', 0)/1e3:.1f}KB "
+            f"fallback_rows {s.get('fallback_rows', 0):.0f} "
+            f"rendered_cells {s.get('rendered_cells', 0):.0f}")
     sweep_s = min(times)
     n_results = len(res.results())
     log(f"steady-state end-to-end sweep (1 mutation): {sweep_s*1000:.1f}ms "

@@ -101,6 +101,19 @@ class AuditPackCache:
         self.capacity = 0
         self.n_rows = 0
         self._gen = 0
+        # device-residency bookkeeping (consumed by the driver): rows whose
+        # packed contents changed since the last take_dirty(), and a layout
+        # generation bumped whenever array identities/shapes change (rebuild,
+        # capacity growth, width growth, new column leaf) — a layout bump
+        # means per-row scatter updates can no longer patch the device copy
+        # and a full re-upload is required.
+        self.dirty: set = set()
+        self.layout_gen = 0
+
+    def take_dirty(self) -> set:
+        d = self.dirty
+        self.dirty = set()
+        return d
 
     # ---- public -----------------------------------------------------------
 
@@ -180,6 +193,8 @@ class AuditPackCache:
                 self.ns_rows.setdefault(ns, set()).add(i)
         self.free = []
         self.synced_epoch = store.epoch
+        self.dirty = set()
+        self.layout_gen += 1
 
     # ---- incremental ------------------------------------------------------
 
@@ -218,6 +233,7 @@ class AuditPackCache:
         self.rp["valid"][row] = False
         self._gen += 1
         self.row_gen[row] = self._gen
+        self.dirty.add(row)
         self.free.append(row)
 
     def _alloc_row(self) -> int:
@@ -245,6 +261,7 @@ class AuditPackCache:
             for ck, leaves in self.cols.items()
         }
         self.capacity = new_capacity
+        self.layout_gen += 1
 
     def _write_leaf(self, holder: dict, key, row: int, src: np.ndarray, fill):
         """Write one packed row into its slot, growing trailing (width)
@@ -260,6 +277,7 @@ class AuditPackCache:
                 grown[tuple(slice(0, s) for s in dst.shape)] = dst
                 holder[key] = grown
                 dst = grown
+                self.layout_gen += 1  # shape changed: device copy is stale
         dst[row] = fill
         if src.ndim:
             dst[(row,) + tuple(slice(0, s) for s in src.shape)] = src
@@ -282,6 +300,8 @@ class AuditPackCache:
                         (self.capacity,) + arr.shape[1:],
                         _COL_FILL[leaf], dtype=arr.dtype,
                     )
+                    self.layout_gen += 1  # new leaf: device tree is stale
                 self._write_leaf(holder, leaf, row, arr[0], _COL_FILL[leaf])
         self._gen += 1
         self.row_gen[row] = self._gen
+        self.dirty.add(row)
