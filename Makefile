@@ -4,6 +4,10 @@
 IMG ?= gatekeeper-tpu:latest
 NAMESPACE ?= gatekeeper-system
 
+.PHONY: manifests
+manifests:  ## regenerate charts/gatekeeper-tpu from deploy/gatekeeper.yaml
+	python tools/helmify.py
+
 .PHONY: test
 test:
 	python -m pytest tests/ -q

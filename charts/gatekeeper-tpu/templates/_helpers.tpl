@@ -1,0 +1,6 @@
+{{- define "gatekeeper-tpu.labels" -}}
+app: gatekeeper-tpu
+chart: {{ .Chart.Name }}
+release: {{ .Release.Name }}
+heritage: {{ .Release.Service }}
+{{- end }}

@@ -109,10 +109,20 @@ class AuditPackCache:
         # and a full re-upload is required.
         self.dirty: set = set()
         self.layout_gen = 0
+        # second dirty channel, drained by the incremental delta sweep
+        # (ops/deltasweep.py) independently of the device-scatter channel
+        # above, so neither consumer starves the other and the delta path
+        # never rescans cumulative churn (advisor r3)
+        self.delta_dirty: set = set()
 
     def take_dirty(self) -> set:
         d = self.dirty
         self.dirty = set()
+        return d
+
+    def take_delta_dirty(self) -> set:
+        d = self.delta_dirty
+        self.delta_dirty = set()
         return d
 
     # ---- public -----------------------------------------------------------
@@ -194,6 +204,7 @@ class AuditPackCache:
         self.free = []
         self.synced_epoch = store.epoch
         self.dirty = set()
+        self.delta_dirty = set()
         self.layout_gen += 1
 
     # ---- incremental ------------------------------------------------------
@@ -234,6 +245,7 @@ class AuditPackCache:
         self._gen += 1
         self.row_gen[row] = self._gen
         self.dirty.add(row)
+        self.delta_dirty.add(row)
         self.free.append(row)
 
     def _alloc_row(self) -> int:
@@ -305,3 +317,4 @@ class AuditPackCache:
         self._gen += 1
         self.row_gen[row] = self._gen
         self.dirty.add(row)
+        self.delta_dirty.add(row)

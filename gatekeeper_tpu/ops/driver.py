@@ -41,7 +41,6 @@ def _scatter_rows(dev_tree, idx, rows_tree):
         lambda d, r: d.at[idx].set(r), dev_tree, rows_tree
     )
 
-
 class TpuDriver(InterpDriver):
     """Drop-in Driver with device-side batched evaluation.  Inherits state
     management (templates/constraints/store) and render fallback from
@@ -97,6 +96,14 @@ class TpuDriver(InterpDriver):
         # capped-audit fused fn (mask + per-constraint count/top-k compaction)
         self._fused_audit = None
         self._fused_audit_key = None
+        # incremental O(changes) sweep (ops/deltasweep.py): steady-state
+        # capped audits evaluate only dirty rows on-device and fold them
+        # into host-side counts/candidate state; GK_DELTA=0 forces every
+        # sweep down the full-dispatch path
+        self.delta_enabled = os.environ.get("GK_DELTA", "1") != "0"
+        self._delta_state = None
+        self._delta_jit = None
+        self._delta_jit_key = None
         # per-sweep instrumentation (read by bench.py): pack/dispatch/fetch/
         # render wall-times, transferred bytes, rendered cells
         self.last_sweep_stats: Dict[str, float] = {}
@@ -198,6 +205,9 @@ class TpuDriver(InterpDriver):
             self._audit_dev = None  # layout gens restart with the new pack
             self._fused_audit = None
             self._fused_audit_key = None
+            self._delta_state = None
+            self._delta_jit = None
+            self._delta_jit_key = None
             self._cs_epoch += 1
         self._epoch_bumped()
 
@@ -636,6 +646,18 @@ class TpuDriver(InterpDriver):
         # re-read the epochs: packing may have interned new strings and
         # bumped the constraint-side cache, but the INPUTS are these epochs'
         self._audit_cache = (key, sweep, None)
+        # a full sweep (re)bases the incremental state: its inputs include
+        # every dirty row the scatter just applied
+        from .deltasweep import DeltaState
+
+        self._delta_state = DeltaState(
+            counts, packed[:, 1:], K, mask_dev,
+            cs_epoch=self._cs_epoch, layout_gen=ap.layout_gen,
+            store_epoch=self.store.epoch,
+        )
+        # the full sweep's inputs already reflect every pending change;
+        # drop the delta channel so those rows aren't re-applied
+        ap.delta_dirty.clear()
         self.last_sweep_stats = {
             "pack_ms": (t1 - t0) * 1e3,
             "device_ms": (t2 - t1) * 1e3,
@@ -732,17 +754,147 @@ class TpuDriver(InterpDriver):
             "namespaceSelector"
         )
 
+    # dirty rows per steady-state sweep beyond which a full device sweep
+    # is cheaper than the delta evaluation + host merge
+    DELTA_MAX_ROWS = 256
+    # cumulative rows tracked since the last full sweep beyond which the
+    # state is rebased (bounds row_cols host memory at ~ROWS_MAX x C bytes)
+    DELTA_ROW_COLS_MAX = 8192
+
+    def _delta_fn(self):
+        """Jitted fused evaluation restricted to a [d]-row slice of the
+        audit pack, plus the gather of the same rows' BEFORE-columns from
+        the resident full-sweep mask, in ONE dispatch ->
+        [C, 2d] (old | new) int8.  Same traced computation as the full
+        sweep, tiny intermediates, one round trip."""
+        if self._delta_jit is not None and self._delta_jit_key == self._cs_epoch:
+            return self._delta_jit
+        fused, _side = self._fused_fn()
+        raw = fused.__wrapped__
+
+        def delta(mask_dev, idx, rv, cs, cols, gp):
+            new = raw(rv, cs, cols, gp)[0]
+            old = mask_dev[:, idx]
+            return jnp.concatenate(
+                [old.astype(jnp.int8), new.astype(jnp.int8)], axis=1
+            )
+
+        self._delta_jit = jax.jit(delta)
+        self._delta_jit_key = self._cs_epoch
+        return self._delta_jit
+
+    def _try_delta(self, K: int):
+        """Bring the incremental sweep state current with an O(dirty-rows)
+        device evaluation (ops/deltasweep.py).  Returns
+        (reviews, ordered, state) or None when the delta path is
+        ineligible (disabled, mesh active, no base state, layout changed,
+        or too many dirty rows — then the caller runs a full sweep)."""
+        if not self.delta_enabled or self._mesh() is not None:
+            return None
+        st = self._delta_state
+        if st is None or st.cs_epoch != self._cs_epoch:
+            return None
+        import time as _time
+
+        t0 = _time.perf_counter()
+        side = self._constraint_side()
+        self._audit_pack.sync(self, side[3])
+        if self.interner.snapshot_size() > self._cs_cache[0][1]:
+            side = self._constraint_side()  # vocab grew: re-pack tables
+        ordered, cp, groups, _col_specs = side
+        ap = self._audit_pack
+        if st.layout_gen != ap.layout_gen or ap.n_rows == 0:
+            return None
+        if len(st.row_cols) > self.DELTA_ROW_COLS_MAX:
+            return None  # too much cumulative churn: rebase via full sweep
+        if not ap.delta_dirty:
+            st.store_epoch = self.store.epoch
+            self.last_sweep_stats = {
+                "pack_ms": (_time.perf_counter() - t0) * 1e3,
+                "device_ms": 0.0, "fetch_ms": 0.0, "fetch_bytes": 0.0,
+                "cached": 1.0,
+            }
+            return ap.reviews, ordered, st
+        if len(ap.delta_dirty) > self.DELTA_MAX_ROWS:
+            return None
+        # drained only once eligibility is certain; any failure past this
+        # point must invalidate the state (the caller then runs a full
+        # sweep, which rebases knowledge and clears both dirty channels)
+        rows = sorted(ap.take_delta_dirty())
+        try:
+            return self._apply_delta(st, ap, rows, ordered, cp, groups, t0)
+        except Exception:
+            import logging
+
+            logging.getLogger("gatekeeper_tpu.driver").exception(
+                "delta sweep failed for %d rows; rebasing via a full sweep",
+                len(rows),
+            )
+            self._delta_state = None
+            return None
+
+    def _apply_delta(self, st, ap, rows, ordered, cp, groups, t0):
+        import time as _time
+        t1 = _time.perf_counter()
+        # ONE dispatch: the fused evaluation on the dirty-row slice AND the
+        # gather of the same rows' before-columns from the resident
+        # full-sweep mask; one [C, 2d] int8 fetch
+        width = 8
+        while width < len(rows):
+            width *= 2
+        rows_pad = np.asarray(rows + [rows[-1]] * (width - len(rows)), np.int32)
+        rv_slice = {k: a[rows_pad] for k, a in ap.rp.items()}
+        cols_slice = {
+            ck: {leaf: a[rows_pad] for leaf, a in leaves.items()}
+            for ck, leaves in ap.cols.items()
+        }
+        group_params = [p for _prog, _idxs, p in groups]
+        cs_d, gp_d = self._constraint_device_side(
+            cp.arrays, group_params, None, None
+        )
+        both = np.asarray(
+            self._delta_fn()(
+                st.mask_dev, rows_pad, rv_slice, cs_d, cols_slice, gp_d
+            )
+        ).astype(bool)
+        fetch_bytes = both.nbytes
+        base_old, dmask = both[:, :width], both[:, width:]
+        t2 = _time.perf_counter()
+        for j, r in enumerate(rows):
+            # rows dirtied since the base sweep carry their current column
+            # in the state cache; the device gather serves the rest
+            old = st.old_column(r)
+            if old is None:
+                old = base_old[:, j]
+            st.apply_row(r, old, dmask[:, j])
+        st.store_epoch = self.store.epoch
+        self.last_sweep_stats = {
+            "pack_ms": (t1 - t0) * 1e3,
+            "device_ms": (t2 - t1) * 1e3,
+            "fetch_ms": 0.0,
+            "fetch_bytes": float(fetch_bytes),
+            "delta_rows": float(len(rows)),
+            "rows": float(ap.n_rows),
+            "cells": float(len(ordered) * len(rows)),
+        }
+        return ap.reviews, ordered, st
+
     def audit_capped(self, cap: int, tracing: bool = False):
         """Cap-aware end-to-end audit: the status write-back keeps at most
         `cap` violations per constraint (--constraint-violations-limit,
-        reference manager.go:49).  The per-constraint reduction happens
-        ON-DEVICE (_fused_audit_fn): only [C] counts + [C, K] first-K
-        candidate row indices cross back to the host per sweep, and host
-        rendering walks those candidates in row order, stopping at the cap.
-        When the K fetched candidates render short of the cap (device
-        over-approximation, or a template with no vectorized program whose
-        column is all-true), the walk falls back to fetching that ONE
-        constraint's full mask row — never the full [C, R] mask.
+        reference manager.go:49).
+
+        Steady state is INCREMENTAL: only rows whose packed content changed
+        since the last sweep are re-evaluated on device ([C, d] delta), and
+        the per-constraint counts + first-K candidate lists are maintained
+        host-side (ops/deltasweep.py) — per-sweep cost is O(churn), not
+        O(cluster).  The first sweep (and any sweep after a template or
+        layout change, under a mesh, or with too much churn) is a FULL
+        device sweep whose on-device reduction ships only [C] counts +
+        [C, K] candidate indices to the host (never the [C, R] mask).
+        When capped rendering needs candidates beyond the known horizon it
+        fetches that one constraint's mask row (base state fresh) or falls
+        back to one full sweep (NeedsFullSweep).
 
         Returns (results, totals, trace) with totals
         {(kind, name): (count, how)}: "exact" when the count equals the
@@ -750,116 +902,140 @@ class TpuDriver(InterpDriver):
         or the cap was hit but the program is provably count-exact
         (_count_exact); "resources" when the cap cut rendering short and
         the count is device-candidate resources, an over-approximation."""
+        from .deltasweep import NeedsFullSweep
+
         if cap is None or cap <= 0:
             return InterpDriver.audit_capped(self, cap or 0, tracing=tracing)
         self._wait_ready_for_audit()
         with self._lock:
-            import time as _time
-
-            t0 = _time.perf_counter()
-            sweep = self._audit_sweep(self._audit_topk(cap))
-            ap = self._audit_pack
+            K = self._audit_topk(cap)
             trace: List[str] = [] if tracing else None
-            if sweep is None:
-                # same contract as InterpDriver: every registered constraint
-                # reports an exact zero even when the inventory is empty
-                empty = {
-                    (kind, cname): (0, "exact")
-                    for kind in self.constraints
-                    for cname in self.constraints[kind]
-                }
-                return [], empty, ("\n".join(trace) if tracing else None)
-            reviews, ordered, mask_dev, counts, topk = sweep
-            if self._render_memo_epoch != self._cs_epoch:
-                self._render_memo.clear()
-                self._render_memo_epoch = self._cs_epoch
-            inventory = self.store.frozen()
-            frozen_cache: Dict[int, object] = {}
-            results: List[Result] = []
-            totals: Dict[Tuple[str, str], Tuple[int, str]] = {}
-            R = len(reviews)
-            rendered_cells = 0
-            fallback_rows = 0
-            fallback_bytes = 0
-
-            def render(ri, kind, name, constraint, uses_inv, action):
-                violations = self._memo_cell(
-                    kind, name, ri, constraint, reviews[ri], frozen_cache,
-                    inventory, uses_inv, ap.row_gen[ri],
-                )
-                for v in violations:
-                    results.append(
-                        Result(
-                            msg=str(v.get("msg", "")),
-                            metadata={"details": v.get("details", {})},
-                            constraint=constraint,
-                            review=reviews[ri],
-                            enforcement_action=action,
+            for _attempt in (0, 1):
+                got = self._try_delta(K)
+                if got is None:
+                    sweep = self._audit_sweep(K)
+                    if sweep is None:
+                        # same contract as InterpDriver: every registered
+                        # constraint reports an exact zero on an empty
+                        # inventory
+                        empty = {
+                            (kind, cname): (0, "exact")
+                            for kind in self.constraints
+                            for cname in self.constraints[kind]
+                        }
+                        return [], empty, (
+                            "\n".join(trace) if tracing else None
                         )
+                    got = (self._audit_pack.reviews, sweep[1],
+                           self._delta_state)
+                try:
+                    return self._render_capped(
+                        got[0], got[1], got[2], cap, trace
                     )
-                    if trace is not None:
-                        trace.append(f"violation {kind}/{name}: {v.get('msg')}")
+                except NeedsFullSweep:
+                    # the state's known candidates ran out while unknown
+                    # ones exist and the base mask is stale: rebase
+                    self._delta_state = None
+                    self._audit_cache = None
+            raise AssertionError("fresh full sweep cannot need another")
 
-            def candidates(ci, n_cand):
-                """This constraint's candidate rows in ascending order: the
-                prefetched first-K indices, then (rarely) the rest of the
-                row fetched on demand — one [R] bool transfer, only for
-                constraints whose prefetch rendered short of the cap."""
-                nonlocal fallback_rows, fallback_bytes
-                served = 0
-                for ri in topk[ci]:
-                    if ri < 0:
-                        break
-                    served += 1
-                    if ri < R:
-                        yield int(ri)
-                if n_cand > served:
-                    row = np.asarray(mask_dev[ci])[:R]
-                    fallback_rows += 1
-                    fallback_bytes += row.nbytes
-                    for ri in np.nonzero(row)[0][served:]:
-                        yield int(ri)
+    def _render_capped(self, reviews, ordered, st, cap, trace):
+        """Render up to `cap` violations per constraint from the
+        incremental state's candidate lists (identical for a
+        fresh-from-full-sweep state and a delta-updated one)."""
+        from .deltasweep import NeedsFullSweep
 
-            for ci, (kind, name, constraint) in enumerate(ordered):
-                ckey = (kind, name)
-                n_cand = int(counts[ci])
-                if n_cand == 0:
-                    totals[ckey] = (0, "exact")
-                    continue
-                tmpl = self.templates.get(kind)
-                uses_inv = (
-                    True if tmpl is None
-                    else getattr(tmpl.policy, "uses_inventory", True)
-                )
-                action = self._enforcement_action(constraint)
-                start = len(results)
-                capped = False
-                for ri in candidates(ci, n_cand):
-                    if len(results) - start >= cap:
-                        capped = True
-                        break
-                    if reviews[ri] is None:
-                        continue  # tombstoned row (valid=False on device too)
-                    render(ri, kind, name, constraint, uses_inv, action)
-                    rendered_cells += 1
-                if not capped:
-                    totals[ckey] = (len(results) - start, "exact")
-                elif self._count_exact(kind, constraint):
-                    # device count == violation count, provably: report the
-                    # full total past the cap (manager.go:188 semantics)
-                    totals[ckey] = (n_cand, "exact")
-                else:
-                    totals[ckey] = (
-                        max(n_cand, len(results) - start), "resources"
-                    )
-            self.last_sweep_stats.update(
-                render_ms=(_time.perf_counter() - t0) * 1e3
-                - self.last_sweep_stats.get("pack_ms", 0.0)
-                - self.last_sweep_stats.get("device_ms", 0.0)
-                - self.last_sweep_stats.get("fetch_ms", 0.0),
-                rendered_cells=float(rendered_cells),
-                fallback_rows=float(fallback_rows),
-                fallback_bytes=float(fallback_bytes),
-                results=float(len(results)),
+        import time as _time
+
+        t0 = _time.perf_counter()
+        ap = self._audit_pack
+        if self._render_memo_epoch != self._cs_epoch:
+            self._render_memo.clear()
+            self._render_memo_epoch = self._cs_epoch
+        inventory = self.store.frozen()
+        frozen_cache: Dict[int, object] = {}
+        results: List[Result] = []
+        totals: Dict[Tuple[str, str], Tuple[int, str]] = {}
+        R = len(reviews)
+        rendered_cells = 0
+        fallback_rows = 0
+        fallback_bytes = 0
+
+        def render(ri, kind, name, constraint, uses_inv, action):
+            violations = self._memo_cell(
+                kind, name, ri, constraint, reviews[ri], frozen_cache,
+                inventory, uses_inv, ap.row_gen[ri],
             )
-            return results, totals, ("\n".join(trace) if tracing else None)
+            for v in violations:
+                results.append(
+                    Result(
+                        msg=str(v.get("msg", "")),
+                        metadata={"details": v.get("details", {})},
+                        constraint=constraint,
+                        review=reviews[ri],
+                        enforcement_action=action,
+                    )
+                )
+                if trace is not None:
+                    trace.append(f"violation {kind}/{name}: {v.get('msg')}")
+
+        def candidates(ci, n_cand):
+            """Known candidate rows ascending; beyond the horizon, fetch
+            the constraint's mask row when the base mask is still fresh
+            (no delta applied), else escalate to a full sweep."""
+            nonlocal fallback_rows, fallback_bytes
+            lst = st.cand[ci]
+            yield from lst
+            if st.horizon[ci] is None or n_cand <= len(lst):
+                return
+            if st.row_cols:
+                raise NeedsFullSweep(ci)
+            row = np.asarray(st.mask_dev[ci])[:R]
+            fallback_rows += 1
+            fallback_bytes += row.nbytes
+            full = [int(x) for x in np.nonzero(row)[0]]
+            st.cand[ci] = full  # complete knowledge for future sweeps
+            st.horizon[ci] = None
+            for ri in full[len(lst):]:
+                yield ri
+
+        for ci, (kind, name, constraint) in enumerate(ordered):
+            ckey = (kind, name)
+            n_cand = int(st.counts[ci])
+            if n_cand == 0:
+                totals[ckey] = (0, "exact")
+                continue
+            tmpl = self.templates.get(kind)
+            uses_inv = (
+                True if tmpl is None
+                else getattr(tmpl.policy, "uses_inventory", True)
+            )
+            action = self._enforcement_action(constraint)
+            start = len(results)
+            capped = False
+            for ri in candidates(ci, n_cand):
+                if len(results) - start >= cap:
+                    capped = True
+                    break
+                if ri >= R or reviews[ri] is None:
+                    continue  # tombstoned row (valid=False on device too)
+                render(ri, kind, name, constraint, uses_inv, action)
+                rendered_cells += 1
+            if not capped:
+                totals[ckey] = (len(results) - start, "exact")
+            elif self._count_exact(kind, constraint):
+                # device count == violation count, provably: report the
+                # full total past the cap (manager.go:188 semantics)
+                totals[ckey] = (n_cand, "exact")
+            else:
+                totals[ckey] = (
+                    max(n_cand, len(results) - start), "resources"
+                )
+        self.last_sweep_stats.update(
+            render_ms=(_time.perf_counter() - t0) * 1e3,
+            rendered_cells=float(rendered_cells),
+            fallback_rows=float(fallback_rows),
+            fallback_bytes=float(fallback_bytes),
+            results=float(len(results)),
+        )
+        return results, totals, ("\n".join(trace) if trace is not None else None)

@@ -210,6 +210,14 @@ class WebhookServer:
             target=self._server.serve_forever, name="webhook", daemon=True
         )
         self._thread.start()
+        # p99 tactic: move everything allocated so far (compiled policies,
+        # packed tensors, module graph) out of the cyclic GC's generations —
+        # a gen-2 collection scanning a 100k-object inventory otherwise
+        # injects multi-ms pauses into the admission path
+        import gc
+
+        gc.collect()
+        gc.freeze()
 
     def stop(self):
         if self._server:

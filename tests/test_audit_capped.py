@@ -236,3 +236,35 @@ def test_audit_manager_uses_capped_totals():
             status = c.get("status") or {}
             if "violations" in status:
                 assert status["totalViolations"] >= len(status["violations"])
+
+
+def test_status_carries_totals_exact_marker():
+    """VERDICT r2 #9: the constraint status surfaces whether
+    totalViolations is exact (violation semantics) or a device-candidate
+    approximation past the cap."""
+    from gatekeeper_tpu.audit.manager import AuditManager
+    from gatekeeper_tpu.kube.inmem import InMemoryKube
+
+    kube = InMemoryKube()
+    ct = _loaded(TpuDriver(), n_templates=6, n_pods=60, violation_rate=0.8)
+    templates, constraints = make_templates(6)
+    for cons in constraints:
+        kube.create(dict(cons))
+    mgr = AuditManager(
+        kube=kube, client=ct, from_cache=True, violations_limit=2,
+        interval_s=1e9,
+    )
+    mgr.audit_once()
+    markers = {}
+    for gvk in mgr._constraint_kinds():
+        for c in kube.list(gvk):
+            status = c.get("status") or {}
+            assert "totalViolationsExact" in status
+            markers[c["metadata"]["name"]] = status["totalViolationsExact"]
+    # the synthetic corpus has both count-exact (labelreq) and inexact
+    # (privflag et al) families over the cap
+    _res, totals = ct.audit_capped(2)
+    want = {f"c-{k[0].lower()}": how == "exact"
+            for k, (n, how) in totals.items()}
+    for name, exact in markers.items():
+        assert exact == want[name], (name, exact)

@@ -139,6 +139,7 @@ def test_incremental_scatter_matches_full_upload():
     drv = ct.driver
     drv.mesh_enabled = False
     drv._mesh_cache = None
+    drv.delta_enabled = False  # force the full-dispatch scatter path
     ct.audit_capped(5)
     # mutate: one new violating pod, one changed pod, one delete
     pods = make_pods(150, seed=7, violation_rate=0.5)

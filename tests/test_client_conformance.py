@@ -120,7 +120,14 @@ DRIVERS = ["interp"]
 try:  # TPU driver battery, once available
     from gatekeeper_tpu.ops.driver import TpuDriver  # noqa: F401
 
-    DRIVERS.append("tpu")
+    # "tpu" = production hybrid dispatch (small batches take the interp
+    # path); "tpu-device"/"tpu-mesh" force every scenario through
+    # compute_masks + render (DEVICE_MIN_CELLS=0) on one device and on the
+    # 8-virtual-device mesh, proving the device kernels on small/degenerate
+    # shapes — empty inventory, vocab growth mid-review, padded rows
+    # (VERDICT r2 #4; conformance role of the reference's e2e_tests.go via
+    # probe_client.go:16-56)
+    DRIVERS += ["tpu", "tpu-device", "tpu-mesh"]
 except ImportError:
     pass
 
@@ -129,9 +136,18 @@ except ImportError:
 def client(request):
     if request.param == "interp":
         return Client(driver=InterpDriver())
+    import jax
+
     from gatekeeper_tpu.ops.driver import TpuDriver
 
-    return Client(driver=TpuDriver())
+    if request.param == "tpu-mesh" and len(jax.devices()) < 2:
+        pytest.skip("mesh variant needs multiple devices")
+    driver = TpuDriver()
+    if request.param != "tpu":
+        driver.DEVICE_MIN_CELLS = 0  # force the device path
+        driver.mesh_enabled = request.param == "tpu-mesh"
+        driver._mesh_cache = None
+    return Client(driver=driver)
 
 
 @pytest.mark.parametrize("rego,libs", [(DENY_REGO, ()), (DENY_REGO_WITH_LIB, (DENY_LIB,))])

