@@ -79,6 +79,14 @@ class TpuDriver(InterpDriver):
         self._audit_pack = AuditPackCache()
         self._render_memo: Dict[Tuple, Tuple[int, list]] = {}
         self._render_memo_epoch = -1
+        # review-path render memo, keyed by CONTENT (kind, constraint name,
+        # frozen review): admission streams are full of identical objects
+        # (deployment replicas, retried requests), and an unchanged
+        # (constraint, object) cell renders identically unless the template
+        # reads data.inventory.  FrozenDict caches its hash, so the review
+        # is hashed once and each constraint lookup is O(1).
+        self._review_memo: Dict[Tuple, list] = {}
+        self._review_memo_epoch = -1
         # constraint-side packing is invalidated on any template/constraint
         # mutation and on vocabulary growth (str-pred tables are vocab-sized)
         self._cs_epoch = 0
@@ -124,6 +132,11 @@ class TpuDriver(InterpDriver):
     def _epoch_bumped(self):
         if self._compiler is not None:
             self._compiler.kick()
+
+    # review-memo entry bound: each entry retains a frozen admission object
+    # (~KBs); 16k entries keeps worst-case memory in the tens of MB and a
+    # wholesale clear in the low ms
+    REVIEW_MEMO_MAX = 16_384
 
     # Audit-path compile wait: long enough that no realistic template storm
     # (bench: 500 templates ≈ tens of seconds) ever falls through to the
@@ -426,9 +439,38 @@ class TpuDriver(InterpDriver):
         inventory,
         tracing_log,
     ):
-        violations = self._eval_cell(
-            constraint, kind, review, frozen_review, inventory
+        # content-keyed memo: identical (constraint, object) cells render
+        # identically while the constraint side is unchanged, PROVIDED the
+        # cell depends only on its inputs: templates reading data.inventory
+        # and constraints with a namespaceSelector (whose match consults the
+        # MUTABLE cached-namespace store, target/match.py) are excluded —
+        # a memoized verdict must never outlive a namespace relabel
+        tmpl = self.templates.get(kind)
+        uses_inv = (
+            True if tmpl is None
+            else getattr(tmpl.policy, "uses_inventory", True)
         )
+        match = (constraint.get("spec") or {}).get("match") or {}
+        if not uses_inv and not match.get("namespaceSelector"):
+            if self._review_memo_epoch != self._cs_epoch:
+                self._review_memo.clear()
+                self._review_memo_epoch = self._cs_epoch
+            mkey = (kind, constraint["metadata"].get("name", ""), frozen_review)
+            violations = self._review_memo.get(mkey)
+            if violations is None:
+                violations = self._eval_cell(
+                    constraint, kind, review, frozen_review, inventory
+                )
+                # bounded: unique objects (pod names) make keys unbounded
+                # on a busy cluster; clearing 16k entries is ~ms, far below
+                # the interp evals the memo saves
+                if len(self._review_memo) >= self.REVIEW_MEMO_MAX:
+                    self._review_memo.clear()
+                self._review_memo[mkey] = violations
+        else:
+            violations = self._eval_cell(
+                constraint, kind, review, frozen_review, inventory
+            )
         action = self._enforcement_action(constraint)
         for v in violations:
             results.append(

@@ -73,3 +73,30 @@ def test_values_are_substituted_not_hardcoded():
                       "gatekeeper-controller-manager-deployment.yaml")
     with open(cm) as f:
         assert "{{ .Values.replicas }}" in f.read()
+
+
+def test_non_default_values_take_effect():
+    """Every exposed knob must actually change the rendered output
+    (a values key with no template reference would be silently ignored)."""
+    vals = dict(helmify.VALUES_DEFAULTS)
+    vals.update(logDenies=False, emitAuditEvents=True, auditFromCache=True,
+                tpuResource="cloud-tpus.google.com/v2", tpuCount=4,
+                exemptNamespaces=["a", "b"], webhookPort=9443,
+                driver="interp", prometheusPort=9999)
+    text = helmify.render_chart(vals)
+    docs = {(d["kind"], d["metadata"]["name"]): d
+            for d in yaml.safe_load_all(text) if d}
+    cm = docs[("Deployment", "gatekeeper-controller-manager")]
+    spec = cm["spec"]["template"]["spec"]["containers"][0]
+    assert "--log-denies" not in spec["args"]
+    assert "--exempt-namespace=a" in spec["args"]
+    assert "--exempt-namespace=b" in spec["args"]
+    assert "--driver=interp" in spec["args"]
+    assert "--port=9443" in spec["args"]
+    ports = {p.get("name"): p["containerPort"] for p in spec["ports"]}
+    assert ports["webhook"] == 9443 and ports["metrics"] == 9999
+    aud = docs[("Deployment", "gatekeeper-audit")]
+    aspec = aud["spec"]["template"]["spec"]["containers"][0]
+    assert "--audit-from-cache" in aspec["args"]
+    assert "--emit-audit-events" in aspec["args"]
+    assert aspec["resources"]["limits"] == {"cloud-tpus.google.com/v2": "4"}

@@ -45,6 +45,7 @@ heritage: {{ .Release.Service }}
 # deploy-time knobs: literal text in deploy/gatekeeper.yaml -> (values key,
 # template expression).  The default value recorded in values.yaml is
 # extracted from the manifest text, mirroring replacements.go's table.
+# ORDER MATTERS: "containerPort: 8443" must rewrite before "port: 8443".
 REPLACEMENTS = [
     ("image: gatekeeper-tpu:latest",
      "image", "image: {{ .Values.image.repository }}:{{ .Values.image.tag }}"),
@@ -55,6 +56,49 @@ REPLACEMENTS = [
     ("- --constraint-violations-limit=20",
      "constraintViolationsLimit",
      "- --constraint-violations-limit={{ .Values.constraintViolationsLimit }}"),
+    ("- --driver=tpu", "driver", "- --driver={{ .Values.driver }}"),
+    ("- --port=8443", "webhookPort", "- --port={{ .Values.webhookPort }}"),
+    ("containerPort: 8443",
+     "webhookPort", "containerPort: {{ .Values.webhookPort }}"),
+    ("port: 8443", "webhookPort", "port: {{ .Values.webhookPort }}"),
+    ("containerPort: 8888",
+     "prometheusPort", "containerPort: {{ .Values.prometheusPort }}"),
+    ('google.com/tpu: "1"',
+     "tpuResource",
+     '{{ .Values.tpuResource }}: "{{ .Values.tpuCount }}"'),
+    # boolean flag present in the manifest -> gated on a value (default
+    # matches the manifest: enabled)
+    ("- --log-denies",
+     "logDenies",
+     "{{- if .Values.logDenies }}\n"
+     "            - --log-denies\n"
+     "            {{- end }}"),
+    # repeatable flag -> range over a list value
+    ("- --exempt-namespace=gatekeeper-system",
+     "exemptNamespaces",
+     "{{- range .Values.exemptNamespaces }}\n"
+     "            - --exempt-namespace={{ . }}\n"
+     "            {{- end }}"),
+    # flags NOT in the manifest, exposed as off-by-default conditionals:
+    # anchored on existing arg lines so the chart stays a pure derivation
+    # of the manifest (at default values these render to the anchor alone)
+    ("- --operation=webhook\n            - --operation=status",
+     "emitAdmissionEvents",
+     "- --operation=webhook\n"
+     "            - --operation=status\n"
+     "            {{- if .Values.emitAdmissionEvents }}\n"
+     "            - --emit-admission-events\n"
+     "            {{- end }}"),
+    ("- --operation=audit\n            - --operation=status",
+     "auditFromCache",
+     "- --operation=audit\n"
+     "            - --operation=status\n"
+     "            {{- if .Values.auditFromCache }}\n"
+     "            - --audit-from-cache\n"
+     "            {{- end }}\n"
+     "            {{- if .Values.emitAuditEvents }}\n"
+     "            - --emit-audit-events\n"
+     "            {{- end }}"),
 ]
 
 # every key here is referenced by a template expression in REPLACEMENTS —
@@ -64,6 +108,16 @@ VALUES_DEFAULTS = {
     "replicas": 3,
     "auditInterval": 60,
     "constraintViolationsLimit": 20,
+    "driver": "tpu",
+    "webhookPort": 8443,
+    "prometheusPort": 8888,
+    "tpuResource": "google.com/tpu",
+    "tpuCount": 1,
+    "logDenies": True,  # the deploy manifest enables it
+    "exemptNamespaces": ["gatekeeper-system"],
+    "emitAdmissionEvents": False,
+    "auditFromCache": False,
+    "emitAuditEvents": False,
 }
 
 _KIND_RE = re.compile(r"^kind:\s+(\S+)\s*$", re.MULTILINE)
@@ -139,10 +193,42 @@ def generate() -> dict:
     return out
 
 
+def _render_blocks(text: str, values: dict) -> str:
+    """Evaluate the {{- if .Values.x }} / {{- range .Values.x }} line
+    blocks this generator emits (non-nested)."""
+    out = []
+    lines = text.splitlines()
+    i = 0
+    end_re = re.compile(r"\s*\{\{- end \}\}\s*$")
+    if_re = re.compile(r"\s*\{\{- if \.Values\.(\w+) \}\}\s*$")
+    range_re = re.compile(r"\s*\{\{- range \.Values\.(\w+) \}\}\s*$")
+    while i < len(lines):
+        m_if = if_re.match(lines[i])
+        m_rg = range_re.match(lines[i])
+        if m_if or m_rg:
+            body = []
+            i += 1
+            while not end_re.match(lines[i]):
+                body.append(lines[i])
+                i += 1
+            i += 1  # the {{- end }} line
+            if m_if:
+                if values.get(m_if.group(1)):
+                    out.extend(body)
+            else:
+                for item in values.get(m_rg.group(1), ()):
+                    out.extend(b.replace("{{ . }}", str(item)) for b in body)
+            continue
+        out.append(lines[i])
+        i += 1
+    return "\n".join(out)
+
+
 def render_chart(values: dict) -> str:
-    """Minimal chart renderer (no helm binary in this image): substitutes
-    the {{ .Values.* }} expressions this generator emits.  Used by the
-    round-trip test to prove chart == manifest at default values."""
+    """Minimal chart renderer (no helm binary in this image): evaluates the
+    if/range blocks and {{ .Values.* }} expressions this generator emits.
+    Used by the round-trip test to prove chart == manifest at default
+    values."""
     rendered = []
     for rel in sorted(os.listdir(os.path.join(CHART, "crds"))):
         with open(os.path.join(CHART, "crds", rel)) as f:
@@ -152,7 +238,7 @@ def render_chart(values: dict) -> str:
         if rel.startswith("_"):
             continue
         with open(os.path.join(tpl_dir, rel)) as f:
-            text = f.read()
+            text = _render_blocks(f.read(), values)
 
         def sub(m):
             cur = values
