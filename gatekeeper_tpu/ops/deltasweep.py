@@ -58,6 +58,10 @@ class DeltaState:
         # after-columns of rows dirtied since the full sweep; the
         # before-column of a newly-dirtied row is gathered from mask_dev
         self.row_cols: Dict[int, np.ndarray] = {}
+        # per-constraint rendered-result reuse across sweeps, keyed by the
+        # (count, candidates, row generations) signature (driver
+        # _render_capped); traced renders bypass it
+        self.render_cache: Dict = {}
         self.mask_dev = mask_dev
         self.cs_epoch = cs_epoch
         self.layout_gen = layout_gen

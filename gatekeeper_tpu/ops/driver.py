@@ -984,7 +984,12 @@ class TpuDriver(InterpDriver):
     def _render_capped(self, reviews, ordered, st, cap, trace):
         """Render up to `cap` violations per constraint from the
         incremental state's candidate lists (identical for a
-        fresh-from-full-sweep state and a delta-updated one)."""
+        fresh-from-full-sweep state and a delta-updated one).
+
+        Per-constraint result reuse: a constraint whose walked candidates
+        and their row generations are unchanged since the last sweep
+        renders the identical Result slice; with 1-object churn, ~all
+        constraints reuse wholesale and the render cost is O(changed)."""
         from .deltasweep import NeedsFullSweep
 
         import time as _time
@@ -994,6 +999,8 @@ class TpuDriver(InterpDriver):
         if self._render_memo_epoch != self._cs_epoch:
             self._render_memo.clear()
             self._render_memo_epoch = self._cs_epoch
+        reuse = st.render_cache if trace is None else {}
+        new_cache: Dict[Tuple, Tuple] = {}
         inventory = self.store.frozen()
         frozen_cache: Dict[int, object] = {}
         results: List[Result] = []
@@ -1052,6 +1059,21 @@ class TpuDriver(InterpDriver):
                 True if tmpl is None
                 else getattr(tmpl.policy, "uses_inventory", True)
             )
+            lst = st.cand[ci]
+            sig = None
+            if trace is None and not uses_inv and len(lst) <= 512:
+                # unchanged candidates + row generations (and the same cap)
+                # render identically; cap is per-call, so it keys the entry
+                sig = (
+                    cap, n_cand, tuple(lst),
+                    tuple(ap.row_gen[r] for r in lst if r < R),
+                )
+                hit = reuse.get(ckey)
+                if hit is not None and hit[0] == sig:
+                    results.extend(hit[1])
+                    totals[ckey] = hit[2]
+                    new_cache[ckey] = hit
+                    continue
             action = self._enforcement_action(constraint)
             start = len(results)
             capped = False
@@ -1073,6 +1095,10 @@ class TpuDriver(InterpDriver):
                 totals[ckey] = (
                     max(n_cand, len(results) - start), "resources"
                 )
+            if sig is not None:
+                new_cache[ckey] = (sig, tuple(results[start:]), totals[ckey])
+        if trace is None:
+            st.render_cache = new_cache
         self.last_sweep_stats.update(
             render_ms=(_time.perf_counter() - t0) * 1e3,
             rendered_cells=float(rendered_cells),

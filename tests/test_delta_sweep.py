@@ -151,3 +151,30 @@ def test_delta_disabled_env_forces_full_sweeps():
     ct.add_data(p)
     ct.audit_capped(5)
     assert "delta_rows" not in ct.driver.last_sweep_stats
+
+
+def test_render_cache_respects_cap_changes():
+    """Re-auditing an unchanged cluster with a different cap must re-render
+    (the per-constraint render cache keys on the cap)."""
+    ct, ci = _pair(n_templates=4, n_pods=200, violation_rate=0.9)
+    r5, t5 = ct.audit_capped(5)
+    r50, t50 = ct.audit_capped(50)
+    i5, it5 = ci.audit_capped(5)
+    i50, it50 = ci.audit_capped(50)
+    per = {}
+    for r in r50.results():
+        k = r.constraint["metadata"]["name"]
+        per[k] = per.get(k, 0) + 1
+    per_i = {}
+    for r in i50.results():
+        k = r.constraint["metadata"]["name"]
+        per_i[k] = per_i.get(k, 0) + 1
+    assert per == per_i, (per, per_i)
+    assert len(r50.results()) > len(r5.results())
+    # shrinking the cap must bound results again
+    r2, _t2 = ct.audit_capped(2)
+    per2 = {}
+    for r in r2.results():
+        k = r.constraint["metadata"]["name"]
+        per2[k] = per2.get(k, 0) + 1
+    assert all(v <= 2 + 1 for v in per2.values()), per2

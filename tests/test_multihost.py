@@ -1,0 +1,96 @@
+"""Multi-host distributed sweep (parallel/multihost.py, SURVEY §5.8):
+two REAL OS processes joined via jax.distributed (gRPC coordinator —
+the DCN control-plane analogue), four virtual CPU devices each, one
+8-device global (host, data) mesh; the fused capped-audit reduction runs
+SPMD across both processes and must match the single-process sweep
+bit-for-bit.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = r"""
+import os, sys, json
+sys.path.insert(0, os.environ["GK_REPO"])
+import numpy as np
+import jax
+from gatekeeper_tpu.parallel.multihost import (
+    init_distributed, multihost_audit_mesh, multihost_capped_sweep,
+)
+
+pid = int(os.environ["GK_PROC"])
+init_distributed(os.environ["GK_COORD"], 2, pid)
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 8, len(jax.devices())  # 4 local x 2 hosts
+
+from gatekeeper_tpu.util.synthetic import build_driver
+
+# every "pod" replicates the same store (derived state) — same workload
+client = build_driver(10, 200, seed=0)
+driver = client.driver
+driver.mesh_enabled = False  # the local auto-mesh must not interfere
+driver._mesh_cache = None
+
+mesh = multihost_audit_mesh()
+assert mesh.shape == {"host": 2, "data": 4}, mesh.shape
+ordered, counts, topk = multihost_capped_sweep(driver, K=32)
+
+# single-process reference on this host's own devices
+driver2 = build_driver(10, 200, seed=0).driver
+driver2.mesh_enabled = False
+driver2._mesh_cache = None
+sweep = driver2._audit_sweep(32)
+_r, _o, _m, ref_counts, ref_topk = sweep
+
+assert (counts == ref_counts).all(), "multi-host counts diverge"
+assert (topk == ref_topk).all(), "multi-host top-k diverges"
+print(f"proc {pid}: multihost sweep parity ok "
+      f"({int(counts.sum())} candidates)", flush=True)
+"""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_multihost_sweep_parity(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    coord = f"127.0.0.1:{_free_port()}"
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.update(
+            GK_REPO=repo,
+            GK_COORD=coord,
+            GK_PROC=str(pid),
+            PALLAS_AXON_POOL_IPS="",
+            JAX_PLATFORMS="cpu",
+        )
+        kept = [f for f in env.get("XLA_FLAGS", "").split()
+                if "xla_force_host_platform_device_count" not in f]
+        kept.append("--xla_force_host_platform_device_count=4")
+        env["XLA_FLAGS"] = " ".join(kept)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _WORKER], env=env, cwd=repo,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        ))
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+            pytest.fail(f"multihost worker hung:\n{out[-3000:]}")
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {pid} failed:\n{out[-3000:]}"
+        assert "multihost sweep parity ok" in out, out[-2000:]
