@@ -395,6 +395,14 @@ def bench_synthetic() -> dict:
     log(f"workload built: {n_templates} templates x {n_resources} resources "
         f"in {time.time()-t0:.1f}s")
 
+    # long-lived-state GC hygiene, as the production processes do
+    # (webhook/server.py): without it, gen-2 collections scanning the
+    # 100k-object inventory inject 100ms+ pauses into steady-state sweeps
+    import gc
+
+    gc.collect()
+    gc.freeze()
+
     # ---- cold sweep: review build + pack + XLA compile + device + render
     t0 = time.time()
     res, totals = client.audit_capped(cap)

@@ -98,9 +98,14 @@ class InventoryStore:
     # cache re-packs only changed rows); beyond this, fall back to rebuild
     CHANGELOG_MAX = 262_144
 
+    # changed paths beyond which re-freezing the whole spine beats
+    # path-local rebuilds
+    RESPINE_MAX = 4096
+
     def __init__(self):
         self.tree: Dict[str, Any] = {}
         self._frozen = None
+        self._frozen_epoch: Optional[int] = None
         self._lock = threading.Lock()
         # monotonically increasing write epoch: lets evaluators cache
         # packed tensors across sweeps over an unchanged inventory
@@ -120,16 +125,21 @@ class InventoryStore:
             self._change_epochs = self._change_epochs[drop:]
             self._change_segs = self._change_segs[drop:]
 
+    def _changes_since_locked(
+        self, epoch: int
+    ) -> Optional[List[Optional[Tuple[str, ...]]]]:
+        import bisect
+
+        if epoch < self._change_floor:
+            return None
+        i = bisect.bisect_right(self._change_epochs, epoch)
+        return list(self._change_segs[i:])
+
     def changes_since(self, epoch: int) -> Optional[List[Optional[Tuple[str, ...]]]]:
         """Segment tuples changed after `epoch` (None entry = wipe), or
         None when the log no longer reaches back that far."""
-        import bisect
-
         with self._lock:
-            if epoch < self._change_floor:
-                return None
-            i = bisect.bisect_right(self._change_epochs, epoch)
-            return list(self._change_segs[i:])
+            return self._changes_since_locked(epoch)
 
     def get(self, segments: Tuple[str, ...]) -> Any:
         """The frozen object at segments, or None."""
@@ -149,7 +159,6 @@ class InventoryStore:
             for seg in segments[:-1]:
                 node = node.setdefault(seg, {})
             node[segments[-1]] = freeze(obj)
-            self._frozen = None
             self.epoch += 1
             self._log_change(tuple(segments))
 
@@ -158,7 +167,6 @@ class InventoryStore:
             if not segments:  # WipeData
                 had = bool(self.tree)
                 self.tree = {}
-                self._frozen = None
                 self.epoch += 1
                 self._log_change(None)
                 return had
@@ -169,16 +177,35 @@ class InventoryStore:
                     return False
             if segments[-1] in node:
                 del node[segments[-1]]
-                self._frozen = None
                 self.epoch += 1
                 self._log_change(tuple(segments))
                 return True
             return False
 
     def frozen(self):
+        """The immutable inventory tree (data.inventory).  Rebuilt
+        INCREMENTALLY: only the FrozenDict spine along paths changed since
+        the last call is reconstructed (unchanged subtrees are shared), so
+        a steady-state sweep pays O(changes), not O(cluster) — re-freezing
+        100k objects costs ~200ms and used to dominate the audit loop."""
         with self._lock:
-            if self._frozen is None:
+            if self._frozen is not None and self._frozen_epoch == self.epoch:
+                return self._frozen
+            changes = None
+            if self._frozen is not None and self._frozen_epoch is not None:
+                changes = self._changes_since_locked(self._frozen_epoch)
+            if (
+                changes is None
+                or len(changes) > self.RESPINE_MAX
+                or any(seg is None for seg in changes)  # wipe
+            ):
                 self._frozen = freeze_spine(self.tree)
+            else:
+                fz = self._frozen
+                for seg in changes:
+                    fz = _respine(fz, self.tree, seg)
+                self._frozen = fz
+            self._frozen_epoch = self.epoch
             return self._frozen
 
     def cached_namespace(self, name: Any) -> Optional[dict]:
@@ -213,6 +240,34 @@ def freeze_spine(node):
     if isinstance(node, dict):
         return FrozenDict({k: freeze_spine(v) for k, v in node.items()})
     return node  # already-frozen leaf
+
+
+def _respine(fz, live: dict, segs: Tuple[str, ...]):
+    """A new frozen spine equal to `fz` except along the path `segs`, which
+    is rebuilt from the live tree (leaf objects are stored frozen already).
+    Unchanged sibling subtrees are SHARED with the previous spine, and new
+    FrozenDicts are created rather than mutated so cached hashes stay
+    valid."""
+    from ..engine.value import FrozenDict
+
+    base = dict(fz._d) if isinstance(fz, FrozenDict) else {}
+    key = segs[0]
+    if len(segs) == 1:
+        if isinstance(live, dict) and key in live:
+            base[key] = live[key]  # the frozen leaf object
+        else:
+            base.pop(key, None)  # deleted
+        return FrozenDict(base)
+    sub_live = live.get(key) if isinstance(live, dict) else None
+    if not isinstance(sub_live, dict):
+        base.pop(key, None)  # intermediate node gone
+        return FrozenDict(base)
+    sub_fz = base.get(key)
+    if isinstance(sub_fz, FrozenDict):
+        base[key] = _respine(sub_fz, sub_live, segs[1:])
+    else:
+        base[key] = freeze_spine(sub_live)
+    return FrozenDict(base)
 
 
 class InterpDriver:

@@ -106,11 +106,20 @@ def multihost_capped_sweep(driver, K: int):
         mesh, ap.capacity, (ap.rp, ap.cols)
     )
     (cs_g, gp_g), _t2 = shard_rows_global(mesh, -1, (cp.arrays, group_params))
-    raw = fn.__wrapped__
-    sharded = jax.jit(
-        lambda rv, cs, c, gp: raw(rv, cs, c, gp)[1],  # packed only
-        out_shardings=NamedSharding(mesh, P()),
-    )
+    # jit cached on the driver per (constraint epoch, K, mesh shape): a
+    # fresh lambda per call would re-trace + recompile the fused kernel
+    # every sweep (advisor r3)
+    key = (driver._cs_epoch, K, tuple(sorted(mesh.shape.items())))
+    cached = getattr(driver, "_multihost_jit", None)
+    if cached is not None and cached[0] == key:
+        sharded = cached[1]
+    else:
+        raw = fn.__wrapped__
+        sharded = jax.jit(
+            lambda rv, cs, c, gp: raw(rv, cs, c, gp)[1],  # packed only
+            out_shardings=NamedSharding(mesh, P()),
+        )
+        driver._multihost_jit = (key, sharded)
     with mesh:
         packed = sharded(rv_g, cs_g, cols_g, gp_g)
     packed = np.asarray(packed.addressable_data(0))

@@ -213,3 +213,51 @@ class TestLogging:
         assert line["msg"] == "denied admission"
         assert line["process"] == "admission"
         assert line["constraint_kind"] == "K8sRequiredLabels"
+
+
+class TestIncrementalFrozenSpine:
+    """store.frozen() rebuilds only the spine along changed paths; the
+    result must always deep-equal a from-scratch freeze."""
+
+    def _check(self, store):
+        from gatekeeper_tpu.client.drivers import freeze_spine
+
+        assert store.frozen() == freeze_spine(store.tree)
+
+    def test_incremental_matches_full(self):
+        from gatekeeper_tpu.client.drivers import InventoryStore
+
+        s = InventoryStore()
+        s.put(("cluster", "v1", "Namespace", "a"), {"x": 1})
+        base = s.frozen()
+        self._check(s)
+        s.put(("namespace", "ns1", "v1", "Pod", "p1"), {"y": [1, 2]})
+        s.put(("cluster", "v1", "Namespace", "b"), {"x": 2})
+        self._check(s)
+        # update in place
+        s.put(("cluster", "v1", "Namespace", "a"), {"x": 9})
+        self._check(s)
+        assert s.frozen()["cluster"]["v1"]["Namespace"]["a"]["x"] == 9
+        # delete a leaf and an implied-empty parent path
+        s.delete(("namespace", "ns1", "v1", "Pod", "p1"))
+        self._check(s)
+        # wipe falls back to full rebuild
+        s.delete(())
+        self._check(s)
+        assert len(s.frozen()) == 0
+        del base
+
+    def test_sharing_across_epochs(self):
+        from gatekeeper_tpu.client.drivers import InventoryStore
+
+        s = InventoryStore()
+        for i in range(50):
+            s.put(("namespace", f"ns{i % 5}", "v1", "Pod", f"p{i}"), {"i": i})
+        f1 = s.frozen()
+        s.put(("namespace", "ns0", "v1", "Pod", "p0"), {"i": 999})
+        f2 = s.frozen()
+        # untouched namespace subtrees are the same objects
+        assert f1["namespace"]["ns1"] is f2["namespace"]["ns1"]
+        assert f2["namespace"]["ns0"]["v1"]["Pod"]["p0"]["i"] == 999
+        # old spine unchanged (immutability)
+        assert f1["namespace"]["ns0"]["v1"]["Pod"]["p0"]["i"] == 0
