@@ -7,6 +7,7 @@ The default run (BENCH_CONFIG unset or "all") measures:
     BASELINE north star <1s on one v5e chip) with a pack/device/fetch/render
     breakdown and a bandwidth-roofline utilization estimate
   - admission p99 latency on demo/basic (north star <=2ms)
+  - PSP library x 1k Pods audit (the reference benchmark's own fixtures)
   - agilebank full policy set x ~10k mixed resources audit
   - 1M-review streamed batch throughput (the "mesh" config shape)
   - template-ingest storm p50 (async compile, interp-served mid-storm)
@@ -17,7 +18,7 @@ The default run (BENCH_CONFIG unset or "all") measures:
 
 and prints ONE JSON line: the headline metric/value/unit/vs_baseline plus
 the secondary configs as extra keys.  Set BENCH_CONFIG to
-{synthetic, latency, agilebank, batch1m, ingest, curve, mesh} to run one
+{synthetic, latency, psp, agilebank, batch1m, ingest, curve, mesh} to run one
 config alone (it then prints its own single JSON line).
 
 Baseline note (see BASELINE.md): the reference is Go; no Go toolchain exists
@@ -105,6 +106,56 @@ def bench_agilebank() -> dict:
         f"{len(res.results())} violations kept")
     return {
         "metric": f"agilebank end-to-end audit ({total} resources)",
+        "value": round(dur, 3),
+        "unit": "s",
+        "vs_baseline": 0,
+    }
+
+
+def bench_psp() -> dict:
+    """BASELINE config 'PSP library x 1k Pods': the reference benchmark's
+    own fixtures (pkg/webhook/testdata/psp-all-violations: 5 PSP
+    templates/constraints + violating pods, policy_benchmark_test.go:265-271)
+    scaled to ~1k cached Pods, steady-state capped audit."""
+    import copy as _copy
+
+    from gatekeeper_tpu.client.client import Client
+    from gatekeeper_tpu.ops.driver import TpuDriver
+
+    n_copies = int(os.environ.get("BENCH_PSP_COPIES", "200"))
+    base = "/root/reference/pkg/webhook/testdata/psp-all-violations"
+    c = Client(driver=TpuDriver())
+    for t in load_yaml_dir(f"{base}/psp-templates/*.yaml"):
+        c.add_template(t)
+    n_cons = 0
+    for cons in load_yaml_dir(f"{base}/psp-constraints/*.yaml"):
+        c.add_constraint(cons)
+        n_cons += 1
+    pods = load_yaml_dir(f"{base}/psp-pods/*.yaml")
+    total = 0
+    for i in range(n_copies):
+        for p in pods:
+            p2 = _copy.deepcopy(p)
+            p2["metadata"]["name"] = f"{p['metadata'].get('name', 'p')}-{i}"
+            p2["metadata"].setdefault("namespace", "default")
+            c.add_data(p2)
+            total += 1
+    log(f"psp: {n_cons} constraints x {total} pods")
+    c.audit_capped(20)  # compile + warm (full sweep)
+    c.add_data({"apiVersion": "v1", "kind": "Namespace",
+                "metadata": {"name": "psp-warm"}})
+    c.audit_capped(20)  # warm the delta path
+    p = _copy.deepcopy(pods[0])
+    p["metadata"]["name"] = "psp-delta"
+    p["metadata"].setdefault("namespace", "default")
+    c.add_data(p)
+    t0 = time.time()
+    res, _totals = c.audit_capped(20)
+    dur = time.time() - t0
+    log(f"psp end-to-end capped audit: {dur*1000:.0f}ms, "
+        f"{len(res.results())} violations kept")
+    return {
+        "metric": f"PSP library end-to-end audit ({n_cons} constraints x {total} pods)",
         "value": round(dur, 3),
         "unit": "s",
         "vs_baseline": 0,
@@ -344,11 +395,13 @@ for mesh_on in (False, True):
     driver._audit_cache = None
     driver._audit_dev = None
     driver._cs_device_cache = None
+    driver._delta_state = None  # both sides must run the FULL sharded sweep
     client.audit_capped(20)  # compile + warm
     # honest steady state: invalidate the sweep cache, keep executables
     ts = []
     for i in range(3):
         driver._audit_cache = None
+        driver._delta_state = None
         t0 = time.perf_counter()
         client.audit_capped(20)
         ts.append(time.perf_counter() - t0)
@@ -395,9 +448,11 @@ def bench_synthetic() -> dict:
     log(f"workload built: {n_templates} templates x {n_resources} resources "
         f"in {time.time()-t0:.1f}s")
 
-    # long-lived-state GC hygiene, as the production processes do
-    # (webhook/server.py): without it, gen-2 collections scanning the
-    # 100k-object inventory inject 100ms+ pauses into steady-state sweeps
+    # long-lived-state GC hygiene, as a production audit pod would do
+    # (webhook/server.py does the same at startup): without it, gen-2
+    # collections scanning the 100k-object inventory inject 100ms+ pauses
+    # into steady-state sweeps.  Unfrozen at the end of this config so the
+    # other configs in a combined run keep normal GC behavior.
     import gc
 
     gc.collect()
@@ -512,6 +567,8 @@ def bench_synthetic() -> dict:
         f"reference ({GO_TOPDOWN_DERATE:.0f}x derate): {est_ref_rate:.0f} "
         f"evals/s -> {est_ref_sweep_s:.0f}s for this sweep")
 
+    gc.unfreeze()  # the other configs in a combined run want normal GC
+
     return {
         "metric": (
             f"end-to-end audit sweep seconds ({n_templates} templates"
@@ -540,6 +597,7 @@ def bench_synthetic() -> dict:
 CONFIGS = {
     "synthetic": bench_synthetic,
     "latency": bench_latency,
+    "psp": bench_psp,
     "agilebank": bench_agilebank,
     "batch1m": bench_batch1m,
     "ingest": bench_ingest,
@@ -551,6 +609,7 @@ CONFIGS = {
 # their headline value lands under
 _FOLDED = [
     ("latency", "admission_p99_ms"),
+    ("psp", "psp_audit_s"),
     ("agilebank", "agilebank_audit_s"),
     ("batch1m", "streamed_reviews_per_s"),
     ("ingest", "ingest_p50_ms"),

@@ -194,6 +194,11 @@ class InventoryStore:
             changes = None
             if self._frozen is not None and self._frozen_epoch is not None:
                 changes = self._changes_since_locked(self._frozen_epoch)
+                if changes is not None:
+                    # dedupe: flapping objects log many entries for few
+                    # paths; _respine reads the final live tree, so one
+                    # rebuild per unique path suffices
+                    changes = list(dict.fromkeys(changes))
             if (
                 changes is None
                 or len(changes) > self.RESPINE_MAX

@@ -261,3 +261,21 @@ class TestIncrementalFrozenSpine:
         assert f2["namespace"]["ns0"]["v1"]["Pod"]["p0"]["i"] == 999
         # old spine unchanged (immutability)
         assert f1["namespace"]["ns0"]["v1"]["Pod"]["p0"]["i"] == 0
+
+    def test_flapping_objects_stay_incremental(self):
+        """Many log entries for few paths must not force a full re-freeze
+        (entries dedupe before the RESPINE_MAX check)."""
+        from gatekeeper_tpu.client.drivers import InventoryStore, freeze_spine
+
+        s = InventoryStore()
+        s.RESPINE_MAX = 16
+        for i in range(40):
+            s.put(("namespace", f"ns{i}", "v1", "Pod", f"p{i}"), {"i": i})
+        f1 = s.frozen()
+        for _flap in range(100):  # 100 entries, 2 unique paths
+            s.put(("namespace", "ns0", "v1", "Pod", "p0"), {"i": _flap})
+            s.put(("namespace", "ns1", "v1", "Pod", "p1"), {"i": -_flap})
+        f2 = s.frozen()
+        assert f2 == freeze_spine(s.tree)
+        # untouched subtree shared => the incremental path ran
+        assert f1["namespace"]["ns5"] is f2["namespace"]["ns5"]
