@@ -63,6 +63,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--disable-cert-rotation", action="store_true")
     p.add_argument("--enable-pprof", action="store_true")
     p.add_argument("--pprof-port", type=int, default=6060)
+    # device-side profiling: a jax.profiler server (XLA op/HLO traces,
+    # HBM usage) that TensorBoard/xprof attaches to on demand — the TPU
+    # analogue of the reference's net/http/pprof listener (main.go:113-119)
+    p.add_argument("--jax-profile-port", type=int, default=0,
+                   help="start a jax.profiler server on this port "
+                        "(0 = disabled; capture via TensorBoard)")
     # operations.go:77
     p.add_argument("--operation", action="append", default=[],
                    choices=list(ops_mod.ALL_OPERATIONS),
@@ -404,6 +410,11 @@ class App:
         if args.enable_pprof:
             self.profile_server = ProfileServer(args.pprof_port)
             self.profile_server.start()
+        if args.jax_profile_port:
+            import jax
+
+            jax.profiler.start_server(args.jax_profile_port)
+            self._jax_profiler_on = True
         log.info(
             "gatekeeper-tpu started",
             extra={"kv": {
@@ -424,6 +435,13 @@ class App:
         ):
             if component is not None:
                 component.stop()
+        if getattr(self, "_jax_profiler_on", False):
+            # jax holds the server in a module global; a second App.start()
+            # in this process would raise without this
+            import jax
+
+            jax.profiler.stop_server()
+            self._jax_profiler_on = False
         self.manager.stop()
 
     def run_forever(self):

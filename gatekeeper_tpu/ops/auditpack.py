@@ -139,9 +139,7 @@ class AuditPackCache:
         if store.epoch == self.synced_epoch:
             return False
         changes = store.changes_since(self.synced_epoch)
-        if changes is None or len(changes) > max(
-            1024, self.n_rows // self.REBUILD_FRACTION
-        ):
+        if changes is None:
             self._rebuild(driver, col_specs)
             return True
         seen = set()
@@ -154,6 +152,13 @@ class AuditPackCache:
                 continue
             seen.add(seg)
             ordered_changes.append(seg)
+        # threshold on UNIQUE paths (a flapping object logs many entries
+        # for one row; the rebuild-vs-patch tradeoff is about rows touched)
+        if len(ordered_changes) > max(
+            1024, self.n_rows // self.REBUILD_FRACTION
+        ):
+            self._rebuild(driver, col_specs)
+            return True
         ns_repack: set = set()
         for seg in reversed(ordered_changes):
             self._apply(driver, seg, col_specs)

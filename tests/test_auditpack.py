@@ -202,3 +202,33 @@ def test_full_audit_uses_resident_pack():
     )
     assert exact2 == fresh
     assert exact1 != exact2
+
+
+def test_flapping_object_stays_incremental():
+    """Many change-log entries for few unique paths must take the per-row
+    patch path, not the full rebuild (threshold counts unique paths)."""
+    from gatekeeper_tpu.client.client import Client
+    from gatekeeper_tpu.ops.driver import TpuDriver
+    from gatekeeper_tpu.util.synthetic import make_pods, make_templates
+
+    templates, constraints = make_templates(4)
+    c = Client(driver=TpuDriver())
+    for t, k in zip(templates, constraints):
+        c.add_template(t)
+        c.add_constraint(k)
+    pods = make_pods(60, seed=5)
+    for p in pods:
+        c.add_data(p)
+    c.audit_capped(5)
+    ap = c.driver._audit_pack
+    gen_before = ap.layout_gen
+    flap = dict(pods[0])
+    for i in range(2000):  # 2000 entries, 1 unique path
+        flap = dict(flap)
+        flap["metadata"] = dict(flap["metadata"])
+        flap["metadata"]["labels"] = {"rev": str(i % 3)}
+        c.driver.store.put(
+            ("namespace", flap["metadata"]["namespace"], "v1", "Pod",
+             flap["metadata"]["name"]), flap)
+    c.audit_capped(5)
+    assert ap.layout_gen == gen_before, "flapping forced a full rebuild"

@@ -260,3 +260,31 @@ class TestSmallPieces:
             assert "thread MainThread" in body
         finally:
             ps.stop()
+
+
+class TestJaxProfileServer:
+    def test_flag_starts_profiler_server(self):
+        """--jax-profile-port starts the jax.profiler server (the TPU
+        analogue of --enable-pprof; TensorBoard attaches on demand)."""
+        import socket
+
+        from gatekeeper_tpu.main import App, build_parser
+        from gatekeeper_tpu.kube.inmem import InMemoryKube
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        app = App(
+            build_parser().parse_args(
+                ["--jax-profile-port", str(port), "--disable-cert-rotation"]
+            ),
+            kube=InMemoryKube(),
+        )
+        try:
+            app.start()
+            # the profiler server listens (gRPC); a TCP connect suffices
+            probe = socket.create_connection(("127.0.0.1", port), timeout=5)
+            probe.close()
+        finally:
+            app.stop()

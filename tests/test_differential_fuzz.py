@@ -1,0 +1,118 @@
+"""Seeded randomized differential testing: the device path must match the
+interpreter oracle on randomly mutated workloads, including degenerate
+object shapes (missing fields, empty containers, wrong-typed values,
+unicode, deep labels).  Complements the fixed-scenario conformance battery
+(SURVEY §4 tier-1 role) with generative coverage.
+"""
+
+import copy
+import random
+
+import pytest
+
+from gatekeeper_tpu.client.client import Client
+from gatekeeper_tpu.client.drivers import InterpDriver
+from gatekeeper_tpu.ops.driver import TpuDriver
+from gatekeeper_tpu.util.synthetic import make_pods, make_templates
+
+
+def _mutate_pod(pod: dict, rng: random.Random) -> dict:
+    """Apply structure-breaking mutations real clusters produce."""
+    p = copy.deepcopy(pod)
+    for _ in range(rng.randint(0, 3)):
+        roll = rng.random()
+        if roll < 0.15:
+            p["spec"].pop("containers", None)  # no containers at all
+        elif roll < 0.3:
+            p["spec"]["containers"] = []  # empty list
+        elif roll < 0.4:
+            (p["metadata"].setdefault("labels", {})
+             )[f"weird/{rng.randint(0, 9)}"] = "x" * rng.randint(0, 5)
+        elif roll < 0.5:
+            p["metadata"].pop("labels", None)
+        elif roll < 0.6 and p["spec"].get("containers"):
+            c = rng.choice(p["spec"]["containers"])
+            c.pop("image", None)  # image missing entirely
+        elif roll < 0.7 and p["spec"].get("containers"):
+            c = rng.choice(p["spec"]["containers"])
+            c["ports"] = [{"hostPort": rng.choice([0, 65535, 31337])}]
+        elif roll < 0.8:
+            p["metadata"]["labels"] = {
+                "uni": "λ-ünïcode-" + chr(0x1F512),
+                "empty": "",
+            }
+        elif roll < 0.9:
+            p["spec"]["volumes"] = [
+                {"name": "v", rng.choice(["nfs", "hostPath", "emptyDir"]): {}}
+            ]
+        else:
+            p["spec"]["hostPID"] = rng.choice([True, False])
+    return p
+
+
+def _results_key(results):
+    return sorted(
+        (r.constraint["kind"], r.constraint["metadata"]["name"], r.msg,
+         str((r.review or {}).get("object", {}).get("metadata", {}).get("name")))
+        for r in results
+    )
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_fuzzed_workloads_device_matches_interp(seed):
+    rng = random.Random(seed)
+    n_templates = rng.randint(4, 14)
+    templates, constraints = make_templates(n_templates, seed=seed)
+    pods = [_mutate_pod(p, rng)
+            for p in make_pods(rng.randint(30, 120), seed=seed,
+                               violation_rate=rng.random())]
+
+    ct = Client(driver=TpuDriver())
+    ct.driver.DEVICE_MIN_CELLS = 0  # force the device path everywhere
+    ci = Client(driver=InterpDriver())
+    for t, k in zip(templates, constraints):
+        ct.add_template(t)
+        ci.add_template(t)
+        ct.add_constraint(k)
+        ci.add_constraint(k)
+    for p in pods:
+        ct.add_data(p)
+        ci.add_data(p)
+
+    # audit parity (uncapped: complete results)
+    assert _results_key(ct.audit().results()) == _results_key(
+        ci.audit().results()
+    ), f"audit diverged (seed {seed})"
+
+    # review parity on a random subset, through the batched device path
+    sample = rng.sample(pods, min(8, len(pods)))
+    reqs = [{
+        "uid": "u", "kind": {"group": "", "version": "v1", "kind": "Pod"},
+        "name": p["metadata"]["name"],
+        "namespace": p["metadata"].get("namespace", ""),
+        "operation": "CREATE", "object": p,
+    } for p in sample]
+    got = ct.driver.review_batch(reqs)
+    for req, (results, _trace) in zip(reqs, got):
+        want, _ = ci.driver.review(req)
+        assert _results_key(results) == _results_key(want), (
+            f"review diverged (seed {seed}, pod {req['name']})"
+        )
+
+    # capped-audit totals: exact entries must equal the oracle's
+    _res, totals = ct.audit_capped(3)
+    _ires, itotals = ci.audit_capped(3)
+    for key, (n, how) in totals.items():
+        if how == "exact":
+            assert n == itotals[key][0], (seed, key, n, itotals[key])
+
+    # churn + delta path parity
+    for i in range(3):
+        p = _mutate_pod(make_pods(1, seed=900 + i, violation_rate=1.0)[0], rng)
+        p["metadata"]["name"] = f"fuzz-delta-{i}"
+        ct.add_data(p)
+        ci.add_data(copy.deepcopy(p))
+        ct.audit_capped(3)
+    assert _results_key(ct.audit().results()) == _results_key(
+        ci.audit().results()
+    ), f"post-churn audit diverged (seed {seed})"
