@@ -488,5 +488,228 @@ def _b64_decode(s: Any):
         raise BuiltinError(f"base64.decode: {e}")
 
 
+# --------------------------------------------------------------------------
+# Library-template neighbours: builtins common in the public
+# gatekeeper-library policies (units.parse_bytes is what K8sContainerLimits
+# canonifies memory quantities with)
+# --------------------------------------------------------------------------
+
+_UNIT_FACTORS = {
+    "": 1,
+    "k": 10 ** 3, "m": 10 ** 6, "g": 10 ** 9, "t": 10 ** 12,
+    "p": 10 ** 15, "e": 10 ** 18,
+    "ki": 2 ** 10, "mi": 2 ** 20, "gi": 2 ** 30, "ti": 2 ** 40,
+    "pi": 2 ** 50, "ei": 2 ** 60,
+}
+
+
+@builtin("units", "parse_bytes")
+def _units_parse_bytes(s: Any):
+    """OPA units.parse_bytes: "1Gi" -> 2^30 etc (case-insensitive units,
+    optional trailing "b")."""
+    _need(isinstance(s, str), "units.parse_bytes: not a string")
+    txt = s.strip().strip('"')
+    i = 0
+    while i < len(txt) and (txt[i].isdigit() or txt[i] in ".-+"):
+        i += 1
+    num, unit = txt[:i], txt[i:].strip().lower()
+    if unit.endswith("b"):
+        unit = unit[:-1]
+    _need(num != "" and unit in _UNIT_FACTORS,
+          f"units.parse_bytes: could not parse {s!r}")
+    try:
+        value = float(num)
+    except ValueError:
+        raise BuiltinError(f"units.parse_bytes: bad number in {s!r}")
+    out = value * _UNIT_FACTORS[unit]
+    return int(out) if float(out).is_integer() else out
+
+
+@builtin("object", "union")
+def _object_union(a: Any, b: Any):
+    _need(isinstance(a, FrozenDict) and isinstance(b, FrozenDict),
+          "object.union: not objects")
+
+    def rec(x, y):
+        if isinstance(x, FrozenDict) and isinstance(y, FrozenDict):
+            out = dict(x._d)
+            for k, v in y._d.items():
+                out[k] = rec(out[k], v) if k in out else v
+            return FrozenDict(out)
+        return y
+
+    return rec(a, b)
+
+
+@builtin("object", "keys")
+def _object_keys(o: Any):
+    _need(isinstance(o, FrozenDict), "object.keys: not an object")
+    return RSet(o._d.keys())
+
+
+@builtin("cast_array")
+def _cast_array(x: Any):
+    import functools
+
+    if isinstance(x, tuple):
+        return x
+    if isinstance(x, RSet):
+        return tuple(sorted(x, key=functools.cmp_to_key(compare)))
+    raise BuiltinError("cast_array: not an array or set")
+
+
+@builtin("trim_space")
+def _trim_space(s: Any):
+    _need(isinstance(s, str), "trim_space: not a string")
+    return s.strip()
+
+
+@builtin("numbers", "range")
+def _numbers_range(a: Any, b: Any):
+    _need(is_number(a) and is_number(b), "numbers.range: not numbers")
+    _need(float(a).is_integer() and float(b).is_integer(),
+          "numbers.range: operands must be integers")
+    a, b = int(a), int(b)
+    step = 1 if b >= a else -1
+    return tuple(range(a, b + step, step))
+
+
+@builtin("glob", "match")
+def _glob_match(pattern: Any, delimiters: Any, match: Any):
+    """OPA glob.match: explicit separators limit * like a path glob; an
+    EMPTY delimiters array defaults to ["."] (OPA topdown glob semantics —
+    there is no way to request separator-free matching except **, which
+    always crosses separators).  Character classes support glob negation
+    [!...]."""
+    _need(isinstance(pattern, str) and isinstance(match, str),
+          "glob.match: pattern and match must be strings")
+    if delimiters is None:
+        delims = ["."]
+    else:
+        _need(isinstance(delimiters, tuple), "glob.match: delimiters array")
+        delims = [d for d in delimiters if isinstance(d, str)]
+        if not delims:
+            delims = ["."]  # OPA: empty delimiters default to ["."]
+    sep = "".join(re.escape(d) for d in delims)
+    out = []
+    i, n = 0, len(pattern)
+    while i < n:
+        c = pattern[i]
+        if c == "*":
+            if pattern[i:i + 2] == "**":
+                out.append(".*")
+                i += 2
+            else:
+                out.append(f"[^{sep}]*" if sep else ".*")
+                i += 1
+        elif c == "?":
+            out.append(f"[^{sep}]" if sep else ".")
+            i += 1
+        elif c == "[":
+            j = pattern.find("]", i + 1)
+            if j == -1:
+                out.append(re.escape(c))
+                i += 1
+            else:
+                cls = pattern[i:j + 1]
+                if cls.startswith("[!"):
+                    cls = "[^" + cls[2:]  # glob negation -> regex negation
+                out.append(cls)
+                i = j + 1
+        else:
+            out.append(re.escape(c))
+            i += 1
+    return re.fullmatch("".join(out), match) is not None
+
+
+@builtin("strings", "replace_n")
+def _strings_replace_n(patterns: Any, s: Any):
+    _need(isinstance(patterns, FrozenDict) and isinstance(s, str),
+          "strings.replace_n: (object, string)")
+    for k, v in patterns._d.items():
+        _need(isinstance(k, str) and isinstance(v, str),
+              "strings.replace_n: non-string mapping")
+        s = s.replace(k, v)
+    return s
+
+
+@builtin("json", "is_valid")
+def _json_is_valid(s: Any):
+    import json
+
+    _need(isinstance(s, str), "json.is_valid: not a string")
+    try:
+        json.loads(s)
+        return True
+    except (json.JSONDecodeError, RecursionError):
+        return False
+
+
+@builtin("semver", "compare")
+def _semver_compare(a: Any, b: Any):
+    _need(isinstance(a, str) and isinstance(b, str),
+          "semver.compare: not strings")
+
+    def parse(v):
+        core = v.split("+", 1)[0]
+        core, _, pre = core.partition("-")
+        parts = core.split(".")
+        _need(len(parts) == 3, f"semver.compare: bad version {v!r}")
+        try:
+            nums = tuple(int(p) for p in parts)
+        except ValueError:
+            raise BuiltinError(f"semver.compare: bad version {v!r}")
+        return nums, pre
+
+    na, pa = parse(a)
+    nb, pb = parse(b)
+    if na != nb:
+        return -1 if na < nb else 1
+    # a pre-release sorts before the release proper; pre-release tags
+    # compare per dot-separated identifier (semver spec item 11: numeric
+    # identifiers numerically and below alphanumeric ones)
+    if pa == pb:
+        return 0
+    if pa == "":
+        return 1
+    if pb == "":
+        return -1
+
+    def ids(pre):
+        out = []
+        for part in pre.split("."):
+            out.append((0, int(part), "") if part.isdigit() else (1, 0, part))
+        return out
+
+    ia, ib = ids(pa), ids(pb)
+    for xa, xb in zip(ia, ib):
+        if xa != xb:
+            return -1 if xa < xb else 1
+    if len(ia) != len(ib):  # more identifiers = higher precedence
+        return -1 if len(ia) < len(ib) else 1
+    return 0
+
+
+# per-query clock cache: OPA evaluates time.now_ns once per query so every
+# call within one evaluation sees the same instant; the interpreter bumps
+# the epoch at each query boundary (interp.QueryContext)
+_NOW_EPOCH = [0, 0]  # [query epoch, cached ns]
+_NOW_SEEN = [-1]
+
+
+def bump_query_epoch():
+    _NOW_EPOCH[0] += 1
+
+
+@builtin("time", "now_ns")
+def _time_now_ns():
+    import time
+
+    if _NOW_SEEN[0] != _NOW_EPOCH[0]:
+        _NOW_SEEN[0] = _NOW_EPOCH[0]
+        _NOW_EPOCH[1] = time.time_ns()
+    return _NOW_EPOCH[1]
+
+
 def lookup(path: tuple):
     return REGISTRY.get(path)

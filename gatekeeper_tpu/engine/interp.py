@@ -280,6 +280,9 @@ class QueryContext:
         self._extent: Dict[Tuple[int, str], Any] = {}
         self._func: Dict[Tuple[int, str, Tuple], Any] = {}
         self._depth = 0
+        # a query boundary: OPA evaluates wall-clock builtins once per
+        # query (every time.now_ns() in this evaluation sees one instant)
+        bi.bump_query_epoch()
 
     # ---- rule evaluation --------------------------------------------------
 

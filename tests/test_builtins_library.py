@@ -1,0 +1,174 @@
+"""Builtins beyond the reference corpus: the surface the public
+gatekeeper-library policies rely on (units.parse_bytes, object.*, glob,
+semver, ...), pinned through the full client + both drivers."""
+
+import pytest
+
+from gatekeeper_tpu.client.client import Client
+from gatekeeper_tpu.client.drivers import InterpDriver
+from gatekeeper_tpu.ops.driver import TpuDriver
+
+
+def _template(kind, rego):
+    return {
+        "apiVersion": "templates.gatekeeper.sh/v1beta1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": kind.lower()},
+        "spec": {
+            "crd": {"spec": {"names": {"kind": kind}}},
+            "targets": [{"target": "admission.k8s.gatekeeper.sh",
+                         "rego": rego}],
+        },
+    }
+
+
+def _constraint(kind, params=None):
+    return {
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": kind,
+        "metadata": {"name": f"c-{kind.lower()}"},
+        "spec": {
+            "match": {"kinds": [{"apiGroups": [""], "kinds": ["Pod"]}]},
+            "parameters": params or {},
+        },
+    }
+
+
+def _pod(name="p", mem="2Gi", image="nginx:1.2.3"):
+    return {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"containers": [{
+            "name": "c", "image": image,
+            "resources": {"limits": {"memory": mem}},
+        }]},
+    }
+
+
+def _req(pod):
+    return {
+        "uid": "u", "kind": {"group": "", "version": "v1", "kind": "Pod"},
+        "name": pod["metadata"]["name"],
+        "namespace": pod["metadata"]["namespace"],
+        "operation": "CREATE", "object": pod,
+    }
+
+
+# the gatekeeper-library K8sContainerLimits shape: memory quantities
+# canonified with units.parse_bytes and compared against a parameter
+MEMLIMIT_REGO = """
+package memlimit
+
+violation[{"msg": msg}] {
+  c := input.review.object.spec.containers[_]
+  mem := units.parse_bytes(c.resources.limits.memory)
+  max := units.parse_bytes(input.parameters.memory)
+  mem > max
+  msg := sprintf("container <%v> memory limit <%v> exceeds <%v>",
+                 [c.name, c.resources.limits.memory, input.parameters.memory])
+}
+"""
+
+# image tags constrained by semver range + registry glob
+IMAGEPOLICY_REGO = """
+package imagepolicy
+
+violation[{"msg": msg}] {
+  c := input.review.object.spec.containers[_]
+  parts := split(c.image, ":")
+  count(parts) == 2
+  semver.compare(parts[1], input.parameters.minVersion) == -1
+  msg := sprintf("image %v older than %v", [c.image, input.parameters.minVersion])
+}
+
+violation[{"msg": msg}] {
+  c := input.review.object.spec.containers[_]
+  not glob.match(input.parameters.registryGlob, ["/"], c.image)
+  msg := sprintf("image %v not from allowed registry", [c.image])
+}
+"""
+
+
+@pytest.mark.parametrize("driver_cls", [InterpDriver, TpuDriver])
+def test_memlimit_library_template(driver_cls):
+    c = Client(driver=driver_cls())
+    c.add_template(_template("MemLimit", MEMLIMIT_REGO))
+    c.add_constraint(_constraint("MemLimit", {"memory": "1Gi"}))
+    over = c.review(_req(_pod("over", mem="2Gi"))).results()
+    assert len(over) == 1 and "exceeds" in over[0].msg
+    under = c.review(_req(_pod("under", mem="512Mi"))).results()
+    assert under == []
+    # canonical unit equivalence: 1024Mi == 1Gi is NOT over the limit
+    eq = c.review(_req(_pod("eq", mem="1024Mi"))).results()
+    assert eq == []
+
+
+@pytest.mark.parametrize("driver_cls", [InterpDriver, TpuDriver])
+def test_image_semver_and_glob(driver_cls):
+    c = Client(driver=driver_cls())
+    c.add_template(_template("ImagePolicy", IMAGEPOLICY_REGO))
+    c.add_constraint(_constraint("ImagePolicy", {
+        "minVersion": "2.0.0", "registryGlob": "nginx*"
+    }))
+    old = c.review(_req(_pod("old", image="nginx:1.2.3"))).results()
+    assert any("older" in r.msg for r in old)
+    new = c.review(_req(_pod("new", image="nginx:2.1.0"))).results()
+    assert new == []
+    foreign = c.review(_req(_pod("x", image="evil.io/x:3.0.0"))).results()
+    assert any("registry" in r.msg for r in foreign)
+
+
+def test_new_builtin_semantics_table():
+    """Direct semantics pins for the added builtins."""
+    from gatekeeper_tpu.engine import builtins as bi
+    from gatekeeper_tpu.engine.value import FrozenDict, RSet, freeze
+
+    pb = bi.lookup(("units", "parse_bytes"))
+    assert pb("1Gi") == 2 ** 30
+    assert pb("100m") == 100 * 10 ** 6  # lowercase m = mega in parse_bytes
+    assert pb("2KiB") == 2048
+    assert pb("5") == 5
+    assert pb("1.5Ki") == 1536
+    with pytest.raises(bi.BuiltinError):
+        pb("oops")
+    union = bi.lookup(("object", "union"))
+    got = union(freeze({"a": 1, "n": {"x": 1}}), freeze({"n": {"y": 2}}))
+    assert got["n"]["x"] == 1 and got["n"]["y"] == 2
+    keys = bi.lookup(("object", "keys"))
+    assert keys(freeze({"a": 1, "b": 2})) == RSet({"a", "b"})
+    glob = bi.lookup(("glob", "match"))
+    assert glob("*.com", (), "x.com")
+    assert not glob("*.com", (".",), "a.b.com")
+    assert glob("**.com", (".",), "a.b.com")
+    sem = bi.lookup(("semver", "compare"))
+    assert sem("1.0.0-alpha", "1.0.0") == -1
+    assert sem("10.0.0", "9.0.0") == 1
+    rng = bi.lookup(("numbers", "range"))
+    assert rng(3, 1) == (3, 2, 1)
+    ca = bi.lookup(("cast_array",))
+    assert ca(RSet({3, 1, 2})) == (1, 2, 3)
+    rep = bi.lookup(("strings", "replace_n"))
+    assert rep(freeze({"<": "&lt;"}), "<x>") == "&lt;x>"
+
+
+def test_builtin_edge_semantics():
+    """Review-driven edges: semver pre-release identifiers, glob negation,
+    numbers.range integer-only, per-query time caching."""
+    from gatekeeper_tpu.engine import builtins as bi
+
+    sem = bi.lookup(("semver", "compare"))
+    assert sem("1.0.0-alpha.10", "1.0.0-alpha.2") == 1  # numeric ids
+    assert sem("1.0.0-alpha", "1.0.0-alpha.1") == -1    # fewer ids first
+    assert sem("1.0.0-1", "1.0.0-alpha") == -1          # numeric < alpha
+    glob = bi.lookup(("glob", "match"))
+    assert glob("[!abc]", (".",), "x")
+    assert not glob("[!abc]", (".",), "a")
+    rng = bi.lookup(("numbers", "range"))
+    with pytest.raises(bi.BuiltinError):
+        rng(1.5, 3)
+    now = bi.lookup(("time", "now_ns"))
+    bi.bump_query_epoch()
+    a, b = now(), now()
+    assert a == b, "same query must see one instant"
+    bi.bump_query_epoch()
+    assert now() >= a
