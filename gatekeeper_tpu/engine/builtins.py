@@ -509,13 +509,12 @@ def _units_parse_bytes(s: Any):
     optional trailing "b")."""
     _need(isinstance(s, str), "units.parse_bytes: not a string")
     txt = s.strip().strip('"')
-    i = 0
-    while i < len(txt) and (txt[i].isdigit() or txt[i] in ".-+"):
-        i += 1
-    num, unit = txt[:i], txt[i:].strip().lower()
+    m = re.fullmatch(r"([+-]?\d+(?:\.\d+)?)([A-Za-z]*)", txt)
+    _need(m is not None, f"units.parse_bytes: could not parse {s!r}")
+    num, unit = m.group(1), m.group(2).lower()
     if unit.endswith("b"):
         unit = unit[:-1]
-    _need(num != "" and unit in _UNIT_FACTORS,
+    _need(unit in _UNIT_FACTORS,
           f"units.parse_bytes: could not parse {s!r}")
     try:
         value = float(num)
@@ -577,19 +576,19 @@ def _numbers_range(a: Any, b: Any):
 @builtin("glob", "match")
 def _glob_match(pattern: Any, delimiters: Any, match: Any):
     """OPA glob.match: explicit separators limit * like a path glob; an
-    EMPTY delimiters array defaults to ["."] (OPA topdown glob semantics —
-    there is no way to request separator-free matching except **, which
-    always crosses separators).  Character classes support glob negation
-    [!...]."""
+    EMPTY delimiters array defaults to ["."], while a null delimiters
+    argument means separator-free matching (* crosses everything) — OPA
+    topdown glob semantics.  ** always crosses separators; character
+    classes support glob negation [!...]."""
     _need(isinstance(pattern, str) and isinstance(match, str),
           "glob.match: pattern and match must be strings")
     if delimiters is None:
-        delims = ["."]
+        delims = []  # null: no separators, * crosses everything
     else:
         _need(isinstance(delimiters, tuple), "glob.match: delimiters array")
         delims = [d for d in delimiters if isinstance(d, str)]
         if not delims:
-            delims = ["."]  # OPA: empty delimiters default to ["."]
+            delims = ["."]  # OPA: EMPTY delimiters default to ["."]
     sep = "".join(re.escape(d) for d in delims)
     out = []
     i, n = 0, len(pattern)
@@ -626,7 +625,8 @@ def _glob_match(pattern: Any, delimiters: Any, match: Any):
 def _strings_replace_n(patterns: Any, s: Any):
     _need(isinstance(patterns, FrozenDict) and isinstance(s, str),
           "strings.replace_n: (object, string)")
-    for k, v in patterns._d.items():
+    for k in patterns.sorted_keys():  # Rego objects iterate in key order
+        v = patterns[k]
         _need(isinstance(k, str) and isinstance(v, str),
               "strings.replace_n: non-string mapping")
         s = s.replace(k, v)
@@ -691,24 +691,28 @@ def _semver_compare(a: Any, b: Any):
 
 
 # per-query clock cache: OPA evaluates time.now_ns once per query so every
-# call within one evaluation sees the same instant; the interpreter bumps
-# the epoch at each query boundary (interp.QueryContext)
-_NOW_EPOCH = [0, 0]  # [query epoch, cached ns]
-_NOW_SEEN = [-1]
+# call within one evaluation sees the same instant.  THREAD-LOCAL: each
+# query runs on one thread, and concurrent admission reviews (the webhook
+# server is threaded) must not bump each other's epoch.  The interpreter
+# bumps the epoch at each query boundary (interp.QueryContext).
+import threading as _threading
+
+_NOW_TLS = _threading.local()
 
 
 def bump_query_epoch():
-    _NOW_EPOCH[0] += 1
+    _NOW_TLS.epoch = getattr(_NOW_TLS, "epoch", 0) + 1
 
 
 @builtin("time", "now_ns")
 def _time_now_ns():
     import time
 
-    if _NOW_SEEN[0] != _NOW_EPOCH[0]:
-        _NOW_SEEN[0] = _NOW_EPOCH[0]
-        _NOW_EPOCH[1] = time.time_ns()
-    return _NOW_EPOCH[1]
+    epoch = getattr(_NOW_TLS, "epoch", 0)
+    if getattr(_NOW_TLS, "seen", None) != epoch:
+        _NOW_TLS.seen = epoch
+        _NOW_TLS.ns = time.time_ns()
+    return _NOW_TLS.ns
 
 
 def lookup(path: tuple):

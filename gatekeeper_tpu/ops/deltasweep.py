@@ -58,6 +58,12 @@ class DeltaState:
         # after-columns of rows dirtied since the full sweep; the
         # before-column of a newly-dirtied row is gathered from mask_dev
         self.row_cols: Dict[int, np.ndarray] = {}
+        # lazily-fetched host copy of the base mask for the UNCAPPED audit
+        # path: fetched once per state generation, then kept current by
+        # overwriting only the columns dirtied since the last patch
+        # (pending_mask_rows; absolute values, so patching is idempotent)
+        self.host_mask: Optional[np.ndarray] = None
+        self.pending_mask_rows: set = set()
         # per-constraint rendered-result reuse across sweeps, keyed by the
         # (count, candidates, row generations) signature (driver
         # _render_capped); traced renders bypass it
@@ -91,3 +97,4 @@ class DeltaState:
             else:
                 insort(lst, r)
         self.row_cols[r] = new_col.astype(bool)
+        self.pending_mask_rows.add(r)

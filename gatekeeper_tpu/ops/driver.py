@@ -711,9 +711,29 @@ class TpuDriver(InterpDriver):
         return sweep
 
     def _audit_masks(self):
-        """Full host candidate mask for the uncapped audit path.  The mask
-        is fetched from the device-resident sweep output at most once per
-        (inventory, constraint) epoch and memoized."""
+        """Full host candidate mask for the uncapped audit path.
+
+        Steady state is incremental like the capped path: the base mask is
+        fetched ONCE per full sweep, and subsequent audits overwrite just
+        the columns of rows the delta sweep re-evaluated (absolute values,
+        so reapplying is idempotent) — no full-mask transfer and no full
+        device re-execution per store change."""
+        got = self._try_delta(self.AUDIT_TOPK_MIN)
+        if got is not None:
+            reviews, ordered, st = got
+            ap = self._audit_pack
+            if st.host_mask is None:
+                # capacity cannot have changed while the state is valid
+                # (a capacity change bumps layout_gen, invalidating it);
+                # copy: np.asarray of a jax array is a read-only view
+                st.host_mask = np.array(
+                    st.mask_dev, copy=True
+                )[:, : ap.capacity]
+                st.pending_mask_rows = set(st.row_cols)
+            for r in st.pending_mask_rows:
+                st.host_mask[:, r] = st.row_cols[r][: st.host_mask.shape[0]]
+            st.pending_mask_rows = set()
+            return reviews, ordered, st.host_mask
         sweep = self._audit_sweep(self.AUDIT_TOPK_MIN, reuse_any_k=True)
         if sweep is None:
             return [], [], None
@@ -722,6 +742,17 @@ class TpuDriver(InterpDriver):
         if host is None:
             host = np.asarray(mask_dev)[:, : self._audit_pack.capacity]
             self._audit_cache = (key, cached_sweep, host)
+        # a full sweep just rebased the incremental state; seed its host
+        # mask from this fetch so the next delta-path audit doesn't
+        # transfer the identical [C, R] mask a second time
+        st = self._delta_state
+        if (
+            st is not None
+            and st.host_mask is None
+            and st.mask_dev is mask_dev
+        ):
+            st.host_mask = host.copy()
+            st.pending_mask_rows = set(st.row_cols)
         return reviews, ordered, host
 
     def audit(self, tracing: bool = False):

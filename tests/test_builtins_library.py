@@ -172,3 +172,15 @@ def test_builtin_edge_semantics():
     assert a == b, "same query must see one instant"
     bi.bump_query_epoch()
     assert now() >= a
+    # null delimiters = separator-free matching (OPA ast.Null semantics)
+    glob2 = bi.lookup(("glob", "match"))
+    assert glob2("*.example.com", None, "a.b.example.com")
+    assert not glob2("*.example.com", (), "a.b.example.com")
+    # interior whitespace between number and unit is rejected like OPA
+    pb2 = bi.lookup(("units", "parse_bytes"))
+    with pytest.raises(bi.BuiltinError):
+        pb2("1 Gi")
+    # replacements apply in sorted key order (Rego object iteration)
+    rep2 = bi.lookup(("strings", "replace_n"))
+    from gatekeeper_tpu.engine.value import freeze as _fz
+    assert rep2(_fz({"b": "x", "ab": "y"}), "ab") == "y"

@@ -178,3 +178,28 @@ def test_render_cache_respects_cap_changes():
         k = r.constraint["metadata"]["name"]
         per2[k] = per2.get(k, 0) + 1
     assert all(v <= 2 + 1 for v in per2.values()), per2
+
+
+def test_uncapped_audit_incremental_after_churn():
+    """audit() (the --audit-exact-totals path) must stay correct and
+    incremental under churn: the base mask is fetched once, then changed
+    columns are patched host-side."""
+    ct, ci = _pair(n_templates=6, n_pods=120)
+    ct.audit_capped(5)  # base full sweep
+    for i in range(4):
+        p = make_pods(1, seed=2500 + i, violation_rate=1.0)[0]
+        p["metadata"]["name"] = f"ua-{i}"
+        ct.add_data(p)
+        ci.add_data(dict(p))
+        if i == 2:
+            pods = make_pods(120, seed=21, violation_rate=0.3)
+            ct.remove_data(pods[7])
+            ci.remove_data(pods[7])
+        assert _audit_keys(ct) == _audit_keys(ci), f"churn step {i}"
+    st = ct.driver._delta_state
+    assert st is not None and st.host_mask is not None
+    # the host mask equals a fresh full fetch of the same store
+    ct.driver._delta_state = None
+    ct.driver._audit_cache = None
+    _r, _o, fresh = ct.driver._audit_masks()
+    assert (st.host_mask == fresh).all()
