@@ -509,7 +509,7 @@ def _units_parse_bytes(s: Any):
     optional trailing "b")."""
     _need(isinstance(s, str), "units.parse_bytes: not a string")
     txt = s.strip().strip('"')
-    m = re.fullmatch(r"([+-]?\d+(?:\.\d+)?)([A-Za-z]*)", txt)
+    m = re.fullmatch(r"([+-]?(?:\d+\.?\d*|\.\d+))([A-Za-z]*)", txt)
     _need(m is not None, f"units.parse_bytes: could not parse {s!r}")
     num, unit = m.group(1), m.group(2).lower()
     if unit.endswith("b"):
@@ -625,12 +625,27 @@ def _glob_match(pattern: Any, delimiters: Any, match: Any):
 def _strings_replace_n(patterns: Any, s: Any):
     _need(isinstance(patterns, FrozenDict) and isinstance(s, str),
           "strings.replace_n: (object, string)")
+    keys = []
     for k in patterns.sorted_keys():  # Rego objects iterate in key order
-        v = patterns[k]
-        _need(isinstance(k, str) and isinstance(v, str),
+        _need(isinstance(k, str) and isinstance(patterns[k], str),
               "strings.replace_n: non-string mapping")
-        s = s.replace(k, v)
-    return s
+        if k:
+            keys.append(k)
+    # single left-to-right pass like Go's strings.Replacer (OPA topdown):
+    # replacement OUTPUT is never re-replaced; at a given position the
+    # first matching pattern in key order wins
+    out = []
+    i = 0
+    while i < len(s):
+        for k in keys:
+            if s.startswith(k, i):
+                out.append(patterns[k])
+                i += len(k)
+                break
+        else:
+            out.append(s[i])
+            i += 1
+    return "".join(out)
 
 
 @builtin("json", "is_valid")

@@ -109,9 +109,35 @@ class TemplatePolicy:
         # have violations that depend only on (review, parameters), which
         # lets evaluators memoize rendered cells across inventory changes.
         self.uses_inventory = False
+        # memo_safe: a policy's verdict for a review depends only on the
+        # review CONTENT (minus per-request metadata) and parameters.
+        # False when the policy (a) calls a wall-clock/random builtin, or
+        # (b) may read per-request metadata: input.review.uid, a dynamic
+        # index under input.review, or the whole input/input.review value
+        # (aliasing defeats static tracking).  Evaluators may cache
+        # rendered cells for memo_safe policies keyed on content.
+        self.memo_safe = True
         for cm in [self.main, *self.libs.values()]:
             for r in cm.module.rules:
                 for node in _walk_rule(r):
+                    if isinstance(node, Call) and node.path[:1] in (
+                        ("time",), ("rand",)
+                    ):
+                        self.memo_safe = False
+                    if isinstance(node, Ref) and isinstance(node.head, Var) and node.head.name == "input":
+                        ops = node.operands
+                        if not ops or not (
+                            isinstance(ops[0], Scalar)
+                            and ops[0].value in ("review", "parameters")
+                        ):
+                            self.memo_safe = False  # whole-input aliasing
+                        elif ops[0].value == "review":
+                            if len(ops) < 2:
+                                self.memo_safe = False  # whole-review alias
+                            elif not isinstance(ops[1], Scalar):
+                                self.memo_safe = False  # dynamic field
+                            elif ops[1].value == "uid":
+                                self.memo_safe = False
                     if isinstance(node, Ref) and isinstance(node.head, Var) and node.head.name == "data":
                         if not node.operands:
                             raise RegoCompileError("bare 'data' reference not allowed")

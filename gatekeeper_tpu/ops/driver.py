@@ -41,6 +41,21 @@ def _scatter_rows(dev_tree, idx, rows_tree):
         lambda d, r: d.at[idx].set(r), dev_tree, rows_tree
     )
 
+
+def _strip_request_meta(frozen_review):
+    """The memo key for a review: identical content minus per-request
+    metadata (uid), so repeated admissions of the same object hit the
+    memo despite fresh uids.  memo_safe policies provably never read
+    the stripped fields (engine/interp.py _validate)."""
+    from ..engine.value import FrozenDict
+
+    if isinstance(frozen_review, FrozenDict) and "uid" in frozen_review:
+        return FrozenDict(
+            {k: frozen_review[k] for k in frozen_review._d if k != "uid"}
+        )
+    return frozen_review
+
+
 class TpuDriver(InterpDriver):
     """Drop-in Driver with device-side batched evaluation.  Inherits state
     management (templates/constraints/store) and render fallback from
@@ -438,24 +453,35 @@ class TpuDriver(InterpDriver):
         frozen_review,
         inventory,
         tracing_log,
+        memo_review=None,
     ):
         # content-keyed memo: identical (constraint, object) cells render
         # identically while the constraint side is unchanged, PROVIDED the
-        # cell depends only on its inputs: templates reading data.inventory
-        # and constraints with a namespaceSelector (whose match consults the
-        # MUTABLE cached-namespace store, target/match.py) are excluded —
-        # a memoized verdict must never outlive a namespace relabel
+        # cell depends only on its inputs: excluded are templates reading
+        # data.inventory, policies that are not memo_safe (wall-clock
+        # builtins or per-request metadata reads, engine/interp.py), and
+        # constraints with a namespaceSelector (whose match consults the
+        # MUTABLE cached-namespace store, target/match.py) — a memoized
+        # verdict must never outlive a namespace relabel.  The key strips
+        # per-request metadata (uid) so real admission traffic, where every
+        # request has a fresh uid, still hits.
         tmpl = self.templates.get(kind)
         uses_inv = (
             True if tmpl is None
             else getattr(tmpl.policy, "uses_inventory", True)
         )
+        memo_safe = (
+            False if tmpl is None
+            else getattr(tmpl.policy, "memo_safe", False)
+        )
         match = (constraint.get("spec") or {}).get("match") or {}
-        if not uses_inv and not match.get("namespaceSelector"):
+        if not uses_inv and memo_safe and not match.get("namespaceSelector"):
             if self._review_memo_epoch != self._cs_epoch:
                 self._review_memo.clear()
                 self._review_memo_epoch = self._cs_epoch
-            mkey = (kind, constraint["metadata"].get("name", ""), frozen_review)
+            if memo_review is None:
+                memo_review = frozen_review
+            mkey = (kind, constraint["metadata"].get("name", ""), memo_review)
             violations = self._review_memo.get(mkey)
             if violations is None:
                 violations = self._eval_cell(
@@ -490,6 +516,44 @@ class TpuDriver(InterpDriver):
     def review(self, review: dict, tracing: bool = False):
         return self.review_batch([review], tracing=tracing)[0]
 
+    def _interp_review_memo(self, review: dict):
+        """InterpDriver.review semantics served through the content-keyed
+        render memo: the hybrid small-batch path and the async-compile
+        fallback — i.e. ordinary single admission requests — skip
+        re-evaluating (constraint, object) cells they have seen before.
+        Traced reviews go to the oracle directly (drivers.py review)."""
+        from ..engine.value import freeze
+
+        with self._lock:
+            inventory = self.store.frozen()
+            cached_ns = self.store.cached_namespace
+            results: List[Result] = []
+            frozen_review = freeze(review)
+            memo_review = _strip_request_meta(frozen_review)
+            for kind in sorted(self.constraints):
+                for name in sorted(self.constraints[kind]):
+                    constraint = self.constraints[kind][name]
+                    if needs_autoreject(constraint, review, cached_ns):
+                        results.append(
+                            Result(
+                                msg="Namespace is not cached in OPA.",
+                                metadata={"details": {}},
+                                constraint=constraint,
+                                review=review,
+                                enforcement_action=self._enforcement_action(
+                                    constraint
+                                ),
+                            )
+                        )
+                    # _render_cell re-checks the match and returns nothing
+                    # for non-matching constraints or missing templates —
+                    # identical semantics to the oracle's walk
+                    self._render_cell(
+                        results, constraint, kind, review, frozen_review,
+                        inventory, None, memo_review=memo_review,
+                    )
+            return results, None
+
     # Below this many constraint x review cells the device dispatch costs
     # more than it saves (kernel launch + host<->device transfer — or a
     # full network RTT when the chip sits behind a relay); small batches
@@ -517,15 +581,19 @@ class TpuDriver(InterpDriver):
             self._compiler is not None
             and not self._compiler.ready()
         ):
-            return [
-                InterpDriver.review(self, r, tracing=tracing) for r in reviews
-            ]
+            if tracing:
+                return [
+                    InterpDriver.review(self, r, tracing=True)
+                    for r in reviews
+                ]
+            return [self._interp_review_memo(r) for r in reviews]
         with self._lock:
             ordered, mask, autoreject = self.compute_masks(reviews)
             inventory = self.store.frozen()
             out = []
             for ri, review in enumerate(reviews):
                 frozen_review = freeze(review)
+                memo_review = _strip_request_meta(frozen_review)
                 results: List[Result] = []
                 trace: List[str] = [] if tracing else None
                 for i, (kind, name, constraint) in enumerate(ordered):
@@ -545,7 +613,7 @@ class TpuDriver(InterpDriver):
                     if mask[i, ri]:
                         self._render_cell(
                             results, constraint, kind, review, frozen_review,
-                            inventory, trace,
+                            inventory, trace, memo_review=memo_review,
                         )
                 out.append((results, "\n".join(trace) if tracing else None))
             return out

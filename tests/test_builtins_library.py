@@ -180,7 +180,12 @@ def test_builtin_edge_semantics():
     pb2 = bi.lookup(("units", "parse_bytes"))
     with pytest.raises(bi.BuiltinError):
         pb2("1 Gi")
-    # replacements apply in sorted key order (Rego object iteration)
+    # replacements apply in sorted key order (Rego object iteration),
+    # single pass: replacement output is never re-replaced (Go Replacer)
     rep2 = bi.lookup(("strings", "replace_n"))
     from gatekeeper_tpu.engine.value import freeze as _fz
     assert rep2(_fz({"b": "x", "ab": "y"}), "ab") == "y"
+    assert rep2(_fz({"a": "b", "b": "z"}), "a") == "b"
+    # parse_bytes accepts bare-fraction forms like OPA's float parse
+    assert bi.lookup(("units", "parse_bytes"))(".5Gi") == 2 ** 29
+    assert bi.lookup(("units", "parse_bytes"))("5.") == 5

@@ -245,3 +245,110 @@ class TestDifferential:
                 assert bool(mask[i, ri]) == truth, (kind, review["name"])
                 checked += 1
         assert checked > 100
+
+
+def test_hybrid_path_memoizes_repeated_requests():
+    """The hybrid (small-batch, interp-served) path uses the content memo:
+    a repeated identical request re-renders nothing, and results match the
+    oracle exactly — including after a constraint change invalidates it."""
+    from gatekeeper_tpu.client.client import Client
+    from gatekeeper_tpu.client.drivers import InterpDriver
+    from gatekeeper_tpu.ops.driver import TpuDriver
+    from gatekeeper_tpu.util.synthetic import make_pods, make_templates
+
+    templates, constraints = make_templates(6)
+    ct = Client(driver=TpuDriver())
+    ci = Client(driver=InterpDriver())
+    for t, k in zip(templates, constraints):
+        ct.add_template(t)
+        ci.add_template(t)
+        ct.add_constraint(k)
+        ci.add_constraint(k)
+    pod = make_pods(1, seed=3, violation_rate=1.0)[0]
+    req = {"uid": "u", "kind": {"group": "", "version": "v1", "kind": "Pod"},
+           "name": pod["metadata"]["name"],
+           "namespace": pod["metadata"]["namespace"],
+           "operation": "CREATE", "object": pod}
+
+    def key(res):
+        return sorted((r.constraint["metadata"]["name"], r.msg)
+                      for r in res.results())
+
+    first = key(ct.review(req))
+    assert first == key(ci.review(req))
+    assert len(ct.driver._review_memo) > 0
+    assert key(ct.review(req)) == first  # memo-served, identical
+    # constraint mutation invalidates: flip one to dryrun and re-review
+    k2 = dict(constraints[0])
+    k2["spec"] = dict(k2["spec"])
+    k2["spec"]["enforcementAction"] = "dryrun"
+    ct.add_constraint(k2)
+    ci.add_constraint(dict(k2))
+    a = sorted((r.constraint["metadata"]["name"], r.enforcement_action)
+               for r in ct.review(req).results())
+    b = sorted((r.constraint["metadata"]["name"], r.enforcement_action)
+               for r in ci.review(req).results())
+    assert a == b
+    # tracing bypasses the memo and matches the oracle's trace behavior
+    res_t, trace_t = ct.driver.review(req, tracing=True)
+    assert trace_t is not None and "match" in trace_t
+
+
+def test_memo_excluded_for_clock_and_uid_policies():
+    """Policies calling wall-clock builtins or reading request metadata
+    must never be memo-served; uid-stripped keys let real traffic (fresh
+    uid per request) hit for safe policies."""
+    from gatekeeper_tpu.client.client import Client
+    from gatekeeper_tpu.ops.driver import TpuDriver
+
+    CLOCK_REGO = """
+package clocky
+violation[{"msg": "tick"}] {
+  time.now_ns() > 0
+}
+"""
+    UID_REGO = """
+package uidy
+violation[{"msg": msg}] {
+  msg := sprintf("uid %v", [input.review.uid])
+}
+"""
+    SAFE_REGO = """
+package safe
+violation[{"msg": "no-labels"}] {
+  not input.review.object.metadata.labels.owner
+}
+"""
+
+    def tpl(kind, rego):
+        return {"apiVersion": "templates.gatekeeper.sh/v1beta1",
+                "kind": "ConstraintTemplate", "metadata": {"name": kind.lower()},
+                "spec": {"crd": {"spec": {"names": {"kind": kind}}},
+                         "targets": [{"target": "admission.k8s.gatekeeper.sh",
+                                      "rego": rego}]}}
+
+    c = Client(driver=TpuDriver())
+    for kind, rego in (("Clocky", CLOCK_REGO), ("Uidy", UID_REGO),
+                       ("Safe", SAFE_REGO)):
+        c.add_template(tpl(kind, rego))
+        c.add_constraint({"apiVersion": "constraints.gatekeeper.sh/v1beta1",
+                          "kind": kind, "metadata": {"name": f"c{kind.lower()}"},
+                          "spec": {"match": {"kinds": [
+                              {"apiGroups": [""], "kinds": ["Pod"]}]}}})
+    assert not c.driver.templates["Clocky"].policy.memo_safe
+    assert not c.driver.templates["Uidy"].policy.memo_safe
+    assert c.driver.templates["Safe"].policy.memo_safe
+
+    def req(uid):
+        return {"uid": uid, "kind": {"group": "", "version": "v1", "kind": "Pod"},
+                "name": "p", "namespace": "d", "operation": "CREATE",
+                "object": {"apiVersion": "v1", "kind": "Pod",
+                           "metadata": {"name": "p", "namespace": "d"}}}
+
+    r1 = {x.constraint["kind"]: x.msg for x in c.review(req("uid-1")).results()}
+    r2 = {x.constraint["kind"]: x.msg for x in c.review(req("uid-2")).results()}
+    # the uid-reading policy sees each request's own uid (never memoized)
+    assert r1["Uidy"] == "uid uid-1" and r2["Uidy"] == "uid uid-2"
+    # the safe policy hit the memo across differing uids
+    assert any(k[0] == "Safe" for k in c.driver._review_memo)
+    assert not any(k[0] in ("Uidy", "Clocky") for k in c.driver._review_memo)
