@@ -251,14 +251,17 @@ def _is_frozen(v):
 
 def _walk_rule(r: Rule):
     stack: List[Node] = []
-    if r.args:
-        stack.extend(r.args)
-    if r.key is not None:
-        stack.append(r.key)
-    if r.value is not None:
-        stack.append(r.value)
-    for e in r.body:
-        stack.append(e)  # type: ignore[arg-type]
+    clause: Optional[Rule] = r
+    while clause is not None:  # head clause + its else chain
+        if clause.args:
+            stack.extend(clause.args)
+        if clause.key is not None:
+            stack.append(clause.key)
+        if clause.value is not None:
+            stack.append(clause.value)
+        for e in clause.body:
+            stack.append(e)  # type: ignore[arg-type]
+        clause = clause.els
     while stack:
         n = stack.pop()
         yield n
@@ -323,21 +326,28 @@ class QueryContext:
             if r.is_default:
                 default = next(self.eval_term(cm, r.value, {}))[0]
                 continue
-            for b in self.eval_body(cm, r.body, 0, {}):
-                val = True
-                if r.value is not None:
-                    got = next(self.eval_term(cm, r.value, b), None)
-                    if got is None:
-                        continue
-                    val = got[0]
-                result = val
-                break
+            result = self._clause_chain_value(cm, r, {})
             if result is not UNDEFINED:
                 break
         if result is UNDEFINED:
             result = default
         self._complete[key] = result
         return result
+
+    def _clause_chain_value(self, cm: CompiledModule, r: Rule, bindings: Bindings) -> Any:
+        """Evaluate a clause and its `else` chain: the first clause whose
+        body succeeds provides the value (true when the head has none)."""
+        clause: Optional[Rule] = r
+        while clause is not None:
+            for b in self.eval_body(cm, clause.body, 0, bindings):
+                if clause.value is None:
+                    return True
+                got = next(self.eval_term(cm, clause.value, b), None)
+                if got is None:
+                    continue
+                return got[0]
+            clause = clause.els
+        return UNDEFINED
 
     def partial_set_extent(self, cm: CompiledModule, name: str) -> RSet:
         key = (id(cm), name)
@@ -392,18 +402,9 @@ class QueryContext:
             if not r.is_function or len(r.args) != len(args):
                 continue
             for b in self._unify_params(cm, r.args, args, {}):
-                done = False
-                for b2 in self.eval_body(cm, r.body, 0, b):
-                    if r.value is None:
-                        result = True
-                        done = True
-                        break
-                    got = next(self.eval_term(cm, r.value, b2), None)
-                    if got is not None:
-                        result = got[0]
-                        done = True
-                        break
-                if done:
+                got = self._clause_chain_value(cm, r, b)
+                if got is not UNDEFINED:
+                    result = got
                     break
             if result is not UNDEFINED:
                 break

@@ -686,6 +686,10 @@ class Vectorizer:
         for r in rules:
             if not r.is_function or len(r.args or ()) != len(args):
                 raise _Unsupported()
+            if r.els is not None:
+                # `else` is ordered choice, not disjunction; leave these
+                # helpers to the interpreter.
+                raise _Unsupported()
             if r.value is not None and not (
                 isinstance(r.value, Scalar) and r.value.value is True
             ):

@@ -5,10 +5,11 @@ The grammar covers the policy corpus shipped with the reference
 /root/reference): multi-clause rules, functions (including constant-argument
 clauses), partial set/object rules, array/set/object comprehensions, negation,
 refs with variable operands, infix arithmetic/comparison/set operators, and
-`some` declarations.  `with` modifiers and `else` are intentionally out of
-scope: the hook shim and constraint-matching library that need them in the
-reference (vendored regolib/src.go, pkg/target/target_template_source.go) are
-implemented natively in gatekeeper_tpu.target / gatekeeper_tpu.client.
+`some` declarations, import aliasing, and `else` clause chains.  `with`
+modifiers are intentionally out of scope: the hook shim and
+constraint-matching library that need them in the reference (vendored
+regolib/src.go, pkg/target/target_template_source.go) are implemented
+natively in gatekeeper_tpu.target / gatekeeper_tpu.client.
 """
 
 from __future__ import annotations
@@ -128,6 +129,9 @@ class Rule(Node):
     body: Body
     is_default: bool = False
     loc: Tuple[int, int] = (0, 0)
+    # `else` chain: the next clause, tried only if this clause's body fails
+    # (OPA else semantics; valid on complete rules and functions only).
+    els: Optional["Rule"] = None
 
     @property
     def is_function(self) -> bool:

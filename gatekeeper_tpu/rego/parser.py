@@ -85,15 +85,37 @@ class Parser:
         pkg = self.parse_package_path()
         rules: List[Rule] = []
         self.skip_nl()
+        # Imports bind an alias (`import data.lib.helpers` -> `helpers`,
+        # `import data.lib.x as y` -> `y`) that OPA resolves at compile time
+        # (vendored opa/ast resolves import aliases during rewriting); we do
+        # the same with a post-parse AST rewrite so safety analysis, the
+        # interpreter, and the vectorizer all see fully-qualified refs.
+        imports: dict = {}
         while self.at("kw", "import"):
-            # imports recorded but unused: the corpus references libs via
-            # fully-qualified data.lib paths (enforced by compile validation).
+            tok = self.cur()
             self.advance()
-            self.parse_package_path()
+            path = self.parse_package_path()
+            alias: Optional[str] = None
+            if self.at("kw", "as"):
+                self.advance()
+                alias = self.expect("ident").value
+            if path[0] not in ("data", "input"):
+                raise RegoParseError(
+                    "import path must begin with data or input", tok.line, tok.col
+                )
+            name = alias or path[-1]
+            if name in imports:
+                raise RegoParseError(
+                    f"import must not shadow import '{name}'", tok.line, tok.col
+                )
+            imports[name] = tuple(path)
             self.skip_nl()
         while not self.at("eof"):
             rules.append(self.parse_rule())
             self.skip_nl()
+        if imports:
+            _check_import_shadowing(rules, imports)
+            rules = [_rewrite_rule_imports(r, imports) for r in rules]
         return Module(package=tuple(pkg), rules=tuple(rules), source=self.src)
 
     def parse_package_path(self) -> List[str]:
@@ -154,12 +176,34 @@ class Parser:
         elif value is None:
             # Only `name = value` / `f(x) = value` constants may omit the body.
             self.err("rule requires a body or value")
-        if self.at("kw", "else"):
-            self.err("'else' is not supported by this Rego subset")
+        els = self._parse_else_chain(key)
         if key is not None and value is None and args is None:
             # partial set rule
             return Rule(name, None, key, None, body, loc=loc)
-        return Rule(name, args, key, value, body, loc=loc)
+        return Rule(name, args, key, value, body, loc=loc, els=els)
+
+    def _parse_else_chain(self, key: Optional[Node]) -> Optional[Rule]:
+        """Parse `else [= value] { body }`... into a linked clause chain
+        (OPA else semantics: clauses tried in order, first success wins)."""
+        save = self.pos
+        self.skip_nl()
+        if not self.at("kw", "else"):
+            self.pos = save
+            return None
+        if key is not None:
+            self.err("'else' is not valid on partial rules")
+        loc = (self.cur().line, self.cur().col)
+        self.advance()
+        value: Optional[Node] = None
+        if self.at_punct("=", ":="):
+            self.advance()
+            self.skip_nl()
+            value = self.parse_term()
+        if not self.at_punct("{"):
+            self.err("'else' requires a body")
+        body = self.parse_body()
+        els = self._parse_else_chain(key)
+        return Rule("else", None, None, value, body, loc=loc, els=els)
 
     def parse_body(self) -> Body:
         self.expect("punct", "{")
@@ -422,6 +466,160 @@ class Parser:
             self.skip_nl()
         self.expect("punct", "}")
         return SetTerm(tuple(items))
+
+
+def _alias_ref(path) -> Ref:
+    return Ref(Var(path[0]), tuple(Scalar(p) for p in path[1:]))
+
+
+def _pattern_vars(node: Node, out: set):
+    """Vars bound by an assignment-LHS / parameter pattern."""
+    if isinstance(node, Var):
+        if not node.is_wildcard:
+            out.add(node.name)
+    elif isinstance(node, ArrayTerm):
+        for i in node.items:
+            _pattern_vars(i, out)
+    elif isinstance(node, ObjectTerm):
+        for _k, v in node.pairs:
+            _pattern_vars(v, out)
+
+
+def _check_import_shadowing(rules, imp: dict):
+    """OPA rejects local declarations that shadow an import alias
+    ('variables must not shadow import'); without this check the rewrite
+    below would silently mis-evaluate such programs instead of erroring."""
+
+    def check_body(body: Body, loc):
+        for e in body:
+            bound: set = set()
+            if e.kind == "some":
+                for v in e.terms:
+                    if isinstance(v, Var):
+                        bound.add(v.name)
+            elif e.kind == "assign":
+                _pattern_vars(e.terms[0], bound)
+            clash = bound & imp.keys()
+            if clash:
+                raise RegoParseError(
+                    f"variables must not shadow import '{sorted(clash)[0]}'",
+                    *e.loc,
+                )
+            for t in e.terms:
+                check_term(t, e.loc)
+
+    def check_term(t: Node, loc):
+        if isinstance(t, (ArrayCompr, SetCompr)):
+            check_body(t.body, loc)
+        elif isinstance(t, ObjectCompr):
+            check_body(t.body, loc)
+        elif isinstance(t, Expr):
+            check_body((t,), loc)
+        elif isinstance(t, Ref):
+            for op in t.operands:
+                check_term(op, loc)
+        elif isinstance(t, Call):
+            for a in t.args:
+                check_term(a, loc)
+        elif isinstance(t, BinOp):
+            check_term(t.lhs, loc)
+            check_term(t.rhs, loc)
+        elif isinstance(t, (ArrayTerm, SetTerm)):
+            for i in t.items:
+                check_term(i, loc)
+        elif isinstance(t, ObjectTerm):
+            for k, v in t.pairs:
+                check_term(k, loc)
+                check_term(v, loc)
+
+    for rule in rules:
+        clause = rule
+        while clause is not None:
+            if clause.name in imp:
+                raise RegoParseError(
+                    f"rule must not shadow import '{clause.name}'", *clause.loc
+                )
+            if clause.args:
+                bound: set = set()
+                for p in clause.args:
+                    _pattern_vars(p, bound)
+                clash = bound & imp.keys()
+                if clash:
+                    raise RegoParseError(
+                        f"variables must not shadow import '{sorted(clash)[0]}'",
+                        *clause.loc,
+                    )
+            check_body(clause.body, clause.loc)
+            for t in (clause.key, clause.value):
+                if t is not None:
+                    check_term(t, clause.loc)
+            clause = clause.els
+
+
+def _rewrite_rule_imports(rule: Rule, imp: dict) -> Rule:
+    """Replace import-alias references with their fully-qualified paths.
+
+    OPA rejects local bindings that shadow an import alias, so unconditional
+    substitution matches its semantics for all accepted programs.
+    """
+
+    def rw(node: Node) -> Node:
+        if isinstance(node, Var):
+            p = imp.get(node.name)
+            return _alias_ref(p) if p else node
+        if isinstance(node, Ref):
+            ops = tuple(rw(o) for o in node.operands)
+            head = node.head
+            if isinstance(head, Var):
+                p = imp.get(head.name)
+                if p:
+                    return Ref(Var(p[0]), tuple(Scalar(s) for s in p[1:]) + ops)
+                return Ref(head, ops)
+            return Ref(rw(head), ops)  # type: ignore[arg-type]
+        if isinstance(node, Call):
+            path = node.path
+            p = imp.get(path[0])
+            if p:
+                path = p + path[1:]
+            return Call(path, tuple(rw(a) for a in node.args))
+        if isinstance(node, ArrayTerm):
+            return ArrayTerm(tuple(rw(i) for i in node.items))
+        if isinstance(node, SetTerm):
+            return SetTerm(tuple(rw(i) for i in node.items))
+        if isinstance(node, ObjectTerm):
+            return ObjectTerm(tuple((rw(k), rw(v)) for k, v in node.pairs))
+        if isinstance(node, ArrayCompr):
+            return ArrayCompr(rw(node.head), rw_body(node.body))
+        if isinstance(node, SetCompr):
+            return SetCompr(rw(node.head), rw_body(node.body))
+        if isinstance(node, ObjectCompr):
+            return ObjectCompr(rw(node.key), rw(node.value), rw_body(node.body))
+        if isinstance(node, BinOp):
+            return BinOp(node.op, rw(node.lhs), rw(node.rhs))
+        if isinstance(node, UnaryMinus):
+            return UnaryMinus(rw(node.operand))
+        return node
+
+    def rw_expr(e: Expr) -> Expr:
+        if e.kind == "some":  # declarations, not references
+            return e
+        if e.kind == "not":
+            return Expr("not", (rw_expr(e.terms[0]),), e.loc)  # type: ignore[arg-type]
+        return Expr(e.kind, tuple(rw(t) for t in e.terms), e.loc)
+
+    def rw_body(body: Body) -> Body:
+        return tuple(rw_expr(e) for e in body)
+
+    return Rule(
+        name=rule.name,
+        args=tuple(rw(a) for a in rule.args) if rule.args is not None else None,
+        key=rw(rule.key) if rule.key is not None else None,
+        value=rw(rule.value) if rule.value is not None else None,
+        body=rw_body(rule.body),
+        is_default=rule.is_default,
+        loc=rule.loc,
+        els=_rewrite_rule_imports(rule.els, imp) if rule.els is not None else None,
+    )
 
 
 def parse_module(src: str) -> Module:

@@ -239,6 +239,15 @@ def transform_body(body: Body, initial_bound: Set[str], rule_names: FrozenSet[st
     return reorder_body(transformed, initial_bound, rule_names)
 
 
+def _reorder_rule(r: Rule, params: Set[str], rule_names: FrozenSet[str]) -> Rule:
+    body = transform_body(r.body, params, rule_names)
+    key = _transform_term(r.key, rule_names) if r.key is not None else None
+    value = _transform_term(r.value, rule_names) if r.value is not None else None
+    # else clauses share the head clause's parameter scope
+    els = _reorder_rule(r.els, params, rule_names) if r.els is not None else None
+    return Rule(r.name, r.args, key, value, body, r.is_default, r.loc, els=els)
+
+
 def reorder_module(module: Module) -> Module:
     """Reorder every rule body (and nested comprehension bodies) for safety."""
     rule_names = frozenset(r.name for r in module.rules)
@@ -250,10 +259,5 @@ def reorder_module(module: Module) -> Module:
             for p in r.args:
                 _walk(p, "pattern", a, rule_names)
             params = a.binds
-        body = transform_body(r.body, params, rule_names)
-        key = _transform_term(r.key, rule_names) if r.key is not None else None
-        value = _transform_term(r.value, rule_names) if r.value is not None else None
-        new_rules.append(
-            Rule(r.name, r.args, key, value, body, r.is_default, r.loc)
-        )
+        new_rules.append(_reorder_rule(r, params, rule_names))
     return Module(package=module.package, rules=tuple(new_rules), source=module.source)

@@ -318,3 +318,87 @@ violation[{"msg": msg}] {
 """
         )
         assert v == [{"msg": "apps,v1,,v1"}]
+
+
+class TestImportsAndElse:
+    """Import aliasing + else chains (OPA v0.21 semantics: vendored
+    opa/ast resolves imports at compile time; else is ordered choice)."""
+
+    def test_bats_containerlimits_template_uses_import(self):
+        # test/bats/tests/templates/k8scontainterlimits_template.yaml:131
+        # `import data.lib.helpers` + `helpers.canonify_cpu(...)` calls.
+        pol = compile_template("test/bats/tests/templates/k8scontainterlimits_template.yaml")
+        pod = {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "p", "namespace": "default"},
+            "spec": {"containers": [{
+                "name": "big", "image": "x",
+                "resources": {"limits": {"cpu": "4", "memory": "8Gi"}}}]},
+        }
+        msgs = sorted(
+            v["msg"] for v in pol.eval_violations(
+                make_review(pod), {"cpu": "200m", "memory": "1Gi"}, {})
+        )
+        assert msgs == [
+            "container <big> cpu limit <4> is higher than the maximum allowed of <200m>",
+            "container <big> memory limit <8Gi> is higher than the maximum allowed of <1Gi>",
+        ]
+
+    def _pol(self, rego):
+        return TemplatePolicy.compile(rego)
+
+    def test_else_complete_rule_ordering(self):
+        pol = self._pol(
+            """
+package p
+
+x = "first" { input.review.a } else = "second" { input.review.b } else = "third" { true }
+
+violation[{"msg": x}] { true }
+"""
+        )
+        def msg(review):
+            return pol.eval_violations(review, {}, {})[0]["msg"]
+        assert msg({"a": True, "b": True}) == "first"
+        assert msg({"b": True}) == "second"
+        assert msg({}) == "third"
+
+    def test_else_function(self):
+        pol = self._pol(
+            """
+package p
+
+grade(s) = "pass" { s >= 50 } else = "fail" { true }
+
+violation[{"msg": m}] { m := grade(input.review.score) }
+"""
+        )
+        assert pol.eval_violations({"score": 60}, {}, {})[0]["msg"] == "pass"
+        assert pol.eval_violations({"score": 10}, {}, {})[0]["msg"] == "fail"
+
+    def test_else_valueless_clause_yields_true(self):
+        pol = self._pol(
+            """
+package p
+
+ok { input.review.a } else { input.review.b }
+
+violation[{"msg": "y"}] { ok }
+"""
+        )
+        assert pol.eval_violations({"b": True}, {}, {}) == [{"msg": "y"}]
+        assert pol.eval_violations({}, {}, {}) == []
+
+    def test_else_undefined_falls_to_default(self):
+        pol = self._pol(
+            """
+package p
+
+default x = "dflt"
+
+x = "set" { input.review.a } else = "els" { input.review.b }
+
+violation[{"msg": x}] { true }
+"""
+        )
+        assert pol.eval_violations({}, {}, {})[0]["msg"] == "dflt"

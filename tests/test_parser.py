@@ -138,3 +138,97 @@ r { not allowedHostPath.readOnly == true }
 def test_rule_requires_body_or_value():
     with pytest.raises(RegoParseError):
         parse_module("package p\n\nviolation[x]\n")
+
+
+def test_import_alias_rewrites_refs_and_calls():
+    # `import data.lib.helpers` binds `helpers` (OPA resolves import aliases
+    # at compile time; vendored opa/ast); we rewrite to qualified refs.
+    m = parse_module(
+        """
+package p
+import data.lib.helpers
+
+v { helpers.missing(input.x, "cpu") }
+w { y := helpers.limits; y > 0 }
+"""
+    )
+    call = m.rules_named("v")[0].body[0].terms[0]
+    assert isinstance(call, Call)
+    assert call.path == ("data", "lib", "helpers", "missing")
+    ref = m.rules_named("w")[0].body[0].terms[1]
+    assert isinstance(ref, Ref) and ref.head.name == "data"
+
+
+def test_import_as_alias():
+    m = parse_module(
+        """
+package p
+import data.lib.kubernetes.pods as podlib
+
+v { podlib.is_pod(input) }
+"""
+    )
+    call = m.rules[0].body[0].terms[0]
+    assert call.path == ("data", "lib", "kubernetes", "pods", "is_pod")
+
+
+def test_import_must_target_data_or_input():
+    with pytest.raises(RegoParseError):
+        parse_module("package p\nimport foo.bar\n\nv { true }\n")
+
+
+def test_else_chain_parses():
+    m = parse_module(
+        """
+package p
+
+x = 1 { input.a } else = 2 { input.b } else = 3 { true }
+"""
+    )
+    r = m.rules[0]
+    assert r.value.value == 1
+    assert r.els is not None and r.els.value.value == 2
+    assert r.els.els is not None and r.els.els.value.value == 3
+    assert r.els.els.els is None
+
+
+def test_else_invalid_on_partial_rules():
+    with pytest.raises(RegoParseError):
+        parse_module("package p\n\nv[x] { x := 1 } else { true }\n")
+
+
+def test_import_shadowing_rejected():
+    # OPA: 'variables must not shadow import' — silent rewrite would
+    # mis-evaluate these instead of erroring.
+    with pytest.raises(RegoParseError):
+        parse_module(
+            "package p\nimport data.lib.helpers\n\nv { helpers := 5; helpers > 3 }\n"
+        )
+    with pytest.raises(RegoParseError):
+        parse_module(
+            "package p\nimport data.lib.helpers\n\nv { some helpers; input.x[helpers] }\n"
+        )
+    with pytest.raises(RegoParseError):
+        parse_module(
+            "package p\nimport data.lib.helpers\n\nf(helpers) = 1 { true }\n"
+        )
+    with pytest.raises(RegoParseError):
+        parse_module(
+            "package p\nimport data.lib.helpers\n\nhelpers { true }\n"
+        )
+
+
+def test_duplicate_import_alias_rejected():
+    with pytest.raises(RegoParseError):
+        parse_module(
+            "package p\nimport data.lib.alpha.helpers\nimport data.lib.beta.helpers\n\nv { true }\n"
+        )
+    # distinct aliases for the same-leaf packages are fine
+    m = parse_module(
+        "package p\nimport data.lib.alpha.helpers\nimport data.lib.beta.helpers as bh\n\nv { bh.f(1); helpers.g(2) }\n"
+    )
+    calls = [e.terms[0] for e in m.rules[0].body]
+    assert {c.path for c in calls} == {
+        ("data", "lib", "beta", "helpers", "f"),
+        ("data", "lib", "alpha", "helpers", "g"),
+    }
