@@ -732,3 +732,743 @@ def _time_now_ns():
 
 def lookup(path: tuple):
     return REGISTRY.get(path)
+
+
+# --------------------------------------------------------------------------
+# OPA v0.21 registry completion (vendored opa/ast/builtins.go).  Infix
+# operators (plus/minus/eq/...) are native BinOps; http.send and the
+# RSA/ECDSA crypto family are environment-blocked (no egress, no crypto
+# library) and stubbed to a BuiltinError so policies see undefined rather
+# than silently-wrong results.
+# --------------------------------------------------------------------------
+
+
+def _freeze(v):
+    from .value import freeze
+
+    return freeze(v)
+
+
+def _thaw(v):
+    from .value import thaw
+
+    return thaw(v)
+
+
+# ---- deprecated type casts (cast_array already above) ---------------------
+
+
+@builtin("cast_string")
+def _cast_string(x: Any):
+    _need(isinstance(x, str), "cast_string: not a string")
+    return x
+
+
+@builtin("cast_boolean")
+def _cast_boolean(x: Any):
+    _need(isinstance(x, bool), "cast_boolean: not a boolean")
+    return x
+
+
+@builtin("cast_null")
+def _cast_null(x: Any):
+    _need(x is None, "cast_null: not null")
+    return x
+
+
+@builtin("cast_object")
+def _cast_object(x: Any):
+    _need(isinstance(x, FrozenDict), "cast_object: not an object")
+    return x
+
+
+@builtin("cast_set")
+def _cast_set(x: Any):
+    _need(isinstance(x, RSet), "cast_set: not a set")
+    return x
+
+
+@builtin("set_diff")
+def _set_diff(a: Any, b: Any):
+    _need(isinstance(a, RSet) and isinstance(b, RSet), "set_diff: not sets")
+    return a.difference(b)
+
+
+# ---- encoding -------------------------------------------------------------
+
+
+@builtin("base64url", "encode")
+def _base64url_encode(s: Any):
+    import base64
+
+    _need(isinstance(s, str), "base64url.encode: not a string")
+    return base64.urlsafe_b64encode(s.encode()).decode()
+
+
+@builtin("base64url", "decode")
+def _base64url_decode(s: Any):
+    import base64
+
+    _need(isinstance(s, str), "base64url.decode: not a string")
+    try:
+        pad = s + "=" * (-len(s) % 4)
+        return base64.urlsafe_b64decode(pad.encode()).decode()
+    except Exception as e:
+        raise BuiltinError(f"base64url.decode: {e}")
+
+
+@builtin("urlquery", "encode")
+def _urlquery_encode(s: Any):
+    import urllib.parse
+
+    _need(isinstance(s, str), "urlquery.encode: not a string")
+    return urllib.parse.quote_plus(s)
+
+
+@builtin("urlquery", "decode")
+def _urlquery_decode(s: Any):
+    import urllib.parse
+
+    _need(isinstance(s, str), "urlquery.decode: not a string")
+    return urllib.parse.unquote_plus(s)
+
+
+@builtin("urlquery", "encode_object")
+def _urlquery_encode_object(obj: Any):
+    import urllib.parse
+
+    _need(isinstance(obj, FrozenDict), "urlquery.encode_object: not an object")
+    parts = []
+    for k in obj.keys():
+        v = obj[k]
+        _need(isinstance(k, str), "urlquery.encode_object: non-string key")
+        if isinstance(v, str):
+            parts.append((k, v))
+        elif isinstance(v, (tuple, RSet)):
+            for item in v:
+                _need(isinstance(item, str), "urlquery.encode_object: non-string value")
+                parts.append((k, item))
+        else:
+            raise BuiltinError("urlquery.encode_object: unsupported value type")
+    return urllib.parse.urlencode(parts)
+
+
+@builtin("yaml", "marshal")
+def _yaml_marshal(x: Any):
+    import yaml
+
+    return yaml.safe_dump(_thaw(x), default_flow_style=False)
+
+
+@builtin("yaml", "unmarshal")
+def _yaml_unmarshal(s: Any):
+    import yaml
+
+    _need(isinstance(s, str), "yaml.unmarshal: not a string")
+    try:
+        return _freeze(yaml.safe_load(s))
+    except yaml.YAMLError as e:
+        raise BuiltinError(f"yaml.unmarshal: {e}")
+
+
+# ---- crypto digests -------------------------------------------------------
+
+
+@builtin("crypto", "md5")
+def _crypto_md5(s: Any):
+    import hashlib
+
+    _need(isinstance(s, str), "crypto.md5: not a string")
+    return hashlib.md5(s.encode()).hexdigest()
+
+
+@builtin("crypto", "sha1")
+def _crypto_sha1(s: Any):
+    import hashlib
+
+    _need(isinstance(s, str), "crypto.sha1: not a string")
+    return hashlib.sha1(s.encode()).hexdigest()
+
+
+@builtin("crypto", "sha256")
+def _crypto_sha256(s: Any):
+    import hashlib
+
+    _need(isinstance(s, str), "crypto.sha256: not a string")
+    return hashlib.sha256(s.encode()).hexdigest()
+
+
+# ---- bits -----------------------------------------------------------------
+
+
+def _int_arg(x: Any, who: str) -> int:
+    _need(is_number(x), f"{who}: not an integer")
+    if isinstance(x, float):
+        # float(x) == int(x) would reject exact ints above 2^53 (every
+        # real ns timestamp); only true floats need the integrality check
+        _need(x.is_integer(), f"{who}: not an integer")
+    return int(x)
+
+
+@builtin("bits", "or")
+def _bits_or(a, b):
+    return _int_arg(a, "bits.or") | _int_arg(b, "bits.or")
+
+
+@builtin("bits", "and")
+def _bits_and(a, b):
+    return _int_arg(a, "bits.and") & _int_arg(b, "bits.and")
+
+
+@builtin("bits", "xor")
+def _bits_xor(a, b):
+    return _int_arg(a, "bits.xor") ^ _int_arg(b, "bits.xor")
+
+
+@builtin("bits", "negate")
+def _bits_negate(a):
+    return ~_int_arg(a, "bits.negate")
+
+
+@builtin("bits", "lsh")
+def _bits_lsh(a, n):
+    return _int_arg(a, "bits.lsh") << _int_arg(n, "bits.lsh")
+
+
+@builtin("bits", "rsh")
+def _bits_rsh(a, n):
+    return _int_arg(a, "bits.rsh") >> _int_arg(n, "bits.rsh")
+
+
+# ---- objects / json documents --------------------------------------------
+
+
+@builtin("object", "filter")
+def _object_filter(obj: Any, keys: Any):
+    _need(isinstance(obj, FrozenDict), "object.filter: not an object")
+    _need(isinstance(keys, (tuple, RSet, FrozenDict)), "object.filter: bad keys")
+    keep = set(keys.keys()) if isinstance(keys, FrozenDict) else set(keys)
+    return FrozenDict({k: obj[k] for k in obj.keys() if k in keep})
+
+
+@builtin("object", "remove")
+def _object_remove(obj: Any, keys: Any):
+    _need(isinstance(obj, FrozenDict), "object.remove: not an object")
+    _need(isinstance(keys, (tuple, RSet, FrozenDict)), "object.remove: bad keys")
+    drop = set(keys.keys()) if isinstance(keys, FrozenDict) else set(keys)
+    return FrozenDict({k: obj[k] for k in obj.keys() if k not in drop})
+
+
+def _json_paths(paths: Any, who: str):
+    """OPA json.filter/json.remove paths: strings "a/b" or arrays of keys."""
+    _need(isinstance(paths, (tuple, RSet)), f"{who}: paths must be array/set")
+    out = []
+    for p in paths:
+        if isinstance(p, str):
+            out.append(tuple(seg for seg in p.split("/") if seg != ""))
+        elif isinstance(p, tuple):
+            out.append(tuple(p))
+        else:
+            raise BuiltinError(f"{who}: bad path {p!r}")
+    return out
+
+
+def _json_filter_value(v: Any, paths):
+    """Keep only the listed paths ('' roots keep everything)."""
+    if any(len(p) == 0 for p in paths):
+        return v
+    if isinstance(v, FrozenDict):
+        out = {}
+        for k in v.keys():
+            sub = [p[1:] for p in paths if p[0] == k]
+            if sub:
+                out[k] = _json_filter_value(v[k], sub)
+        return FrozenDict(out)
+    if isinstance(v, tuple):
+        out_l = []
+        for i, item in enumerate(v):
+            sub = [p[1:] for p in paths if p[0] in (str(i), i)]
+            if sub:
+                out_l.append(_json_filter_value(item, sub))
+        return tuple(out_l)
+    return v
+
+
+@builtin("json", "filter")
+def _json_filter(obj: Any, paths: Any):
+    _need(isinstance(obj, FrozenDict), "json.filter: not an object")
+    return _json_filter_value(obj, _json_paths(paths, "json.filter"))
+
+
+def _json_remove_value(v: Any, paths):
+    drop_here = {p[0] for p in paths if len(p) == 1}
+    deeper: Dict[Any, list] = {}
+    for p in paths:
+        if len(p) > 1:
+            deeper.setdefault(p[0], []).append(p[1:])
+    if isinstance(v, FrozenDict):
+        out = {}
+        for k in v.keys():
+            if k in drop_here:
+                continue
+            if k in deeper:
+                out[k] = _json_remove_value(v[k], deeper[k])
+            else:
+                out[k] = v[k]
+        return FrozenDict(out)
+    if isinstance(v, tuple):
+        out_l = []
+        for i, item in enumerate(v):
+            if str(i) in drop_here or i in drop_here:
+                continue
+            subs = deeper.get(str(i), deeper.get(i))
+            out_l.append(_json_remove_value(item, subs) if subs else item)
+        return tuple(out_l)
+    return v
+
+
+@builtin("json", "remove")
+def _json_remove(obj: Any, paths: Any):
+    _need(isinstance(obj, FrozenDict), "json.remove: not an object")
+    return _json_remove_value(obj, _json_paths(paths, "json.remove"))
+
+
+# ---- graph ----------------------------------------------------------------
+
+
+@builtin("graph", "reachable")
+def _graph_reachable(graph: Any, initial: Any):
+    _need(isinstance(graph, FrozenDict), "graph.reachable: not an object")
+    _need(isinstance(initial, (tuple, RSet)), "graph.reachable: initial must be array/set")
+    seen = set()
+    stack = list(initial)
+    while stack:
+        n = stack.pop()
+        if n in seen:
+            continue
+        seen.add(n)
+        nbrs = graph.get(n, UNDEFINED)
+        if nbrs is UNDEFINED or nbrs is None:
+            continue
+        if isinstance(nbrs, (tuple, RSet)):
+            stack.extend(nbrs)
+    return RSet(seen)
+
+
+# ---- net ------------------------------------------------------------------
+
+
+def _parse_net(s: Any, who: str):
+    import ipaddress
+
+    _need(isinstance(s, str), f"{who}: not a string")
+    try:
+        if "/" in s:
+            return ipaddress.ip_network(s, strict=False)
+        addr = ipaddress.ip_address(s)
+        return ipaddress.ip_network(f"{addr}/{addr.max_prefixlen}")
+    except ValueError as e:
+        raise BuiltinError(f"{who}: {e}")
+
+
+@builtin("net", "cidr_contains")
+def _net_cidr_contains(cidr: Any, other: Any):
+    a = _parse_net(cidr, "net.cidr_contains")
+    b = _parse_net(other, "net.cidr_contains")
+    if a.version != b.version:
+        return False
+    return b.subnet_of(a)
+
+
+@builtin("net", "cidr_intersects")
+def _net_cidr_intersects(a: Any, b: Any):
+    na = _parse_net(a, "net.cidr_intersects")
+    nb = _parse_net(b, "net.cidr_intersects")
+    if na.version != nb.version:
+        return False
+    return na.overlaps(nb)
+
+
+@builtin("net", "cidr_overlap")
+def _net_cidr_overlap(cidr: Any, ip: Any):
+    # deprecated alias of cidr_contains with an IP operand
+    return _net_cidr_contains(cidr, ip)
+
+
+@builtin("net", "cidr_expand")
+def _net_cidr_expand(cidr: Any):
+    n = _parse_net(cidr, "net.cidr_expand")
+    _need(n.num_addresses <= 65536, "net.cidr_expand: network too large")
+    return RSet({str(h) for h in n})
+
+
+@builtin("net", "cidr_contains_matches")
+def _net_cidr_contains_matches(cidrs: Any, cidrs_or_ips: Any):
+    """Cross-product membership: pairs [cidr_index, candidate_index] (OPA
+    returns index keys for array operands, values for sets/strings)."""
+
+    def entries(x, who):
+        if isinstance(x, str):
+            return [(x, x)]
+        if isinstance(x, tuple):
+            out = []
+            for i, v in enumerate(x):
+                if isinstance(v, tuple) and v:  # [cidr, data...] tuples
+                    out.append((i, v[0]))
+                else:
+                    out.append((i, v))
+            return out
+        if isinstance(x, RSet):
+            return [(v, v) for v in x]
+        if isinstance(x, FrozenDict):
+            return [(k, x[k]) for k in x.keys()]
+        raise BuiltinError(f"{who}: unsupported operand")
+
+    out = set()
+    for ka, va in entries(cidrs, "net.cidr_contains_matches"):
+        for kb, vb in entries(cidrs_or_ips, "net.cidr_contains_matches"):
+            try:
+                if _net_cidr_contains(va, vb):
+                    out.add((ka, kb))
+            except BuiltinError:
+                continue
+    return RSet(out)
+
+
+# ---- time -----------------------------------------------------------------
+
+_GO_UNITS = {"ns": 1, "us": 1000, "µs": 1000, "ms": 10**6, "s": 10**9, "m": 60 * 10**9, "h": 3600 * 10**9}
+
+
+@builtin("time", "parse_duration_ns")
+def _time_parse_duration_ns(s: Any):
+    from fractions import Fraction
+
+    _need(isinstance(s, str), "time.parse_duration_ns: not a string")
+    txt = s.strip()
+    if txt in ("0", "+0", "-0"):  # Go ParseDuration's unitless zero
+        return 0
+    m = re.fullmatch(r"([+-])?((?:\d+\.?\d*|\.\d+)(?:ns|us|µs|ms|s|m|h))+", txt)
+    _need(m is not None and txt not in ("", "+", "-"), f"time.parse_duration_ns: bad duration {s!r}")
+    sign = -1 if txt.startswith("-") else 1
+    total = Fraction(0)  # exact: float accumulation loses ns at large scales
+    for num, unit in re.findall(r"(\d+\.?\d*|\.\d+)(ns|us|µs|ms|s|m|h)", txt):
+        total += Fraction(num) * _GO_UNITS[unit]
+    return sign * int(total)
+
+
+def _go_layout_to_strptime(layout: str) -> str:
+    """Map the common Go reference-time layouts to strptime directives."""
+    subs = [
+        ("2006", "%Y"), ("01", "%m"), ("02", "%d"), ("15", "%H"),
+        ("04", "%M"), ("05", "%S"), ("Jan", "%b"), ("Monday", "%A"),
+        ("Mon", "%a"), ("MST", "%Z"), ("Z07:00", "%z"), ("-07:00", "%z"),
+        ("-0700", "%z"), (".000", ".%f"), (".999999999", ".%f"), (".999999", ".%f"),
+    ]
+    out = layout
+    for go, py in subs:
+        out = out.replace(go, py)
+    return out
+
+
+def _dt_to_ns(dt) -> int:
+    import datetime
+
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=datetime.timezone.utc)
+    # exact integer arithmetic: float64 timestamp() cannot carry ns precision
+    delta = dt - datetime.datetime(1970, 1, 1, tzinfo=datetime.timezone.utc)
+    return (delta.days * 86400 + delta.seconds) * 10**9 + delta.microseconds * 1000
+
+
+@builtin("time", "parse_rfc3339_ns")
+def _time_parse_rfc3339_ns(s: Any):
+    import datetime
+
+    _need(isinstance(s, str), "time.parse_rfc3339_ns: not a string")
+    txt = s.strip()
+    # datetime.fromisoformat (3.11+) accepts Z and fractional seconds
+    try:
+        dt = datetime.datetime.fromisoformat(txt.replace("Z", "+00:00"))
+    except ValueError as e:
+        raise BuiltinError(f"time.parse_rfc3339_ns: {e}")
+    # preserve sub-microsecond digits lost by datetime
+    ns = _dt_to_ns(dt)
+    m = re.search(r"\.(\d{7,9})", txt)
+    if m:
+        frac = m.group(1).ljust(9, "0")[:9]
+        ns = (ns // 10**9) * 10**9 + int(frac)
+    return ns
+
+
+@builtin("time", "parse_ns")
+def _time_parse_ns(layout: Any, s: Any):
+    import datetime
+
+    _need(isinstance(layout, str) and isinstance(s, str), "time.parse_ns: not strings")
+    try:
+        dt = datetime.datetime.strptime(s, _go_layout_to_strptime(layout))
+    except ValueError as e:
+        raise BuiltinError(f"time.parse_ns: {e}")
+    return _dt_to_ns(dt)
+
+
+def _ns_arg(x: Any, who: str):
+    """OPA time builtins take ns or [ns, tz]; only UTC/Local-free math here."""
+    import datetime
+
+    if isinstance(x, tuple):
+        _need(len(x) >= 1, f"{who}: empty array operand")
+        ns = x[0]
+    else:
+        ns = x
+    ns = _int_arg(ns, who)
+    # integer arithmetic: fromtimestamp(ns / 1e9) rounds across second
+    # boundaries for large timestamps (float64 cannot carry ns)
+    dt = datetime.datetime(1970, 1, 1, tzinfo=datetime.timezone.utc) + datetime.timedelta(
+        microseconds=ns // 1000
+    )
+    return ns, dt
+
+
+@builtin("time", "date")
+def _time_date(x: Any):
+    _ns, dt = _ns_arg(x, "time.date")
+    return (dt.year, dt.month, dt.day)
+
+
+@builtin("time", "clock")
+def _time_clock(x: Any):
+    _ns, dt = _ns_arg(x, "time.clock")
+    return (dt.hour, dt.minute, dt.second)
+
+
+@builtin("time", "weekday")
+def _time_weekday(x: Any):
+    _ns, dt = _ns_arg(x, "time.weekday")
+    return ["Monday", "Tuesday", "Wednesday", "Thursday", "Friday", "Saturday", "Sunday"][dt.weekday()]
+
+
+@builtin("time", "add_date")
+def _time_add_date(ns: Any, years: Any, months: Any, days: Any):
+    import calendar
+    import datetime
+
+    base_ns, dt = _ns_arg(ns, "time.add_date")
+    y = _int_arg(years, "time.add_date")
+    mo = _int_arg(months, "time.add_date")
+    d = _int_arg(days, "time.add_date")
+    total_months = (dt.year + y) * 12 + (dt.month - 1) + mo
+    ny, nm = divmod(total_months, 12)
+    nm += 1
+    # Go normalizes out-of-range days by rolling over (Oct 31 + 1mo = Dec 1)
+    day_overflow = dt.day - calendar.monthrange(ny, nm)[1]
+    nd = dt.day
+    if day_overflow > 0:
+        nd = calendar.monthrange(ny, nm)[1]
+    out = dt.replace(year=ny, month=nm, day=nd)
+    if day_overflow > 0:
+        out += datetime.timedelta(days=day_overflow)
+    out += datetime.timedelta(days=d)
+    return _dt_to_ns(out) + base_ns % 1000  # keep sub-microsecond digits
+
+
+# ---- regex extras ---------------------------------------------------------
+
+
+@builtin("regex", "find_n")
+def _regex_find_n(pattern: Any, s: Any, n: Any):
+    _need(isinstance(pattern, str) and isinstance(s, str), "regex.find_n: not strings")
+    limit = _int_arg(n, "regex.find_n")
+    out = []
+    for m in _compile_re(pattern).finditer(s):
+        if limit >= 0 and len(out) >= limit:
+            break
+        out.append(m.group(0))
+    return tuple(out)
+
+
+@builtin("regex", "find_all_string_submatch_n")
+def _regex_find_all_string_submatch_n(pattern: Any, s: Any, n: Any):
+    _need(isinstance(pattern, str) and isinstance(s, str),
+          "regex.find_all_string_submatch_n: not strings")
+    limit = _int_arg(n, "regex.find_all_string_submatch_n")
+    out = []
+    for m in _compile_re(pattern).finditer(s):
+        if limit >= 0 and len(out) >= limit:
+            break
+        groups = [m.group(0)] + ["" if g is None else g for g in m.groups()]
+        out.append(tuple(groups))
+    return tuple(out)
+
+
+@builtin("regex", "template_match")
+def _regex_template_match(pattern: Any, s: Any, delim_start: Any, delim_end: Any):
+    """Match s against pattern where {delimited} spans are regexes and the
+    rest is literal (OPA topdown/regex.go builtinRegexMatchTemplate)."""
+    for x in (pattern, s, delim_start, delim_end):
+        _need(isinstance(x, str), "regex.template_match: not strings")
+    _need(len(delim_start) == 1 and len(delim_end) == 1,
+          "regex.template_match: delimiters must be single characters")
+    parts = []
+    i = 0
+    while i < len(pattern):
+        j = pattern.find(delim_start, i)
+        if j < 0:
+            parts.append(re.escape(pattern[i:]))
+            break
+        parts.append(re.escape(pattern[i:j]))
+        k = pattern.find(delim_end, j + 1)
+        _need(k >= 0, "regex.template_match: unbalanced delimiters")
+        parts.append("(?:" + pattern[j + 1:k] + ")")
+        i = k + 1
+    try:
+        return re.fullmatch("".join(parts), s) is not None
+    except re.error as e:
+        raise BuiltinError(f"regex.template_match: {e}")
+
+
+@builtin("glob", "quote_meta")
+def _glob_quote_meta(s: Any):
+    _need(isinstance(s, str), "glob.quote_meta: not a string")
+    return re.sub(r"([*?\[\]{}\\])", r"\\\1", s)
+
+
+# ---- JWT (HMAC family only: no RSA/ECDSA library in this image) -----------
+
+
+def _jwt_parts(token: Any, who: str):
+    import base64
+
+    _need(isinstance(token, str), f"{who}: not a string")
+    parts = token.split(".")
+    _need(len(parts) == 3, f"{who}: not a JWS compact token")
+
+    def dec(x):
+        return base64.urlsafe_b64decode(x + "=" * (-len(x) % 4))
+
+    try:
+        return dec(parts[0]), dec(parts[1]), dec(parts[2]), parts
+    except Exception as e:
+        raise BuiltinError(f"{who}: {e}")
+
+
+@builtin("io", "jwt", "decode")
+def _io_jwt_decode(token: Any):
+    import json
+
+    header_b, payload_b, sig_b, _parts = _jwt_parts(token, "io.jwt.decode")
+    try:
+        header = json.loads(header_b)
+        payload = json.loads(payload_b)
+    except json.JSONDecodeError as e:
+        raise BuiltinError(f"io.jwt.decode: {e}")
+    return (_freeze(header), _freeze(payload), sig_b.hex())
+
+
+def _jwt_verify_hs(token: Any, secret: Any, alg: str, digestmod) -> bool:
+    import hashlib  # noqa: F401  (digestmod resolved by caller)
+    import hmac
+    import json
+
+    header_b, _payload_b, sig_b, parts = _jwt_parts(token, f"io.jwt.verify_{alg.lower()}")
+    _need(isinstance(secret, str), f"io.jwt.verify_{alg.lower()}: secret not a string")
+    try:
+        header = json.loads(header_b)
+    except json.JSONDecodeError:
+        return False
+    if header.get("alg") != alg:
+        return False
+    signing_input = (parts[0] + "." + parts[1]).encode()
+    want = hmac.new(secret.encode(), signing_input, digestmod).digest()
+    return hmac.compare_digest(want, sig_b)
+
+
+@builtin("io", "jwt", "verify_hs256")
+def _io_jwt_verify_hs256(token: Any, secret: Any):
+    import hashlib
+
+    return _jwt_verify_hs(token, secret, "HS256", hashlib.sha256)
+
+
+@builtin("io", "jwt", "verify_hs384")
+def _io_jwt_verify_hs384(token: Any, secret: Any):
+    import hashlib
+
+    return _jwt_verify_hs(token, secret, "HS384", hashlib.sha384)
+
+
+@builtin("io", "jwt", "verify_hs512")
+def _io_jwt_verify_hs512(token: Any, secret: Any):
+    import hashlib
+
+    return _jwt_verify_hs(token, secret, "HS512", hashlib.sha512)
+
+
+def _unsupported_builtin(name: str, why: str):
+    def stub(*_args):
+        raise BuiltinError(f"{name}: {why}")
+
+    return stub
+
+
+for _name, _why in [
+    ("http.send", "outbound HTTP is disabled in this runtime"),
+    ("io.jwt.decode_verify", "asymmetric JWT verification requires a crypto library"),
+    ("io.jwt.encode_sign", "JWT signing requires a crypto library"),
+    ("io.jwt.encode_sign_raw", "JWT signing requires a crypto library"),
+    ("io.jwt.verify_rs256", "RSA verification requires a crypto library"),
+    ("io.jwt.verify_rs384", "RSA verification requires a crypto library"),
+    ("io.jwt.verify_rs512", "RSA verification requires a crypto library"),
+    ("io.jwt.verify_ps256", "RSA-PSS verification requires a crypto library"),
+    ("io.jwt.verify_ps384", "RSA-PSS verification requires a crypto library"),
+    ("io.jwt.verify_ps512", "RSA-PSS verification requires a crypto library"),
+    ("io.jwt.verify_es256", "ECDSA verification requires a crypto library"),
+    ("io.jwt.verify_es384", "ECDSA verification requires a crypto library"),
+    ("io.jwt.verify_es512", "ECDSA verification requires a crypto library"),
+    ("crypto.x509.parse_certificates", "X.509 parsing requires a crypto library"),
+    ("crypto.x509.parse_certificate_request", "X.509 parsing requires a crypto library"),
+    ("regex.globs_match", "glob-language intersection is not implemented"),
+    ("rego.parse_module", "reflective module parsing is not exposed"),
+]:
+    REGISTRY[tuple(_name.split("."))] = _unsupported_builtin(_name, _why)
+
+
+# ---- misc -----------------------------------------------------------------
+
+
+@builtin("trace")
+def _trace(note: Any):
+    _need(isinstance(note, str), "trace: not a string")
+    return True  # notes surface through the evaluator's tracer, not here
+
+
+@builtin("opa", "runtime")
+def _opa_runtime():
+    from .. import version
+
+    return FrozenDict({"version": getattr(version, "VERSION", "dev"), "env": FrozenDict({}), "config": FrozenDict({})})
+
+
+@builtin("uuid", "rfc4122")
+def _uuid_rfc4122(k: Any):
+    """Stable within one query per key (OPA caches per-query); marked
+    memo-unsafe by the compile analysis like time.now_ns."""
+    import uuid
+
+    epoch = getattr(_NOW_TLS, "epoch", 0)
+    cache = getattr(_NOW_TLS, "uuid_cache", None)
+    if cache is None or getattr(_NOW_TLS, "uuid_epoch", None) != epoch:
+        cache = {}
+        _NOW_TLS.uuid_cache = cache
+        _NOW_TLS.uuid_epoch = epoch
+    if k not in cache:
+        cache[k] = str(uuid.uuid4())
+    return cache[k]
+
+
+@builtin("walk")
+def _walk_stub(_x: Any):
+    # `walk` is relational; the interpreter special-cases it (interp.
+    # _eval_walk) and never dispatches here.  Registered for arity metadata.
+    raise BuiltinError("walk: must be used as walk(x, [path, value])")

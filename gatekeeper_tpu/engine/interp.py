@@ -121,7 +121,7 @@ class TemplatePolicy:
             for r in cm.module.rules:
                 for node in _walk_rule(r):
                     if isinstance(node, Call) and node.path[:1] in (
-                        ("time",), ("rand",)
+                        ("time",), ("rand",), ("uuid",)
                     ):
                         self.memo_safe = False
                     if isinstance(node, Ref) and isinstance(node.head, Var) and node.head.name == "input":
@@ -247,6 +247,20 @@ class TemplatePolicy:
 
 def _is_frozen(v):
     return v is None or isinstance(v, (bool, int, float, str, tuple, FrozenDict, RSet))
+
+
+def _walk_pairs(path: Tuple[Any, ...], v: Any) -> Iterator[Tuple[Any, Any]]:
+    """Depth-first [path, value] enumeration for the walk builtin."""
+    yield (path, v)
+    if isinstance(v, FrozenDict):
+        for k in v.keys():
+            yield from _walk_pairs(path + (k,), v[k])
+    elif isinstance(v, tuple):
+        for i, item in enumerate(v):
+            yield from _walk_pairs(path + (i,), item)
+    elif isinstance(v, RSet):
+        for item in v:
+            yield from _walk_pairs(path + (item,), item)
 
 
 def _walk_rule(r: Rule):
@@ -762,12 +776,64 @@ class QueryContext:
         try:
             if self._depth > self.MAX_DEPTH:
                 raise RegoEvalError("max evaluation depth exceeded")
+            if t.path == ("walk",):
+                yield from self._eval_walk(cm, t, b)
+                return
+            arity = self._call_arity(cm, t.path)
+            if arity is not None and len(t.args) == arity + 1:
+                # output-argument form: f(in..., out) unifies out with the
+                # result (OPA allows this for every function; topdown
+                # rewrites it to out = f(in...))
+                for argv, b2 in self._eval_product(
+                    cm, t.args[:-1], b, lambda vs: tuple(vs)
+                ):
+                    result = self._dispatch_call(cm, t.path, argv)
+                    if result is not UNDEFINED:
+                        for b3 in self.unify_pattern(cm, t.args[-1], result, b2):
+                            yield True, b3
+                return
             for argv, b2 in self._eval_product(cm, t.args, b, lambda vs: tuple(vs)):
                 result = self._dispatch_call(cm, t.path, argv)
                 if result is not UNDEFINED:
                     yield result, b2
         finally:
             self._depth -= 1
+
+    def _call_arity(self, cm: CompiledModule, path: Tuple[str, ...]) -> Optional[int]:
+        """Declared input arity of a builtin or user function, or None."""
+        if len(path) == 1 and path[0] in cm.rules:
+            for r in cm.rules[path[0]]:
+                if r.is_function:
+                    return len(r.args or ())
+            return None
+        if path[0] == "data" and len(path) > 2 and path[1] == "lib":
+            parts = path[1:]
+            for cut in range(len(parts) - 1, 0, -1):
+                libm = self.policy.libs.get(tuple(parts[:cut]))
+                if libm is not None and parts[cut] in libm.rules:
+                    for r in libm.rules[parts[cut]]:
+                        if r.is_function:
+                            return len(r.args or ())
+                    return None
+            return None
+        fn = bi.lookup(path)
+        if fn is None:
+            return None
+        return fn.__code__.co_argcount
+
+    def _eval_walk(self, cm: CompiledModule, t: Call, b: Bindings) -> Iterator[Tuple[Any, Bindings]]:
+        """`walk` is OPA's only relational builtin: walk(x) enumerates
+        [path, value] pairs over every nested element of x; walk(x, pat)
+        unifies each pair with pat (topdown/walk.go semantics)."""
+        if len(t.args) not in (1, 2):
+            raise RegoEvalError("walk: expects 1 or 2 arguments")
+        for doc, b2 in self.eval_term(cm, t.args[0], b):
+            for pair in _walk_pairs((), doc):
+                if len(t.args) == 1:
+                    yield pair, b2
+                else:
+                    for b3 in self.unify_pattern(cm, t.args[1], pair, b2):
+                        yield True, b3
 
     def _dispatch_call(self, cm: CompiledModule, path: Tuple[str, ...], args: Tuple[Any, ...]) -> Any:
         if len(path) == 1 and path[0] in cm.rules:

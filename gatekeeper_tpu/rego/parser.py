@@ -199,9 +199,12 @@ class Parser:
             self.advance()
             self.skip_nl()
             value = self.parse_term()
-        if not self.at_punct("{"):
-            self.err("'else' requires a body")
-        body = self.parse_body()
+        body: Body = ()
+        if self.at_punct("{"):
+            body = self.parse_body()
+        elif value is None:
+            # OPA grammar: rule-else ::= "else" [ "=" term ] [ "{" query "}" ]
+            self.err("'else' requires a value or a body")
         els = self._parse_else_chain(key)
         return Rule("else", None, None, value, body, loc=loc, els=els)
 

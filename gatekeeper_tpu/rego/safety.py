@@ -51,6 +51,30 @@ def _is_special(name: str, rule_names: FrozenSet[str]) -> bool:
     return name in _GLOBALS or name in rule_names
 
 
+import threading as _threading
+
+_REORDER_TLS = _threading.local()  # per-compile local-function arity map
+
+
+def _is_output_form(t: Call) -> bool:
+    """True when a statement-level call carries an extra output argument
+    (declared arity + 1).  Builtin arities come from the engine registry
+    (function-level import: engine.builtins depends only on engine.value,
+    so no cycle with this package); module-local function arities come
+    from the thread-local map reorder_module installs.  data.lib
+    cross-module calls are unknown here and fall back to source order."""
+    from ..engine.builtins import lookup
+
+    fn = lookup(t.path)
+    if fn is not None:
+        return len(t.args) == fn.__code__.co_argcount + 1
+    if len(t.path) == 1:
+        arity = getattr(_REORDER_TLS, "arities", {}).get(t.path[0])
+        if arity is not None:
+            return len(t.args) == arity + 1
+    return False
+
+
 def _walk(t: Node, pos: str, a: _Analysis, rule_names: FrozenSet[str]):
     """pos: 'pattern' (vars get bound) or 'eval' (vars must be bound)."""
     if isinstance(t, Scalar):
@@ -145,7 +169,15 @@ def _expr_analysis(e: Expr, rule_names: FrozenSet[str]) -> Tuple[Set[str], Set[s
             else:
                 _walk(side, "eval", a, rule_names)
         return a.needs, a.binds
-    _walk(e.terms[0], "eval", a, rule_names)
+    t0 = e.terms[0]
+    if isinstance(t0, Call) and _is_output_form(t0):
+        # statement-level output-argument call: f(in..., out) binds out
+        # (and walk(x, [p, v]) binds p/v — OPA's relational builtin)
+        for arg in t0.args[:-1]:
+            _walk(arg, "eval", a, rule_names)
+        _walk(t0.args[-1], "pattern", a, rule_names)
+        return a.needs, a.binds
+    _walk(t0, "eval", a, rule_names)
     return a.needs, a.binds
 
 
@@ -251,13 +283,19 @@ def _reorder_rule(r: Rule, params: Set[str], rule_names: FrozenSet[str]) -> Rule
 def reorder_module(module: Module) -> Module:
     """Reorder every rule body (and nested comprehension bodies) for safety."""
     rule_names = frozenset(r.name for r in module.rules)
-    new_rules = []
-    for r in module.rules:
-        params: Set[str] = set()
-        if r.args:
-            a = _Analysis()
-            for p in r.args:
-                _walk(p, "pattern", a, rule_names)
-            params = a.binds
-        new_rules.append(_reorder_rule(r, params, rule_names))
+    _REORDER_TLS.arities = {
+        r.name: len(r.args) for r in module.rules if r.args is not None
+    }
+    try:
+        new_rules = []
+        for r in module.rules:
+            params: Set[str] = set()
+            if r.args:
+                a = _Analysis()
+                for p in r.args:
+                    _walk(p, "pattern", a, rule_names)
+                params = a.binds
+            new_rules.append(_reorder_rule(r, params, rule_names))
+    finally:
+        _REORDER_TLS.arities = {}
     return Module(package=module.package, rules=tuple(new_rules), source=module.source)

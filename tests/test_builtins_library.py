@@ -189,3 +189,281 @@ def test_builtin_edge_semantics():
     # parse_bytes accepts bare-fraction forms like OPA's float parse
     assert bi.lookup(("units", "parse_bytes"))(".5Gi") == 2 ** 29
     assert bi.lookup(("units", "parse_bytes"))("5.") == 5
+
+
+from gatekeeper_tpu.engine.builtins import REGISTRY
+from gatekeeper_tpu.engine.interp import TemplatePolicy
+from gatekeeper_tpu.engine.value import FrozenDict, RSet, freeze
+
+
+def _py(v):
+    """Thaw a frozen value, hashing nested arrays as tuples inside sets."""
+    if isinstance(v, FrozenDict):
+        return {k: _py(v[k]) for k in v.keys()}
+    if isinstance(v, tuple):
+        return [_py(x) for x in v]
+    if isinstance(v, RSet):
+        out = set()
+        for x in v:
+            px = _py(x)
+            out.add(tuple(px) if isinstance(px, list) else px)
+        return out
+    return v
+
+
+def run_bi(name, *args):
+    """Call a builtin directly with frozen args, returning a python value."""
+    fn = REGISTRY[tuple(name.split("."))]
+    return _py(fn(*[freeze(a) for a in args]))
+
+
+run_bi_raw = run_bi
+
+
+class TestRegistryCompletion:
+    """OPA v0.21 registry completion: every name in the vendored
+    ast/builtins.go is either implemented, a native infix operator, or an
+    environment-blocked stub with a clear error."""
+
+    def test_full_registry_coverage(self):
+        import re as _re
+        from .corpus import REF
+        src = open(REF / "vendor/github.com/open-policy-agent/opa/ast/builtins.go").read()
+        opa = set(_re.findall(r'Name:\s*"([^"]+)"', src))
+        from gatekeeper_tpu.engine.builtins import REGISTRY
+        ours = {".".join(p) for p in REGISTRY}
+        infix = {"and", "or", "plus", "minus", "mul", "div", "rem",
+                 "eq", "neq", "lt", "lte", "gt", "gte", "equal", "assign"}
+        missing = opa - ours - infix
+        assert not missing, f"missing builtins: {sorted(missing)}"
+
+    def test_encoding(self):
+        assert run_bi("base64url.encode", "a+b/c") == "YStiL2M="
+        assert run_bi("base64url.decode", "YStiL2M") == "a+b/c"
+        assert run_bi("urlquery.encode", "a b&c") == "a+b%26c"
+        assert run_bi("urlquery.decode", "a+b%26c") == "a b&c"
+        assert "a=1" in run_bi("urlquery.encode_object", {"a": "1"})
+        assert run_bi("yaml.unmarshal", "a: 1\n") == {"a": 1}
+        assert run_bi("yaml.marshal", {"a": 1}) == "a: 1\n"
+
+    def test_crypto_digests(self):
+        assert run_bi("crypto.sha256", "abc") == (
+            "ba7816bf8f01cfea414140de5dae2223b00361a396177a9cb410ff61f20015ad")
+        assert run_bi("crypto.md5", "") == "d41d8cd98f00b204e9800998ecf8427e"
+        assert run_bi("crypto.sha1", "") == "da39a3ee5e6b4b0d3255bfef95601890afd80709"
+
+    def test_bits(self):
+        assert run_bi("bits.or", 5, 3) == 7
+        assert run_bi("bits.and", 5, 3) == 1
+        assert run_bi("bits.xor", 5, 3) == 6
+        assert run_bi("bits.negate", 0) == -1
+        assert run_bi("bits.lsh", 1, 4) == 16
+        assert run_bi("bits.rsh", 16, 4) == 1
+
+    def test_object_filter_remove(self):
+        assert run_bi("object.filter", {"a": 1, "b": 2}, ["a"]) == {"a": 1}
+        assert run_bi("object.remove", {"a": 1, "b": 2}, ["a"]) == {"b": 2}
+
+    def test_json_filter_remove(self):
+        doc = {"a": {"b": 1, "c": 2}, "d": 3}
+        assert run_bi("json.filter", doc, ["a/b"]) == {"a": {"b": 1}}
+        assert run_bi("json.remove", doc, ["a/b"]) == {"a": {"c": 2}, "d": 3}
+        assert run_bi("json.filter", doc, [["a", "c"]]) == {"a": {"c": 2}}
+
+    def test_graph_reachable(self):
+        g = {"a": ["b"], "b": ["c"], "c": [], "x": ["y"], "y": []}
+        assert run_bi("graph.reachable", g, ["a"]) == {"a", "b", "c"}
+
+    def test_net(self):
+        assert run_bi("net.cidr_contains", "10.0.0.0/8", "10.1.0.0/16") is True
+        assert run_bi("net.cidr_contains", "10.0.0.0/8", "10.1.2.3") is True
+        assert run_bi("net.cidr_contains", "10.0.0.0/8", "11.0.0.1") is False
+        assert run_bi("net.cidr_intersects", "10.0.0.0/30", "10.0.0.2/31") is True
+        assert run_bi("net.cidr_expand", "10.0.0.0/30") == {
+            "10.0.0.0", "10.0.0.1", "10.0.0.2", "10.0.0.3"}
+        matches = run_bi("net.cidr_contains_matches", ["10.0.0.0/8"], ["10.1.2.3", "8.8.8.8"])
+        assert matches == {(0, 0)}
+
+    def test_time_parsing(self):
+        ns = run_bi("time.parse_rfc3339_ns", "2020-01-02T03:04:05Z")
+        assert ns == 1577934245000000000
+        assert run_bi("time.date", ns) == [2020, 1, 2]
+        assert run_bi("time.clock", ns) == [3, 4, 5]
+        assert run_bi("time.weekday", ns) == "Thursday"
+        assert run_bi("time.parse_duration_ns", "1.5h") == int(1.5 * 3600 * 1e9)
+        assert run_bi("time.parse_duration_ns", "300ms") == 300_000_000
+        assert run_bi("time.parse_ns", "2006-01-02", "2020-01-02") == 1577923200000000000
+        # fractional-second precision survives to the nanosecond
+        assert run_bi("time.parse_rfc3339_ns", "2020-01-02T03:04:05.123456789Z") % 10**9 == 123456789
+
+    def test_time_add_date(self):
+        ns = run_bi("time.parse_rfc3339_ns", "2020-01-31T00:00:00Z")
+        y, m, d = run_bi("time.date", run_bi("time.add_date", ns, 0, 1, 0))
+        # Go normalizes Jan 31 + 1 month = Mar 2 (2020 is a leap year)
+        assert (y, m, d) == (2020, 3, 2)
+
+    def test_regex_extras(self):
+        assert run_bi("regex.find_n", "[0-9]+", "a1b22c333", 2) == ["1", "22"]
+        assert run_bi("regex.find_n", "[0-9]+", "a1b22c333", -1) == ["1", "22", "333"]
+        subs = run_bi("regex.find_all_string_submatch_n", "([a-z])([0-9])", "a1 b2", -1)
+        assert subs == [["a1", "a", "1"], ["b2", "b", "2"]]
+        assert run_bi("regex.template_match", "urn:foo:{.*}", "urn:foo:bar:baz", "{", "}") is True
+        assert run_bi("regex.template_match", "urn:foo:{[0-9]+}", "urn:foo:bar", "{", "}") is False
+        assert run_bi("glob.quote_meta", "*.txt") == "\\*.txt"
+
+    def test_jwt_hmac(self):
+        import base64, hashlib, hmac, json as _json
+        header = base64.urlsafe_b64encode(_json.dumps({"alg": "HS256", "typ": "JWT"}).encode()).rstrip(b"=")
+        payload = base64.urlsafe_b64encode(_json.dumps({"sub": "x"}).encode()).rstrip(b"=")
+        signing = header + b"." + payload
+        sig = base64.urlsafe_b64encode(
+            hmac.new(b"secret", signing, hashlib.sha256).digest()).rstrip(b"=")
+        token = (signing + b"." + sig).decode()
+        assert run_bi("io.jwt.verify_hs256", token, "secret") is True
+        assert run_bi("io.jwt.verify_hs256", token, "wrong") is False
+        hdr, pay, _sig = run_bi("io.jwt.decode", token)
+        assert hdr["alg"] == "HS256" and pay["sub"] == "x"
+
+    def test_casts(self):
+        assert run_bi("cast_string", "x") == "x"
+        assert run_bi("set_diff", {1, 2, 3}, {2}) == {1, 3}
+        with pytest.raises(Exception):
+            run_bi_raw("cast_string", 5)
+
+    def test_blocked_builtins_are_undefined_not_wrong(self):
+        # http.send & friends must fail closed (undefined), never fabricate
+        from gatekeeper_tpu.engine.builtins import REGISTRY, BuiltinError
+        with pytest.raises(BuiltinError):
+            REGISTRY[("http", "send")]({})
+
+    def test_trace_and_runtime(self):
+        assert run_bi("trace", "note") is True
+        rt = run_bi("opa.runtime")
+        assert "version" in rt
+
+    def test_uuid_stable_within_query(self):
+        pol = TemplatePolicy.compile(
+            """
+package p
+
+violation[{"msg": m}] {
+  a := uuid.rfc4122("k")
+  b := uuid.rfc4122("k")
+  a == b
+  m := "stable"
+}
+"""
+        )
+        assert pol.eval_violations({}, {}, {}) == [{"msg": "stable"}]
+        assert pol.memo_safe is False
+
+
+class TestWalkAndOutputArgs:
+    def test_walk_enumerates_nested_paths(self):
+        pol = TemplatePolicy.compile(
+            """
+package p
+
+violation[{"msg": m}] {
+  walk(input.review.object, [path, value])
+  value == "secret"
+  m := concat("/", [format_int(count(path), 10)])
+}
+"""
+        )
+        obj = {"a": {"b": ["x", "secret"]}}
+        out = pol.eval_violations({"object": obj}, {}, {})
+        assert out == [{"msg": "3"}]  # path ["a","b",1] has 3 segments
+
+    def test_walk_finds_all_matching_values(self):
+        pol = TemplatePolicy.compile(
+            """
+package p
+
+paths[path] { walk(input.review, [path, value]); value == 1 }
+
+violation[{"msg": "n"}] { count(paths) == 2 }
+"""
+        )
+        assert pol.eval_violations({"a": 1, "b": {"c": 1}}, {}, {}) == [{"msg": "n"}]
+
+    def test_builtin_output_argument_form(self):
+        pol = TemplatePolicy.compile(
+            """
+package p
+
+violation[{"msg": msg}] {
+  split(input.review.image, ":", parts)
+  count(parts, n)
+  n == 2
+  msg := parts[1]
+}
+"""
+        )
+        assert pol.eval_violations({"image": "nginx:latest"}, {}, {}) == [{"msg": "latest"}]
+        assert pol.eval_violations({"image": "nginx"}, {}, {}) == []
+
+    def test_sprintf_output_argument(self):
+        pol = TemplatePolicy.compile(
+            """
+package p
+
+violation[{"msg": msg}] { sprintf("got %v", [input.review.x], msg) }
+"""
+        )
+        assert pol.eval_violations({"x": 7}, {}, {}) == [{"msg": "got 7"}]
+
+
+class TestPrecisionAndEdgeCases:
+    """Regressions: integer/ns precision and grammar edges found in review."""
+
+    def test_time_builtins_accept_real_ns_timestamps(self):
+        # ints above 2^53 are not exactly float-representable; the
+        # integrality check must not reject them
+        ns = 1577934245123456789
+        assert run_bi("time.date", ns) == [2020, 1, 2]
+        assert run_bi("time.clock", ns) == [3, 4, 5]
+        assert run_bi("bits.or", 2**53 + 1, 0) == 2**53 + 1
+
+    def test_ns_arg_no_second_boundary_rounding(self):
+        # 0.999999744s must not round up into the next second
+        assert run_bi("time.clock", 999999999999999744) == [1, 46, 39]
+
+    def test_parse_duration_exact_and_zero(self):
+        assert run_bi("time.parse_duration_ns", "0") == 0
+        assert run_bi("time.parse_duration_ns",
+                      "2562047h47m16s854ms775us807ns") == 9223372036854775807
+
+    def test_else_without_body(self):
+        # OPA grammar: rule-else ::= "else" [ "=" term ] [ "{" query "}" ]
+        pol = TemplatePolicy.compile(
+            """
+package p
+
+x = 1 { input.review.a } else = 2
+
+violation[{"msg": sprintf("%v", [x])}] { true }
+"""
+        )
+        assert pol.eval_violations({}, {}, {}) == [{"msg": "2"}]
+        assert pol.eval_violations({"a": True}, {}, {}) == [{"msg": "1"}]
+
+    def test_user_function_output_arg_reorders_safely(self):
+        # consumer written before the producing call: safety reorder must
+        # know local-function output arity
+        pol = TemplatePolicy.compile(
+            """
+package p
+
+double(x) = y { y := x * 2 }
+
+violation[{"msg": m}] {
+  double(n, out)
+  out > 3
+  n := input.review.num
+  m := "big"
+}
+"""
+        )
+        assert pol.eval_violations({"num": 5}, {}, {}) == [{"msg": "big"}]
+        assert pol.eval_violations({"num": 1}, {}, {}) == []
