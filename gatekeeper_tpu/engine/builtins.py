@@ -1217,9 +1217,12 @@ def _ns_arg(x: Any, who: str):
     """OPA time builtins take ns or [ns, tz]; only UTC/Local-free math here."""
     import datetime
 
+    tz = None
     if isinstance(x, tuple):
         _need(len(x) >= 1, f"{who}: empty array operand")
         ns = x[0]
+        if len(x) > 1 and x[1] not in ("", "UTC"):
+            tz = x[1]
     else:
         ns = x
     ns = _int_arg(ns, who)
@@ -1228,6 +1231,16 @@ def _ns_arg(x: Any, who: str):
     dt = datetime.datetime(1970, 1, 1, tzinfo=datetime.timezone.utc) + datetime.timedelta(
         microseconds=ns // 1000
     )
+    if tz is not None:
+        # Go LoadLocation semantics via the system tz database; unknown
+        # names fail closed (undefined) rather than silently return UTC
+        _need(isinstance(tz, str), f"{who}: timezone must be a string")
+        import zoneinfo
+
+        try:
+            dt = dt.astimezone(zoneinfo.ZoneInfo(tz))
+        except (zoneinfo.ZoneInfoNotFoundError, ValueError) as e:
+            raise BuiltinError(f"{who}: {e}")
     return ns, dt
 
 

@@ -82,6 +82,7 @@ class TemplatePolicy:
     def __init__(self, main: CompiledModule, libs: Dict[Tuple[str, ...], CompiledModule]):
         self.main = main
         self.libs = libs
+        self._arity_cache: Dict[Tuple[int, Tuple[str, ...]], Optional[int]] = {}
 
     # -- compile ------------------------------------------------------------
 
@@ -243,6 +244,9 @@ class TemplatePolicy:
             return thaw(ctx.partial_set_extent(self.main, name))
         v = ctx.complete_value(self.main, name)
         return thaw(v) if v is not UNDEFINED else UNDEFINED
+
+
+_ARITY_MISS = object()  # cache sentinel: None is a valid cached arity
 
 
 def _is_frozen(v):
@@ -800,7 +804,21 @@ class QueryContext:
             self._depth -= 1
 
     def _call_arity(self, cm: CompiledModule, path: Tuple[str, ...]) -> Optional[int]:
-        """Declared input arity of a builtin or user function, or None."""
+        """Declared input arity of a builtin or user function, or None.
+        Memoized on the policy: the answer is static per (module, path)
+        and this sits on the interpreter's hottest path (every call in
+        every rule body).  Dict get/set are atomic and the value is
+        deterministic, so the shared cache is thread-safe."""
+        cache = self.policy._arity_cache
+        key = (id(cm), path)
+        hit = cache.get(key, _ARITY_MISS)
+        if hit is not _ARITY_MISS:
+            return hit
+        arity = self._call_arity_uncached(cm, path)
+        cache[key] = arity
+        return arity
+
+    def _call_arity_uncached(self, cm: CompiledModule, path: Tuple[str, ...]) -> Optional[int]:
         if len(path) == 1 and path[0] in cm.rules:
             for r in cm.rules[path[0]]:
                 if r.is_function:

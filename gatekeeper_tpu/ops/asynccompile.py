@@ -157,9 +157,13 @@ class AsyncCompiler:
             # read under the lock; _dispatch must not key the device cache
             # on a LATER epoch a concurrent mutation may have created
             cs_key = (d._cs_epoch, d.interner.snapshot_size())
-        # XLA trace + compile OUTSIDE the lock — the whole point
+        # XLA trace + compile OUTSIDE the lock — the whole point.  Warm the
+        # PACKED variant: compute_masks dispatches _packed_variant(fn), so
+        # warming only the unpacked fused fn would leave the first real
+        # review to pay the full synchronous compile anyway.
         out = d._dispatch(
-            fn, rp.arrays, cp.arrays, cols, group_params, rows, cs_key=cs_key
+            d._packed_variant(fn), rp.arrays, cp.arrays, cols, group_params,
+            rows, cs_key=cs_key,
         )
         jax.block_until_ready(out)
         with self._cond:

@@ -77,6 +77,10 @@ class TpuDriver(InterpDriver):
         self.pred_cache: Dict[Tuple[str, str], PredicateTable] = {}
         self._fused = None
         self._fused_key = None
+        # bit-packed output wrapper of the fused fn (review path): one
+        # [2C, ceil(R/8)] uint8 fetch instead of two R-byte bool fetches
+        self._fused_packed = None
+        self._fused_packed_src = None
         # multi-chip: data-parallel mesh over every visible device (None on
         # single-chip).  GK_MESH=0 forces the single-device path; tests pin
         # bit-parity between both settings.
@@ -407,6 +411,26 @@ class TpuDriver(InterpDriver):
             self._cs_device_cache = (key, placed)
         return placed
 
+    def _packed_variant(self, fn):
+        """Wrap the fused fn so mask+autoreject leave the device as ONE
+        bit-packed uint8 array: behind the network relay every fetched
+        array costs an RTT, and packing cuts the payload 8x besides.  The
+        packing runs inside the same jitted dispatch (no separate stack
+        op crossing the relay)."""
+        if self._fused_packed is not None and self._fused_packed_src is fn:
+            return self._fused_packed
+        raw = fn.__wrapped__
+
+        def fused_packed(rv, cs, cols, gp):
+            mask, autoreject = raw(rv, cs, cols, gp)
+            return jnp.packbits(
+                jnp.concatenate([mask, autoreject], axis=0), axis=1
+            )
+
+        self._fused_packed = jax.jit(fused_packed)
+        self._fused_packed_src = fn
+        return self._fused_packed
+
     def compute_masks(self, reviews: List[dict]):
         """-> (ordered constraints, match&violation candidate mask [C, R],
         autoreject mask [C, R]) as numpy arrays.
@@ -417,11 +441,17 @@ class TpuDriver(InterpDriver):
         callers see identical shapes on 1 or N devices."""
         fn, ordered, rp, cp, cols, group_params = self._device_inputs(reviews)
         rows = len(rp.arrays["valid"])
-        mask, autoreject = self._dispatch(
-            fn, rp.arrays, cp.arrays, cols, group_params, rows
+        packed = self._dispatch(
+            self._packed_variant(fn), rp.arrays, cp.arrays, cols,
+            group_params, rows,
         )
-        both = np.asarray(jnp.stack([mask, autoreject]))  # one fetch
-        return ordered, both[0][:, :rows], both[1][:, :rows]
+        both = np.unpackbits(np.asarray(packed), axis=1)
+        c = both.shape[0] // 2
+        return (
+            ordered,
+            both[:c, :rows].astype(bool),
+            both[c:, :rows].astype(bool),
+        )
 
     # ---- render (exactness filter) ---------------------------------------
 
@@ -590,33 +620,80 @@ class TpuDriver(InterpDriver):
         with self._lock:
             ordered, mask, autoreject = self.compute_masks(reviews)
             inventory = self.store.frozen()
-            out = []
-            for ri, review in enumerate(reviews):
-                frozen_review = freeze(review)
-                memo_review = _strip_request_meta(frozen_review)
-                results: List[Result] = []
-                trace: List[str] = [] if tracing else None
-                for i, (kind, name, constraint) in enumerate(ordered):
-                    if autoreject[i, ri]:
-                        if needs_autoreject(constraint, review, self.store.cached_namespace):
-                            results.append(
-                                Result(
-                                    msg="Namespace is not cached in OPA.",
-                                    metadata={"details": {}},
-                                    constraint=constraint,
-                                    review=review,
-                                    enforcement_action=self._enforcement_action(constraint),
-                                )
-                            )
-                            if tracing:
-                                trace.append(f"autoreject {kind}/{name}")
-                    if mask[i, ri]:
-                        self._render_cell(
-                            results, constraint, kind, review, frozen_review,
-                            inventory, trace, memo_review=memo_review,
+            mask_np = np.asarray(mask)
+            rej_np = np.asarray(autoreject)
+            if tracing:
+                return self._review_batch_traced(
+                    reviews, ordered, mask_np, rej_np, inventory
+                )
+            # Sparse render: iterate only (review, constraint) cells the
+            # device marked positive, review-major so per-review result
+            # ordering matches the dense loop.  Reviews with no positive
+            # cell (the common admission case) cost zero host work — in
+            # particular no freeze(), which dominated the dense loop at
+            # 1M-review scale.
+            out: List = [([], None) for _ in reviews]
+            ris, iis = np.nonzero((mask_np | rej_np).T)
+            frozen_cache: Dict[int, tuple] = {}
+            for ri, i in zip(ris.tolist(), iis.tolist()):
+                kind, _name, constraint = ordered[i]
+                review = reviews[ri]
+                results = out[ri][0]
+                if rej_np[i, ri] and needs_autoreject(
+                    constraint, review, self.store.cached_namespace
+                ):
+                    results.append(
+                        Result(
+                            msg="Namespace is not cached in OPA.",
+                            metadata={"details": {}},
+                            constraint=constraint,
+                            review=review,
+                            enforcement_action=self._enforcement_action(constraint),
                         )
-                out.append((results, "\n".join(trace) if tracing else None))
+                    )
+                if mask_np[i, ri]:
+                    fr = frozen_cache.get(ri)
+                    if fr is None:
+                        fz = freeze(review)
+                        fr = (fz, _strip_request_meta(fz))
+                        frozen_cache[ri] = fr
+                    self._render_cell(
+                        results, constraint, kind, review, fr[0],
+                        inventory, None, memo_review=fr[1],
+                    )
             return out
+
+    def _review_batch_traced(self, reviews, ordered, mask_np, rej_np, inventory):
+        """Dense per-cell walk kept for tracing runs: trace lines must name
+        every constraint in order, including non-matching ones."""
+        from ..engine.value import freeze
+
+        out = []
+        for ri, review in enumerate(reviews):
+            frozen_review = freeze(review)
+            memo_review = _strip_request_meta(frozen_review)
+            results: List[Result] = []
+            trace: List[str] = []
+            for i, (kind, name, constraint) in enumerate(ordered):
+                if rej_np[i, ri]:
+                    if needs_autoreject(constraint, review, self.store.cached_namespace):
+                        results.append(
+                            Result(
+                                msg="Namespace is not cached in OPA.",
+                                metadata={"details": {}},
+                                constraint=constraint,
+                                review=review,
+                                enforcement_action=self._enforcement_action(constraint),
+                            )
+                        )
+                        trace.append(f"autoreject {kind}/{name}")
+                if mask_np[i, ri]:
+                    self._render_cell(
+                        results, constraint, kind, review, frozen_review,
+                        inventory, trace, memo_review=memo_review,
+                    )
+            out.append((results, "\n".join(trace)))
+        return out
 
     # Fetched candidate indices per constraint for the capped audit: at
     # least this many, and at least 2x the cap (oversampling absorbs device

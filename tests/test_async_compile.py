@@ -145,3 +145,18 @@ def test_sync_driver_unaffected():
     d = TpuDriver(async_compile=False)
     assert d._compiler is None
     assert d.wait_ready() is True
+
+
+def test_background_warm_covers_packed_review_fn(async_client):
+    """The review path dispatches _packed_variant(fused); the background
+    warm must compile THAT executable, or the first real admission review
+    pays the synchronous XLA compile the feature exists to prevent."""
+    c = async_client
+    driver = c.driver
+    templates, constraints = make_templates(4, seed=3)
+    for t, k in zip(templates, constraints):
+        c.add_template(t)
+        c.add_constraint(k)
+    assert driver.wait_ready(timeout=300.0)
+    assert driver._fused_packed is not None
+    assert driver._fused_packed_src is driver._fused

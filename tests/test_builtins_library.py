@@ -467,3 +467,15 @@ violation[{"msg": m}] {
         )
         assert pol.eval_violations({"num": 5}, {}, {}) == [{"msg": "big"}]
         assert pol.eval_violations({"num": 1}, {}, {}) == []
+
+
+def test_time_builtins_apply_timezone():
+    # OPA's [ns, tz] operand: Go LoadLocation semantics via the system tz
+    # database; unknown names are undefined, never silently UTC
+    ns = run_bi("time.parse_rfc3339_ns", "2020-01-02T03:04:05Z")
+    assert run_bi("time.clock", [ns, "America/New_York"]) == [22, 4, 5]
+    assert run_bi("time.date", [ns, "America/New_York"]) == [2020, 1, 1]
+    assert run_bi("time.clock", [ns, "UTC"]) == [3, 4, 5]
+    from gatekeeper_tpu.engine.builtins import BuiltinError
+    with pytest.raises(BuiltinError):
+        run_bi("time.clock", [ns, "Not/AZone"])
