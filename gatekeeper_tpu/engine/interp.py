@@ -125,6 +125,12 @@ class TemplatePolicy:
                         ("time",), ("rand",), ("uuid",)
                     ):
                         self.memo_safe = False
+                    if isinstance(node, Expr) and node.withs:
+                        # `with` rebinds documents mid-query; rendered-cell
+                        # memoization cannot see through the patches
+                        self.memo_safe = False
+                        if any(p[:2] == ("data", "inventory") for p, _v in node.withs):
+                            self.uses_inventory = True
                     if isinstance(node, Ref) and isinstance(node.head, Var) and node.head.name == "input":
                         ops = node.operands
                         if not ops or not (
@@ -249,6 +255,21 @@ class TemplatePolicy:
 _ARITY_MISS = object()  # cache sentinel: None is a valid cached arity
 
 
+def _upsert_path(doc: Any, segs: Tuple[str, ...], v: Any) -> Any:
+    """Functional deep-set for `with` patches: replaces the value at segs,
+    creating object levels as needed (OPA inserts missing paths into base
+    documents)."""
+    if not segs:
+        return v
+    base = doc if isinstance(doc, FrozenDict) else FrozenDict({})
+    out = {k: base[k] for k in base.keys()}
+    cur = base.get(segs[0], UNDEFINED)
+    out[segs[0]] = _upsert_path(
+        cur if cur is not UNDEFINED else FrozenDict({}), segs[1:], v
+    )
+    return FrozenDict(out)
+
+
 def _is_frozen(v):
     return v is None or isinstance(v, (bool, int, float, str, tuple, FrozenDict, RSet))
 
@@ -285,6 +306,8 @@ def _walk_rule(r: Rule):
         yield n
         if isinstance(n, Expr):
             stack.extend(n.terms)  # type: ignore[arg-type]
+            for _path, v in n.withs:
+                stack.append(v)
         elif isinstance(n, Ref):
             stack.append(n.head)
             stack.extend(n.operands)
@@ -448,7 +471,44 @@ class QueryContext:
         for b2 in self.eval_expr(cm, body[i], b):
             yield from self.eval_body(cm, body, i + 1, b2)
 
+    def _eval_with(self, cm: CompiledModule, e: Expr, b: Bindings) -> Iterator[Bindings]:
+        """`with` modifiers (OPA v0.21 scope: input and base documents; the
+        inventory is this engine's only base document).  Values resolve
+        under the CURRENT context/bindings; the base literal then runs in a
+        child context carrying the patched documents with fresh rule caches
+        (cached rule values may depend on the patched docs).  The query
+        clock is shared — `with` does not start a new query."""
+        base = Expr(e.kind, e.terms, e.loc)
+
+        def go(i, binds, inp, inv):
+            if i == len(e.withs):
+                child = self._child_context(inp, inv)
+                yield from child.eval_expr(cm, base, binds)
+                return
+            path, vterm = e.withs[i]
+            for v, b2 in self.eval_term(cm, vterm, binds):
+                if path[0] == "input":
+                    yield from go(i + 1, b2, _upsert_path(inp, path[1:], v), inv)
+                else:  # ("data", "inventory", ...)
+                    yield from go(i + 1, b2, inp, _upsert_path(inv, path[2:], v))
+
+        yield from go(0, b, self.input, self.inventory)
+
+    def _child_context(self, input_value: Any, inventory: Any) -> "QueryContext":
+        child = QueryContext.__new__(QueryContext)
+        child.policy = self.policy
+        child.input = input_value
+        child.inventory = inventory
+        child._complete = {}
+        child._extent = {}
+        child._func = {}
+        child._depth = self._depth
+        return child
+
     def eval_expr(self, cm: CompiledModule, e: Expr, b: Bindings) -> Iterator[Bindings]:
+        if e.withs:
+            yield from self._eval_with(cm, e, b)
+            return
         if e.kind == "some":
             yield b
             return

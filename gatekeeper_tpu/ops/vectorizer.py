@@ -168,6 +168,9 @@ class Vectorizer:
         """Compile one statement into zero or more conds.  Returns False when
         the statement was dropped (only allowed when not exact_required)."""
         try:
+            if stmt.withs:
+                # document patching is interpreter-only
+                raise _Unsupported()
             if stmt.kind == "some":
                 return True
             if stmt.kind == "not":

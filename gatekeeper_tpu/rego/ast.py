@@ -5,11 +5,12 @@ The grammar covers the policy corpus shipped with the reference
 /root/reference): multi-clause rules, functions (including constant-argument
 clauses), partial set/object rules, array/set/object comprehensions, negation,
 refs with variable operands, infix arithmetic/comparison/set operators, and
-`some` declarations, import aliasing, and `else` clause chains.  `with`
-modifiers are intentionally out of scope: the hook shim and
-constraint-matching library that need them in the reference (vendored
-regolib/src.go, pkg/target/target_template_source.go) are implemented
-natively in gatekeeper_tpu.target / gatekeeper_tpu.client.
+`some` declarations, import aliasing, `else` clause chains, and `with`
+modifiers on input[...] / data.inventory[...] (OPA v0.21 restricts `with`
+to input and base documents; the inventory is this engine's only base
+document — the hook shim and constraint-matching library that use `with`
+in the reference are implemented natively in gatekeeper_tpu.target /
+gatekeeper_tpu.client).
 """
 
 from __future__ import annotations
@@ -115,6 +116,11 @@ class Expr(Node):
     kind: str  # "term" | "unify" | "assign" | "not" | "some"
     terms: Tuple[Node, ...]  # term: (t,); unify/assign: (lhs, rhs); not: (Expr,)
     loc: Tuple[int, int] = (0, 0)
+    # `with` modifiers: ((target path, value term), ...).  Targets are
+    # restricted to input[...] and data.inventory[...] — OPA v0.21 only
+    # supports `with` on input and base documents, and this engine's only
+    # base document is the inventory.
+    withs: Tuple[Tuple[Tuple[str, ...], Node], ...] = ()
 
 
 Body = Tuple[Expr, ...]

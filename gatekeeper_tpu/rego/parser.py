@@ -37,6 +37,7 @@ class Parser:
         self.pos = 0
         self._wild = 0
         self.src = src
+        self.imports: dict = {}  # alias -> full path, filled by parse_module
 
     # ---- token helpers ----------------------------------------------------
 
@@ -109,6 +110,7 @@ class Parser:
                     f"import must not shadow import '{name}'", tok.line, tok.col
                 )
             imports[name] = tuple(path)
+            self.imports = imports  # visible to with-target resolution
             self.skip_nl()
         while not self.at("eof"):
             rules.append(self.parse_rule())
@@ -240,10 +242,42 @@ class Parser:
         if self.at("kw", "not"):
             self.advance()
             inner = self.parse_statement_core(loc)
-            return Expr("not", (inner,), loc)
-        if self.at("kw", "with"):
-            self.err("'with' is not supported by this Rego subset")
-        return self.parse_statement_core(loc)
+            e = Expr("not", (inner,), loc)
+        else:
+            e = self.parse_statement_core(loc)
+        withs = self._parse_with_modifiers()
+        if withs:
+            # `with` scopes the whole literal, including its negation
+            e = Expr(e.kind, e.terms, e.loc, withs=withs)
+        return e
+
+    def _parse_with_modifiers(self):
+        """`<literal> with <target> as <value>`...  Targets: input[...] or
+        data.inventory[...] (OPA v0.21 restricts `with` to input and base
+        documents; the inventory is this engine's only base document)."""
+        withs = []
+        while self.at("kw", "with"):
+            tok = self.cur()
+            self.advance()
+            path = tuple(self.parse_package_path())
+            if path[0] in self.imports:
+                # aliases resolve in with targets too (OPA resolves them
+                # during compile-stage rewriting)
+                path = self.imports[path[0]] + path[1:]
+            if not (
+                path[0] == "input"
+                or (path[0] == "data" and path[1:2] == ("inventory",))
+            ):
+                raise RegoParseError(
+                    "'with' targets must be input[...] or data.inventory[...]",
+                    tok.line,
+                    tok.col,
+                )
+            self.expect("kw", "as")
+            self.skip_nl()
+            value = self.parse_term()
+            withs.append((path, value))
+        return tuple(withs)
 
     def parse_statement_core(self, loc) -> Expr:
         lhs = self.parse_term()
@@ -257,8 +291,6 @@ class Parser:
             self.skip_nl()
             rhs = self.parse_term()
             return Expr("assign", (lhs, rhs), loc)
-        if self.at("kw", "with"):
-            self.err("'with' is not supported by this Rego subset")
         return Expr("term", (lhs,), loc)
 
     # ---- terms (precedence climbing) --------------------------------------
@@ -510,6 +542,8 @@ def _check_import_shadowing(rules, imp: dict):
                 )
             for t in e.terms:
                 check_term(t, e.loc)
+            for _p, v in e.withs:
+                check_term(v, e.loc)
 
     def check_term(t: Node, loc):
         if isinstance(t, (ArrayCompr, SetCompr)):
@@ -604,11 +638,12 @@ def _rewrite_rule_imports(rule: Rule, imp: dict) -> Rule:
         return node
 
     def rw_expr(e: Expr) -> Expr:
+        withs = tuple((p, rw(v)) for p, v in e.withs)
         if e.kind == "some":  # declarations, not references
             return e
         if e.kind == "not":
-            return Expr("not", (rw_expr(e.terms[0]),), e.loc)  # type: ignore[arg-type]
-        return Expr(e.kind, tuple(rw(t) for t in e.terms), e.loc)
+            return Expr("not", (rw_expr(e.terms[0]),), e.loc, withs=withs)  # type: ignore[arg-type]
+        return Expr(e.kind, tuple(rw(t) for t in e.terms), e.loc, withs=withs)
 
     def rw_body(body: Body) -> Body:
         return tuple(rw_expr(e) for e in body)

@@ -146,6 +146,14 @@ def _all_vars(t: Node, rule_names: FrozenSet[str], out: Set[str]):
 
 def _expr_analysis(e: Expr, rule_names: FrozenSet[str]) -> Tuple[Set[str], Set[str]]:
     a = _Analysis()
+    if e.withs:
+        # with-values must be bound before the modified literal runs
+        wa = _Analysis()
+        for _path, v in e.withs:
+            _walk(v, "eval", wa, rule_names)
+        base = Expr(e.kind, e.terms, e.loc)
+        n, b = _expr_analysis(base, rule_names)
+        return n | wa.needs, b
     if e.kind == "some":
         return set(), set()
     if e.kind == "not":
@@ -257,12 +265,20 @@ def _transform_term(t: Node, rule_names: FrozenSet[str]) -> Node:
 
 
 def _transform_expr(e: Expr, rule_names: FrozenSet[str]) -> Expr:
+    withs = tuple(
+        (p, _transform_term(v, rule_names)) for p, v in e.withs
+    )
     if e.kind == "not":
-        return Expr("not", (_transform_expr(e.terms[0], rule_names),), e.loc)
+        return Expr(
+            "not", (_transform_expr(e.terms[0], rule_names),), e.loc, withs=withs
+        )
     if e.kind == "some":
         return e
     return Expr(
-        e.kind, tuple(_transform_term(t, rule_names) for t in e.terms), e.loc
+        e.kind,
+        tuple(_transform_term(t, rule_names) for t in e.terms),
+        e.loc,
+        withs=withs,
     )
 
 

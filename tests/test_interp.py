@@ -402,3 +402,134 @@ violation[{"msg": x}] { true }
 """
         )
         assert pol.eval_violations({}, {}, {})[0]["msg"] == "dflt"
+
+
+class TestWithModifiers:
+    """`with` modifiers, OPA v0.21 scope: input[...] and base documents
+    (data.inventory[...] here).  Values bind in the outer context; the
+    modified literal evaluates under patched documents with rule caches
+    isolated."""
+
+    def _pol(self, rego):
+        return TemplatePolicy.compile(rego)
+
+    def test_with_whole_input(self):
+        pol = self._pol(
+            """
+package p
+
+flagged { input.review.object.bad == true }
+
+violation[{"msg": "synthetic"}] {
+  flagged with input as {"review": {"object": {"bad": true}}}
+}
+
+violation[{"msg": "real"}] { flagged }
+"""
+        )
+        # real input is clean; only the with-patched evaluation fires
+        assert pol.eval_violations({"object": {"bad": False}}, {}, {}) == [
+            {"msg": "synthetic"}
+        ]
+
+    def test_with_input_path_override_and_insert(self):
+        pol = self._pol(
+            """
+package p
+
+violation[{"msg": m}] {
+  x := input.review.object.replicas with input.review.object.replicas as 9
+  y := input.review.extra with input.review.extra as "new"
+  m := sprintf("%v/%v", [x, y])
+}
+"""
+        )
+        out = pol.eval_violations({"object": {"replicas": 2}}, {}, {})
+        assert out == [{"msg": "9/new"}]
+
+    def test_with_scopes_only_the_literal(self):
+        pol = self._pol(
+            """
+package p
+
+violation[{"msg": m}] {
+  a := input.review.n with input.review.n as 7
+  b := input.review.n
+  m := sprintf("%v:%v", [a, b])
+}
+"""
+        )
+        assert pol.eval_violations({"n": 1}, {}, {}) == [{"msg": "7:1"}]
+
+    def test_with_applies_to_negation(self):
+        pol = self._pol(
+            """
+package p
+
+present { input.review.flag }
+
+violation[{"msg": "gone"}] {
+  not present with input.review as {}
+}
+"""
+        )
+        assert pol.eval_violations({"flag": True}, {}, {}) == [{"msg": "gone"}]
+
+    def test_with_data_inventory(self):
+        pol = self._pol(
+            """
+package p
+
+count_ns = n { n := count(data.inventory.cluster["v1"]["Namespace"]) }
+
+violation[{"msg": m}] {
+  real := count_ns
+  mocked := count_ns with data.inventory.cluster as {"v1": {"Namespace": {"a": {}, "b": {}}}}
+  m := sprintf("%v->%v", [real, mocked])
+}
+"""
+        )
+        inv = {"cluster": {"v1": {"Namespace": {"x": {}}}}}
+        assert pol.eval_violations({}, {}, inv) == [{"msg": "1->2"}]
+
+    def test_with_value_binds_in_outer_context(self):
+        pol = self._pol(
+            """
+package p
+
+violation[{"msg": m}] {
+  v := input.review.seed
+  m := input.review.out with input.review.out as v
+}
+"""
+        )
+        assert pol.eval_violations({"seed": "s1"}, {}, {}) == [{"msg": "s1"}]
+
+    def test_with_disallowed_target_rejected(self):
+        from gatekeeper_tpu.rego import RegoError
+        with pytest.raises(RegoError):
+            self._pol("package p\n\nviolation[{\"msg\": \"x\"}] { true with data.lib.q as 1 }\n")
+
+    def test_with_policy_not_memo_safe(self):
+        pol = self._pol(
+            """
+package p
+
+violation[{"msg": "x"}] { input.review.a with input.review.a as true }
+"""
+        )
+        assert pol.memo_safe is False
+
+    def test_with_target_through_input_alias(self):
+        # OPA resolves import aliases in with targets during rewriting
+        pol = self._pol(
+            """
+package p
+import input.review as rev
+
+violation[{"msg": m}] {
+  m := rev.tag with rev.tag as "mocked"
+}
+"""
+        )
+        assert pol.eval_violations({"tag": "real"}, {}, {}) == [{"msg": "mocked"}]
