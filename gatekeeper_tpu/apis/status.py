@@ -80,8 +80,39 @@ def key_for_template(pod_id: str, template_name: str) -> str:
     return dash_pack(pod_id, template_name)
 
 
+# Pod ownership of status CRs: when enabled (default) and the owning Pod
+# is known, status resources carry an ownerReference to it so they are
+# garbage-collected with the pod (constraintpodstatus_types.go:104-108).
+# --debug-use-fake-pod disables it to run outside Kubernetes
+# (reference apis/status/v1beta1/util.go DisablePodOwnership).
+_POD_OWNERSHIP = True
+
+
+def disable_pod_ownership():
+    global _POD_OWNERSHIP
+    _POD_OWNERSHIP = False
+
+
+def pod_ownership_enabled() -> bool:
+    return _POD_OWNERSHIP
+
+
+def _maybe_own(meta: dict, owner_pod) -> dict:
+    if _POD_OWNERSHIP and owner_pod:
+        meta["ownerReferences"] = [
+            {
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "name": (owner_pod.get("metadata") or {}).get("name", ""),
+                "uid": (owner_pod.get("metadata") or {}).get("uid", ""),
+            }
+        ]
+    return meta
+
+
 def new_constraint_status_for_pod(
-    pod_id: str, namespace: str, constraint: dict, operations: List[str]
+    pod_id: str, namespace: str, constraint: dict, operations: List[str],
+    owner_pod: dict = None,
 ) -> dict:
     """NewConstraintStatusForPod (constraintpodstatus_types.go:86-111) as an
     unstructured dict ready for the in-memory API."""
@@ -91,7 +122,7 @@ def new_constraint_status_for_pod(
     return {
         "apiVersion": f"{STATUS_GROUP}/{STATUS_VERSION}",
         "kind": "ConstraintPodStatus",
-        "metadata": {
+        "metadata": _maybe_own({
             "name": key_for_constraint(pod_id, constraint),
             "namespace": namespace,
             "labels": {
@@ -100,7 +131,7 @@ def new_constraint_status_for_pod(
                 POD_LABEL: pod_id,
                 TEMPLATE_NAME_LABEL: kind.lower(),
             },
-        },
+        }, owner_pod),
         "status": {
             "id": pod_id,
             "constraintUID": uid,
@@ -113,7 +144,8 @@ def new_constraint_status_for_pod(
 
 
 def new_template_status_for_pod(
-    pod_id: str, namespace: str, template: dict, operations: List[str]
+    pod_id: str, namespace: str, template: dict, operations: List[str],
+    owner_pod: dict = None,
 ) -> dict:
     """NewConstraintTemplateStatusForPod as an unstructured dict."""
     name = (template.get("metadata") or {}).get("name") or ""
@@ -121,14 +153,14 @@ def new_template_status_for_pod(
     return {
         "apiVersion": f"{STATUS_GROUP}/{STATUS_VERSION}",
         "kind": "ConstraintTemplatePodStatus",
-        "metadata": {
+        "metadata": _maybe_own({
             "name": key_for_template(pod_id, name),
             "namespace": namespace,
             "labels": {
                 TEMPLATE_NAME_LABEL: name,
                 POD_LABEL: pod_id,
             },
-        },
+        }, owner_pod),
         "status": {
             "id": pod_id,
             "templateUID": uid,

@@ -62,6 +62,7 @@ class ConstraintController(Controller):
         namespace: str = "gatekeeper-system",
         operations=None,
         reporter=None,
+        get_pod=None,
     ):
         super().__init__(switch)
         self.kube = kube
@@ -72,6 +73,7 @@ class ConstraintController(Controller):
         self.operations = operations
         self.cache = ConstraintsCache()
         self.reporter = reporter
+        self.get_pod = get_pod
 
     def reconcile(self, gvk: GVK, event: WatchEvent):
         constraint = event.object
@@ -95,6 +97,7 @@ class ConstraintController(Controller):
         status = status_api.new_constraint_status_for_pod(
             self.pod_id, self.namespace, constraint,
             self.operations.assigned_string_list() if self.operations else [],
+            owner_pod=self.get_pod() if self.get_pod else None,
         )
         try:
             self.client.add_constraint(constraint)

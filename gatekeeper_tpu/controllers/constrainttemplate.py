@@ -42,6 +42,7 @@ class ConstraintTemplateController(Controller):
         namespace: str = "gatekeeper-system",
         operations=None,
         reporter=None,
+        get_pod=None,
     ):
         super().__init__(switch)
         self.kube = kube
@@ -52,6 +53,7 @@ class ConstraintTemplateController(Controller):
         self.namespace = namespace
         self.operations = operations
         self.reporter = reporter
+        self.get_pod = get_pod
 
     # ---- reconcile --------------------------------------------------------
 
@@ -74,6 +76,7 @@ class ConstraintTemplateController(Controller):
         status = status_api.new_template_status_for_pod(
             self.pod_id, self.namespace, template,
             self.operations.assigned_string_list() if self.operations else [],
+            owner_pod=self.get_pod() if self.get_pod else None,
         )
         kind = self._constraint_kind(template)
         try:

@@ -39,6 +39,27 @@ class Dependencies:
     pod_id: str = "pod-local"
     namespace: str = "gatekeeper-system"
     reporter: object = None
+    # () -> Pod dict or None; status CRs owner-reference this pod so they
+    # are GC'd with it.  None selects the default lazy fetch
+    # (controller.go:78-118 defaultPodGetter: no watch, cached once found).
+    get_pod: object = None
+
+
+def default_pod_getter(kube, pod_id: str, namespace: str):
+    """Lazy, cached fetch of the owning Pod without creating a watch."""
+    cache: list = []
+
+    def get():
+        if cache:
+            return cache[0]
+        try:
+            pod = kube.get(("", "v1", "Pod"), pod_id, namespace)
+        except Exception:
+            return None
+        cache.append(pod)
+        return pod
+
+    return get
 
 
 class Manager:
@@ -66,10 +87,14 @@ class Manager:
         )
         self.sync.registrar = sync_reg
 
+        get_pod = deps.get_pod or default_pod_getter(
+            deps.kube, deps.pod_id, deps.namespace
+        )
         self.constraint = ConstraintController(
             deps.kube, deps.client, deps.tracker, self.switch,
             pod_id=deps.pod_id, namespace=deps.namespace,
             operations=self.operations, reporter=deps.reporter,
+            get_pod=get_pod,
         )
         self.constraint.registrar = constraint_reg
 
@@ -77,6 +102,7 @@ class Manager:
             deps.kube, deps.client, constraint_reg, deps.tracker, self.switch,
             pod_id=deps.pod_id, namespace=deps.namespace,
             operations=self.operations, reporter=deps.reporter,
+            get_pod=get_pod,
         )
         self.template.registrar = template_reg
 

@@ -34,10 +34,26 @@ RESOURCE_NAME = "resource_name"
 REQUEST_USERNAME = "request_username"
 
 
+# level encoders, matching zapcore's set (reference main.go:74-79)
+_ANSI = {"debug": "\x1b[35m", "info": "\x1b[34m", "warning": "\x1b[33m",
+         "error": "\x1b[31m", "critical": "\x1b[31m"}
+LEVEL_ENCODERS = {
+    "lower": lambda lv: lv.lower(),
+    "capital": lambda lv: lv.upper(),
+    "color": lambda lv: f"{_ANSI.get(lv.lower(), '')}{lv.lower()}\x1b[0m",
+    "capitalcolor": lambda lv: f"{_ANSI.get(lv.lower(), '')}{lv.upper()}\x1b[0m",
+}
+
+
 class JsonFormatter(logging.Formatter):
+    def __init__(self, level_key: str = "level", level_encoder: str = "lower"):
+        super().__init__()
+        self.level_key = level_key
+        self.level_encoder = LEVEL_ENCODERS[level_encoder]
+
     def format(self, record: logging.LogRecord) -> str:
         out = {
-            "level": record.levelname.lower(),
+            self.level_key: self.level_encoder(record.levelname),
             "ts": time.time(),
             "logger": record.name,
             "msg": record.getMessage(),
@@ -50,13 +66,21 @@ class JsonFormatter(logging.Formatter):
         return json.dumps(out, default=str)
 
 
-def setup(level: str = "INFO", stream=None) -> logging.Logger:
-    """Process-wide JSON logger (the reference's zap setup, main.go:121-136)."""
+def setup(
+    level: str = "INFO",
+    stream=None,
+    level_key: str = "level",
+    level_encoder: str = "lower",
+) -> logging.Logger:
+    """Process-wide JSON logger (the reference's zap setup, main.go:121-136;
+    --log-level-key / --log-level-encoder mirror main.go:84-85)."""
+    if level_encoder not in LEVEL_ENCODERS:
+        raise ValueError(f"invalid log level encoder: {level_encoder}")
     root = logging.getLogger("gatekeeper")
     root.setLevel(level.upper())
     if not root.handlers:
         h = logging.StreamHandler(stream or sys.stderr)
-        h.setFormatter(JsonFormatter())
+        h.setFormatter(JsonFormatter(level_key, level_encoder))
         root.addHandler(h)
         root.propagate = False
     return root

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import threading
 import time
@@ -27,6 +28,7 @@ from typing import List, Optional
 
 from . import logging as gklog
 from . import operations as ops_mod
+from .apis import status as status_api
 from .audit import AuditManager
 from .certs import CertRotator
 from .client.client import Client
@@ -77,6 +79,18 @@ def build_parser() -> argparse.ArgumentParser:
     # metrics exporter.go:14-15
     p.add_argument("--metrics-backend", default="Prometheus")
     p.add_argument("--prometheus-port", type=int, default=8888)
+    # main.go:84-87
+    p.add_argument("--log-level-key", default="level",
+                   help="JSON key for the log level field")
+    p.add_argument("--log-level-encoder", default="lower",
+                   choices=["lower", "capital", "color", "capitalcolor"])
+    p.add_argument("--metrics-addr", default="0",
+                   help="additional address to serve the metrics endpoint "
+                        "on ('0' disables; main.go:87)")
+    # controller.go:40
+    p.add_argument("--debug-use-fake-pod", action="store_true",
+                   help="use a fake pod identity so the process can run "
+                        "outside of Kubernetes")
     # webhook policy.go:74-76, namespacelabel.go:25
     p.add_argument("--log-denies", action="store_true")
     p.add_argument("--emit-admission-events", action="store_true")
@@ -267,7 +281,16 @@ class App:
         if args is None or isinstance(args, list):
             args = build_parser().parse_args(args or [])
         self.args = args
-        gklog.setup(args.log_level)
+        gklog.setup(
+            args.log_level,
+            level_key=getattr(args, "log_level_key", "level"),
+            level_encoder=getattr(args, "log_level_encoder", "lower"),
+        )
+        if getattr(args, "debug_use_fake_pod", False):
+            # run outside Kubernetes: fixed pod identity, no owner refs on
+            # status CRs (controller.go:133-142)
+            os.environ["POD_NAME"] = "no-pod"
+            status_api.disable_pod_ownership()
         self.kube = kube if kube is not None else make_kube(
             getattr(args, "api_server", "inmem"))
         self.operations = ops_mod.Operations(args.operation or None)
@@ -407,6 +430,24 @@ class App:
             port=args.prometheus_port, registry=self.reporters.registry
         )
         self.metrics_exporter.start()
+        # --metrics-addr (main.go:87): an additional bind for the same
+        # registry, matching the reference's controller-runtime endpoint
+        self.metrics_addr_exporter = None
+        addr = getattr(args, "metrics_addr", "0")
+        if addr and addr != "0":
+            host, _, port_s = addr.rpartition(":")
+            try:
+                port = int(port_s or 0)
+            except ValueError:
+                raise SystemExit(
+                    f"--metrics-addr: invalid port in {addr!r} "
+                    "(expected [host]:port)"
+                )
+            self.metrics_addr_exporter = MetricsExporter(
+                port=port, registry=self.reporters.registry,
+                host=host.strip("[]") or "0.0.0.0",  # bracketed IPv6
+            )
+            self.metrics_addr_exporter.start()
         if args.enable_pprof:
             self.profile_server = ProfileServer(args.pprof_port)
             self.profile_server.start()
@@ -429,6 +470,7 @@ class App:
             self.webhook_server,
             self.health_server,
             self.metrics_exporter,
+            self.metrics_addr_exporter,
             self.micro_batcher,
             self.rotator,
             self.profile_server,

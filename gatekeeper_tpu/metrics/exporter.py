@@ -98,15 +98,21 @@ class _Handler(BaseHTTPRequestHandler):
 class MetricsExporter:
     """Background Prometheus endpoint (reference runner in exporter.go:40-57)."""
 
-    def __init__(self, port: int = 8888, registry: Optional[Registry] = None):
+    def __init__(
+        self,
+        port: int = 8888,
+        registry: Optional[Registry] = None,
+        host: str = "0.0.0.0",
+    ):
         self.port = port
+        self.host = host
         self.registry = registry or global_registry()
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
     def start(self):
         handler = type("Handler", (_Handler,), {"registry": self.registry})
-        self._server = ThreadingHTTPServer(("0.0.0.0", self.port), handler)
+        self._server = ThreadingHTTPServer((self.host, self.port), handler)
         self.port = self._server.server_address[1]  # resolve port 0
         self._thread = threading.Thread(
             target=self._server.serve_forever, name="metrics", daemon=True

@@ -375,6 +375,8 @@ class Vectorizer:
         key_source = None
         param_source = None
         excludes: List[str] = []
+        if any(stmt.withs for stmt in t.body):
+            return SUnknown()  # document patching is interpreter-only
         for stmt in t.body:
             if stmt.kind == "term" and isinstance(stmt.terms[0], Ref):
                 ref = stmt.terms[0]
@@ -465,6 +467,8 @@ class Vectorizer:
         param_path = None
         param_var = None
         pred_node = None
+        if any(stmt.withs for stmt in t.body):
+            return SUnknown()  # document patching is interpreter-only
         for stmt in t.body:
             if stmt.kind not in ("assign", "unify"):
                 return SUnknown()

@@ -288,3 +288,56 @@ class TestJaxProfileServer:
             probe.close()
         finally:
             app.stop()
+
+
+class TestFlagParityAdditions:
+    """main.go:84-87 + controller.go:40 flags added for full surface parity."""
+
+    def test_log_level_encoders(self):
+        from gatekeeper_tpu.logging import LEVEL_ENCODERS
+        assert LEVEL_ENCODERS["lower"]("INFO") == "info"
+        assert LEVEL_ENCODERS["capital"]("info") == "INFO"
+        assert "\x1b[" in LEVEL_ENCODERS["color"]("ERROR")
+        assert "ERROR" in LEVEL_ENCODERS["capitalcolor"]("error").upper()
+
+    def test_parser_accepts_new_flags(self):
+        from gatekeeper_tpu.main import build_parser
+        args = build_parser().parse_args([
+            "--log-level-key", "severity", "--log-level-encoder", "capital",
+            "--metrics-addr", ":0", "--debug-use-fake-pod",
+        ])
+        assert args.log_level_key == "severity"
+        assert args.debug_use_fake_pod is True
+
+    def test_debug_use_fake_pod_disables_ownership(self, monkeypatch):
+        import os
+        from gatekeeper_tpu.apis import status as status_api
+        from gatekeeper_tpu.main import App
+        monkeypatch.setattr(status_api, "_POD_OWNERSHIP", True)
+        # App writes POD_NAME directly; register restoration so later tests
+        # don't inherit the fake pod identity
+        monkeypatch.setitem(os.environ, "POD_NAME", os.environ.get("POD_NAME", ""))
+        app = App(["--debug-use-fake-pod", "--api-server", "inmem",
+                   "--driver", "interp"])
+        assert os.environ.get("POD_NAME") == "no-pod"
+        assert status_api.pod_ownership_enabled() is False
+
+    def test_status_crs_owner_reference_the_pod(self, monkeypatch):
+        from gatekeeper_tpu.apis import status as status_api
+        monkeypatch.setattr(status_api, "_POD_OWNERSHIP", True)
+        pod = {"metadata": {"name": "gk-pod-1", "uid": "u-123"}}
+        st = status_api.new_constraint_status_for_pod(
+            "gk-pod-1", "gatekeeper-system",
+            {"kind": "K8sFoo", "metadata": {"name": "c1"}}, ["audit"],
+            owner_pod=pod,
+        )
+        refs = st["metadata"]["ownerReferences"]
+        assert refs == [{"apiVersion": "v1", "kind": "Pod",
+                         "name": "gk-pod-1", "uid": "u-123"}]
+        # ownership disabled -> no owner refs (DisablePodOwnership analogue)
+        monkeypatch.setattr(status_api, "_POD_OWNERSHIP", False)
+        st2 = status_api.new_template_status_for_pod(
+            "gk-pod-1", "gatekeeper-system",
+            {"metadata": {"name": "t1"}}, ["audit"], owner_pod=pod,
+        )
+        assert "ownerReferences" not in st2["metadata"]

@@ -533,3 +533,30 @@ violation[{"msg": m}] {
 """
         )
         assert pol.eval_violations({"tag": "real"}, {}, {}) == [{"msg": "mocked"}]
+
+    def test_with_inside_comprehension_not_vectorized_exact(self):
+        # a with-modifier inside a comprehension body must disable the
+        # exact vectorized path (the patch is interpreter-only)
+        rego = """
+package p
+
+violation[{"msg": "missing"}] {
+  provided := {l | input.review.object.metadata.labels[l] with input.review.object.metadata.labels as {"mock": "1"}}
+  required := {l | l := input.parameters.labels[_]}
+  missing := required - provided
+  count(missing) > 0
+}
+"""
+        pol = TemplatePolicy.compile(rego)
+        from gatekeeper_tpu.ops.vectorizer import Vectorizer
+        prog = Vectorizer(pol).compile()
+        assert prog is None or not prog.exact
+        # and the interpreter applies the patch: "mock" is provided
+        assert pol.eval_violations(
+            {"object": {"metadata": {"labels": {}}}},
+            {"labels": ["mock"]}, {},
+        ) == []
+        assert pol.eval_violations(
+            {"object": {"metadata": {"labels": {}}}},
+            {"labels": ["other"]}, {},
+        ) == [{"msg": "missing"}]
