@@ -402,6 +402,10 @@ class AuditManager:
                    gklog.AUDIT_ID: timestamp},
             )
             if self.emit_audit_events and self.event_recorder:
+                capi = r.constraint.get("apiVersion", "")
+                cgroup, _, cversion = capi.rpartition("/")
+                rapi = resource.get("apiVersion", "")
+                rgroup, _, rversion = rapi.rpartition("/")
                 self.event_recorder({
                     "reason": "AuditViolation",
                     "type": "Warning",
@@ -410,6 +414,23 @@ class AuditManager:
                         f"{rmeta.get('namespace', '')}, Constraint: "
                         f"{cmeta.get('name', '')}, Message: {r.msg}"
                     ),
+                    # annotation set of manager.go:755-770 emitEvent
+                    "annotations": {
+                        "process": "audit",
+                        "auditTimestamp": timestamp,
+                        gklog.EVENT_TYPE: "violation_audited",
+                        gklog.CONSTRAINT_GROUP: cgroup,
+                        gklog.CONSTRAINT_API_VERSION: cversion,
+                        gklog.CONSTRAINT_KIND: r.constraint.get("kind", ""),
+                        gklog.CONSTRAINT_NAME: cmeta.get("name", ""),
+                        gklog.CONSTRAINT_NAMESPACE: cmeta.get("namespace", ""),
+                        gklog.CONSTRAINT_ACTION: action,
+                        gklog.RESOURCE_GROUP: rgroup,
+                        gklog.RESOURCE_API_VERSION: rversion,
+                        gklog.RESOURCE_KIND: resource.get("kind", ""),
+                        gklog.RESOURCE_NAMESPACE: rmeta.get("namespace", ""),
+                        gklog.RESOURCE_NAME: rmeta.get("name", ""),
+                    },
                     "namespace": self.gk_namespace,
                 })
 

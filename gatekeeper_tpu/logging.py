@@ -83,6 +83,11 @@ def setup(
         h.setFormatter(JsonFormatter(level_key, level_encoder))
         root.addHandler(h)
         root.propagate = False
+    else:
+        # re-setup (second App in one process, flag-configured key/encoder
+        # after a default setup): apply the new format to the existing
+        # handler instead of silently keeping the old one
+        root.handlers[0].setFormatter(JsonFormatter(level_key, level_encoder))
     return root
 
 

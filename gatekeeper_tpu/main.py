@@ -333,6 +333,7 @@ class App:
         self.health_server: Optional[HealthServer] = None
         self.audit_manager: Optional[AuditManager] = None
         self.metrics_exporter: Optional[MetricsExporter] = None
+        self.metrics_addr_exporter: Optional[MetricsExporter] = None
         self.micro_batcher: Optional[MicroBatcher] = None
         self.profile_server: Optional[ProfileServer] = None
 
@@ -432,12 +433,11 @@ class App:
         self.metrics_exporter.start()
         # --metrics-addr (main.go:87): an additional bind for the same
         # registry, matching the reference's controller-runtime endpoint
-        self.metrics_addr_exporter = None
         addr = getattr(args, "metrics_addr", "0")
         if addr and addr != "0":
             host, _, port_s = addr.rpartition(":")
             try:
-                port = int(port_s or 0)
+                port = int(port_s)
             except ValueError:
                 raise SystemExit(
                     f"--metrics-addr: invalid port in {addr!r} "

@@ -341,3 +341,38 @@ class TestFlagParityAdditions:
             {"metadata": {"name": "t1"}}, ["audit"], owner_pod=pod,
         )
         assert "ownerReferences" not in st2["metadata"]
+
+    def test_metrics_addr_rejects_malformed(self):
+        from gatekeeper_tpu.main import App
+        import pytest as _pytest
+        for bad in ("localhost", "127.0.0.1:", ":", "localhost:http"):
+            with _pytest.raises(SystemExit):
+                app = App(["--api-server", "inmem", "--driver", "interp",
+                           "--metrics-addr", bad, "--prometheus-port", "0",
+                           "--port", "0", "--health-addr", ":0",
+                           "--disable-cert-rotation"])
+                app.start()
+                app.stop()
+
+    def test_stop_safe_after_failed_start(self):
+        # a start() that dies before metrics-addr binding must still allow
+        # cleanup via stop() without AttributeError
+        from gatekeeper_tpu.main import App
+        app = App(["--api-server", "inmem", "--driver", "interp"])
+        app.stop()  # never started: every component is None
+
+    def test_logging_resetup_applies_new_format(self):
+        import io, json, logging
+        from gatekeeper_tpu import logging as gklog
+        root = logging.getLogger("gatekeeper")
+        saved = root.handlers[:]
+        try:
+            root.handlers = []
+            buf = io.StringIO()
+            gklog.setup("INFO", stream=buf)
+            gklog.setup("INFO", level_key="severity", level_encoder="capital")
+            gklog.get("t").info("x")
+            line = json.loads(buf.getvalue())
+            assert line["severity"] == "INFO"
+        finally:
+            root.handlers = saved

@@ -1,0 +1,319 @@
+"""bats-parity e2e lane: the reference's in-cluster battery
+(test/bats/test.bats, 17 @test cases) replayed against the full App with
+the reference's own bats fixtures (test/bats/tests/).  kind+kubectl are
+replaced by the in-memory API store; "kubectl apply" is modeled as
+webhook review -> create-if-allowed, which is exactly what the apiserver
+does with the validating webhook registered.
+
+Tests run in definition order and share one App, mirroring the bats
+file's stateful flow (the dryrun switch feeds the audit and event
+cases)."""
+
+import json
+import ssl
+import time
+import urllib.request
+
+import pytest
+import yaml
+
+from gatekeeper_tpu.kube.inmem import InMemoryKube
+from gatekeeper_tpu.main import App, build_parser
+
+BATS = "/root/reference/test/bats/tests"
+
+RL_GVK = ("constraints.gatekeeper.sh", "v1beta1", "K8sRequiredLabels")
+EVENTS_GVK = ("", "v1", "Event")
+
+
+def load(relpath):
+    with open(f"{BATS}/{relpath}") as fh:
+        return yaml.safe_load(fh)
+
+
+def admission_request(obj, operation="CREATE", namespace=None, old=None):
+    api = obj.get("apiVersion", "v1")
+    group, _, version = api.rpartition("/")
+    req = {
+        "uid": "e2e",
+        "kind": {"group": group, "version": version, "kind": obj.get("kind", "")},
+        "name": (obj.get("metadata") or {}).get("name", ""),
+        "operation": operation,
+        "object": obj,
+        "userInfo": {"username": "bats"},
+    }
+    ns = namespace or (obj.get("metadata") or {}).get("namespace")
+    if ns:
+        req["namespace"] = ns
+    if old is not None:
+        req["oldObject"] = old
+    return req
+
+
+@pytest.fixture(scope="class")
+def cluster():
+    kube = InMemoryKube()
+    # the namespaces a kind cluster starts with (the audit counts them)
+    for ns in ("default", "kube-system", "kube-public", "kube-node-lease",
+               "gatekeeper-system"):
+        kube.create({"apiVersion": "v1", "kind": "Namespace",
+                     "metadata": {"name": ns}})
+    app = App(build_parser().parse_args([
+        "--driver", "interp",
+        "--port", "0", "--prometheus-port", "0", "--health-addr", ":0",
+        "--audit-interval", "0.2",
+        "--cert-dir", "/tmp/gk-bats-certs",
+        "--exempt-namespace", "gatekeeper-system",
+        "--emit-admission-events", "--emit-audit-events",
+        "--log-denies",
+    ]), kube=kube)
+    app.start()
+    state = {"app": app, "kube": kube}
+    try:
+        yield state
+    finally:
+        app.stop()
+
+
+class Ctx:
+    def __init__(self, state):
+        self.app = state["app"]
+        self.kube = state["kube"]
+
+    def _post(self, path, request):
+        body = json.dumps({"request": request}).encode()
+        ctx = ssl.create_default_context()
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+        r = urllib.request.Request(
+            f"https://127.0.0.1:{self.app.webhook_server.port}{path}", data=body
+        )
+        with urllib.request.urlopen(r, context=ctx, timeout=10) as resp:
+            return json.loads(resp.read())["response"]
+
+    def admit(self, request):
+        return self._post("/v1/admit", request)
+
+    def admitlabel(self, request):
+        return self._post("/v1/admitlabel", request)
+
+    def apply(self, obj, namespace=None):
+        """kubectl apply: review through the webhook, create when allowed."""
+        if namespace:
+            obj = json.loads(json.dumps(obj))
+            obj.setdefault("metadata", {})["namespace"] = namespace
+        resp = self.admit(admission_request(obj, namespace=namespace))
+        if resp["allowed"]:
+            self.kube.apply(obj)
+        return resp
+
+    def drain(self):
+        assert self.app.manager.drain()
+
+    def wait_for(self, pred, timeout=15.0, msg="condition"):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            got = pred()
+            if got:
+                return got
+            time.sleep(0.05)
+        raise AssertionError(f"timed out waiting for {msg}")
+
+
+@pytest.mark.usefixtures("cluster")
+class TestBatsBattery:
+    # "gatekeeper-controller-manager is running" / "gatekeeper-audit is
+    # running" / "waiting for validating webhook"
+    def test_processes_running(self, cluster):
+        c = Ctx(cluster)
+        # health endpoints ride the webhook listener when the webhook role
+        # is assigned (reference main.go:193-196 registers them on the
+        # manager's server)
+        port = c.app.webhook_server.port
+        ctx = ssl.create_default_context()
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+        for path in ("/healthz", "/readyz"):
+            with urllib.request.urlopen(
+                f"https://127.0.0.1:{port}{path}", context=ctx, timeout=5
+            ) as resp:
+                assert resp.status == 200
+
+    # "namespace label webhook is serving"
+    def test_namespace_label_webhook_serving(self, cluster):
+        c = Ctx(cluster)
+        ok = c.admitlabel(admission_request(
+            {"apiVersion": "v1", "kind": "Namespace",
+             "metadata": {"name": "probe"}}))
+        assert ok["allowed"] is True
+
+    # "applying sync config"
+    def test_applying_sync_config(self, cluster):
+        c = Ctx(cluster)
+        c.kube.create(load("sync.yaml"))
+        c.drain()
+        watched = c.app.manager.watch_manager.watched_gvks()
+        assert watched.contains(("", "v1", "Namespace"))
+        assert watched.contains(("", "v1", "Pod"))
+
+    # "required labels dryrun test" part 1 + "constrainttemplates crd is
+    # established"
+    def test_template_and_crd_established(self, cluster):
+        c = Ctx(cluster)
+        c.kube.create(load("templates/k8srequiredlabels_template.yaml"))
+        c.drain()
+        crd = c.kube.get(
+            ("apiextensions.k8s.io", "v1", "CustomResourceDefinition"),
+            "k8srequiredlabels.constraints.gatekeeper.sh",
+        )
+        conds = (crd.get("status") or {}).get("conditions") or []
+        assert any(
+            x.get("type") == "Established" and x.get("status") == "True"
+            for x in conds
+        )
+
+    # "no ignore label unless namespace is exempt test"
+    def test_no_ignore_label_unless_exempt(self, cluster):
+        c = Ctx(cluster)
+        resp = c.admitlabel(admission_request(load("bad/ignore_label_ns.yaml")))
+        assert resp["allowed"] is False
+        assert (
+            "Only exempt namespace can have the admission.gatekeeper.sh/ignore label"
+            in resp["status"]["message"]
+        )
+
+    # "gatekeeper-system ignore label can be patched"
+    def test_exempt_namespace_ignore_label_allowed(self, cluster):
+        c = Ctx(cluster)
+        patched = {
+            "apiVersion": "v1", "kind": "Namespace",
+            "metadata": {"name": "gatekeeper-system",
+                         "labels": {"admission.gatekeeper.sh/ignore":
+                                    "ignore-label-test-passed"}},
+        }
+        resp = c.admitlabel(admission_request(patched, operation="UPDATE"))
+        assert resp["allowed"] is True
+
+    # "required labels dryrun test" parts 2-4
+    def test_required_labels_deny_then_dryrun(self, cluster):
+        c = Ctx(cluster)
+        c.kube.create(load("constraints/all_ns_must_have_gatekeeper.yaml"))
+        c.drain()
+        good = c.apply(load("good/good_ns.yaml"))
+        assert good["allowed"] is True
+        bad = c.apply(load("bad/bad_ns.yaml"))
+        assert bad["allowed"] is False
+        assert "denied" in bad["status"]["message"]
+        # switch the same constraint to enforcementAction: dryrun
+        c.kube.apply(load("constraints/all_ns_must_have_gatekeeper-dryrun.yaml"))
+        c.drain()
+        spec = c.kube.get(RL_GVK, "ns-must-have-gk")["spec"]
+        assert spec.get("enforcementAction") == "dryrun"
+        bad2 = c.apply(load("bad/bad_ns.yaml"))
+        assert bad2["allowed"] is True  # dryrun violations never block
+
+    # "create namespace for unique labels test" + "unique labels test"
+    def test_unique_labels(self, cluster):
+        c = Ctx(cluster)
+        c.kube.create(load("templates/k8suniquelabel_template.yaml"))
+        c.drain()
+        c.kube.create(load("constraints/all_ns_gatekeeper_label_unique.yaml"))
+        c.drain()
+        first = c.apply(load("good/no_dupe_ns.yaml"))
+        assert first["allowed"] is True
+        c.drain()  # sync the namespace into the inventory
+        dupe = c.apply(load("bad/no_dupe_ns_2.yaml"))
+        assert dupe["allowed"] is False
+
+    # "container limits test"
+    def test_container_limits(self, cluster):
+        c = Ctx(cluster)
+        c.kube.create(load("templates/k8scontainterlimits_template.yaml"))
+        c.drain()
+        c.kube.create(load("constraints/containers_must_be_limited.yaml"))
+        c.drain()
+        no_limits = c.apply(load("bad/opa_no_limits.yaml"), namespace="good-ns")
+        assert no_limits["allowed"] is False
+        good = c.apply(load("good/opa.yaml"))
+        assert good["allowed"] is True
+
+    # "deployment test": the deployment itself is admitted (no Deployment
+    # match); the pod it stamps out is denied, which in a live cluster
+    # surfaces as unavailableReplicas
+    def test_deployment_pods_denied(self, cluster):
+        c = Ctx(cluster)
+        deploy = load("bad/bad_deployment.yaml")
+        resp = c.apply(deploy)
+        assert resp["allowed"] is True
+        pod_template = deploy["spec"]["template"]
+        pod = {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {
+                "name": "opa-test-deployment-0",
+                "namespace": "default",
+                "labels": (pod_template.get("metadata") or {}).get("labels") or {},
+            },
+            "spec": pod_template["spec"],
+        }
+        denied = c.admit(admission_request(pod))
+        assert denied["allowed"] is False
+
+    # "waiting for namespaces to be synced using metrics endpoint"
+    def test_sync_metric_matches_namespace_count(self, cluster):
+        c = Ctx(cluster)
+        n_ns = len(c.kube.list(("", "v1", "Namespace")))
+
+        def metric_ok():
+            port = c.app.metrics_exporter.port
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5
+            ).read().decode()
+            for line in body.splitlines():
+                if line.startswith("gatekeeper_sync{") and 'kind="Namespace"' in line \
+                        and 'status="active"' in line:
+                    return float(line.rsplit(" ", 1)[1]) == n_ns
+            return False
+
+        c.wait_for(metric_ok, msg="gatekeeper_sync Namespace metric")
+
+    # "required labels audit test"
+    def test_required_labels_audit(self, cluster):
+        c = Ctx(cluster)
+
+        def audited():
+            st = (c.kube.get(RL_GVK, "ns-must-have-gk").get("status") or {})
+            return st if st.get("violations") else None
+
+        st = c.wait_for(audited, msg="audit violations on ns-must-have-gk")
+        names = {v["name"] for v in st["violations"]}
+        # every unlabeled namespace violates, including the dryrun'd bad-ns
+        assert "bad-ns" in names and "default" in names
+        assert st["totalViolations"] == len(st["violations"])
+        assert st["totalViolationsExact"] is True
+        assert all(v["enforcementAction"] == "dryrun" for v in st["violations"])
+
+    # "emit events test"
+    def test_emit_events(self, cluster):
+        c = Ctx(cluster)
+
+        def events_of(reason):
+            return [
+                e for e in c.kube.list(EVENTS_GVK)
+                if e.get("reason") == reason
+                and (e["metadata"].get("annotations") or {}).get(
+                    "constraint_kind") == "K8sRequiredLabels"
+            ]
+
+        assert len(events_of("FailedAdmission")) == 1
+        assert len(events_of("DryrunViolation")) == 1
+        c.wait_for(lambda: len(events_of("AuditViolation")) >= 6,
+                   msg="audit violation events")
+
+    # "config namespace exclusion test"
+    def test_config_namespace_exclusion(self, cluster):
+        c = Ctx(cluster)
+        c.kube.create({"apiVersion": "v1", "kind": "Namespace",
+                       "metadata": {"name": "excluded-namespace"}})
+        resp = c.apply(load("bad/opa_no_limits.yaml"),
+                       namespace="excluded-namespace")
+        assert resp["allowed"] is True  # sync.yaml excludes it for "*"
