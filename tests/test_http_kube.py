@@ -12,6 +12,7 @@ against envtest.
 """
 
 import json
+import pathlib
 import ssl
 import threading
 import time
@@ -23,7 +24,7 @@ import yaml
 from gatekeeper_tpu.certs.rotator import generate_ca, generate_server_cert
 from gatekeeper_tpu.kube.apiserver import KubeApiServer
 from gatekeeper_tpu.kube.http_client import HttpKube, KubeError
-from gatekeeper_tpu.kube.inmem import Conflict, InMemoryKube, NotFound
+from gatekeeper_tpu.kube.inmem import Conflict, NotFound
 
 from .test_controllers import CONSTRAINT, TEMPLATE
 
@@ -51,7 +52,8 @@ WIDGET_CRD = {
 
 
 def load_deploy_crds():
-    with open("deploy/gatekeeper.yaml") as f:
+    manifest = pathlib.Path(__file__).parent.parent / "deploy/gatekeeper.yaml"
+    with open(manifest) as f:
         return [d for d in yaml.safe_load_all(f)
                 if d and d.get("kind") == "CustomResourceDefinition"]
 
@@ -403,4 +405,118 @@ class TestFullStackOverHTTP:
             finally:
                 app.stop()
         finally:
+            srv.stop()
+
+
+class TestRoleSplitPods:
+    """The reference's production deployment shape (Makefile:30-75): a
+    controller-manager pod (--operation webhook --operation status) and a
+    separate audit pod (--operation audit --operation status), both
+    against the same API server over the wire.  Each writes its own
+    per-pod status CR; the aggregation controllers fold both into the
+    parent's status.byPod (constraintstatus_controller.go:218)."""
+
+    def test_two_pods_aggregate_and_split_roles(self, monkeypatch):
+        import os
+        from gatekeeper_tpu.main import App, build_parser
+
+        srv = KubeApiServer()
+        srv.start()
+        apps = []
+        try:
+            admin = HttpKube(srv.url, discovery_retry_s=2.0)
+            for crd in load_deploy_crds():
+                admin.create(crd)
+            admin.create(ns("gatekeeper-system"))
+            # each pod exists in the API so status CRs get owner refs
+            for pname in ("gk-webhook-0", "gk-audit-0"):
+                admin.create({"apiVersion": "v1", "kind": "Pod",
+                              "metadata": {"name": pname,
+                                           "namespace": "gatekeeper-system",
+                                           "uid": f"uid-{pname}"},
+                              "spec": {"containers": []}})
+
+            def boot(pod_name, ops):
+                monkeypatch.setitem(os.environ, "POD_NAME", pod_name)
+                flags = ["--driver", "interp", "--port", "0",
+                         "--prometheus-port", "0", "--health-addr", ":0",
+                         "--audit-interval", "0.1",
+                         "--cert-dir", "/tmp/gk-test-certs"]
+                for o in ops:
+                    flags += ["--operation", o]
+                app = App(build_parser().parse_args(flags),
+                          kube=HttpKube(srv.url, discovery_retry_s=2.0))
+                app.start()
+                apps.append(app)
+                return app
+
+            webhook_pod = boot("gk-webhook-0", ["webhook", "status"])
+            audit_pod = boot("gk-audit-0", ["audit", "status"])
+            assert webhook_pod.webhook_server is not None
+            assert webhook_pod.audit_manager is None
+            assert audit_pod.webhook_server is None
+            assert audit_pod.audit_manager is not None
+
+            admin.create(json.loads(json.dumps(TEMPLATE)))
+            admin.create(ns("unlabeled"))
+            # wait for the template controller to synthesize + create the
+            # constraint CRD, then create the constraint CR exactly once
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                try:
+                    admin.get(CRD_GVK,
+                              "k8srequiredlabels.constraints.gatekeeper.sh")
+                    break
+                except (NotFound, KubeError):
+                    time.sleep(0.1)
+            admin.create(json.loads(json.dumps(CONSTRAINT)))
+
+            # the audit pod writes violations to the shared constraint
+            deadline = time.monotonic() + 25
+            st = {}
+            while time.monotonic() < deadline:
+                try:
+                    st = admin.get(CGVK, "ns-must-have-gk").get("status") or {}
+                except Exception:
+                    st = {}
+                if st.get("violations") and len(st.get("byPod", [])) == 2:
+                    break
+                time.sleep(0.1)
+            assert any(v["name"] == "unlabeled"
+                       for v in st.get("violations", [])), st
+            # both pods' status CRs folded into byPod, sorted by pod id
+            ids = [s["id"] for s in st.get("byPod", [])]
+            assert ids == ["gk-audit-0", "gk-webhook-0"], st.get("byPod")
+
+            # the per-pod status CRs are owner-referenced to their pods
+            sts = admin.list(("status.gatekeeper.sh", "v1beta1",
+                              "ConstraintPodStatus"),
+                             namespace="gatekeeper-system")
+            owners = {
+                (s["metadata"].get("ownerReferences") or [{}])[0].get("name")
+                for s in sts
+            }
+            assert owners == {"gk-webhook-0", "gk-audit-0"}, sts
+
+            # the webhook pod serves denials meanwhile
+            body = json.dumps({"request": {
+                "uid": "u1",
+                "kind": {"group": "", "version": "v1", "kind": "Namespace"},
+                "name": "bad-ns", "namespace": "", "operation": "CREATE",
+                "userInfo": {"username": "alice"},
+                "object": {"apiVersion": "v1", "kind": "Namespace",
+                           "metadata": {"name": "bad-ns", "labels": {}}},
+            }}).encode()
+            ctx = ssl.create_default_context()
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+            req = urllib.request.Request(
+                f"https://127.0.0.1:{webhook_pod.webhook_server.port}/v1/admit",
+                data=body)
+            with urllib.request.urlopen(req, context=ctx, timeout=10) as resp:
+                out = json.loads(resp.read())
+            assert out["response"]["allowed"] is False
+        finally:
+            for app in apps:
+                app.stop()
             srv.stop()
