@@ -623,6 +623,22 @@ def main():
     import jax
 
     log(f"devices: {jax.devices()}")
+    # persistent XLA compile cache (restart-recovery path, SURVEY §5.4):
+    # cold_sweep_s reflects a warm cache when prior runs populated it —
+    # the entry count below makes that auditable in the artifact's stderr
+    cache_dir = os.environ.get(
+        "GK_XLA_CACHE",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".xla-cache"),
+    )
+    if cache_dir:
+        from gatekeeper_tpu.ops.xlacache import enable as enable_xla_cache
+
+        if enable_xla_cache(cache_dir):
+            try:
+                n = len(os.listdir(cache_dir))
+            except OSError:
+                n = 0
+            log(f"xla cache: {cache_dir} ({n} entries pre-run)")
     if config != "all":
         print(json.dumps(CONFIGS[config]()))
         return

@@ -71,6 +71,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--jax-profile-port", type=int, default=0,
                    help="start a jax.profiler server on this port "
                         "(0 = disabled; capture via TensorBoard)")
+    p.add_argument("--xla-cache-dir",
+                   default=os.environ.get("GK_XLA_CACHE", ""),
+                   help="persistent XLA compilation cache directory: a "
+                        "restarted pod reloads its fused executables from "
+                        "disk instead of recompiling (empty = disabled)")
     # operations.go:77
     p.add_argument("--operation", action="append", default=[],
                    choices=list(ops_mod.ALL_OPERATIONS),
@@ -286,6 +291,10 @@ class App:
             level_key=getattr(args, "log_level_key", "level"),
             level_encoder=getattr(args, "log_level_encoder", "lower"),
         )
+        if getattr(args, "xla_cache_dir", ""):
+            from .ops.xlacache import enable as enable_xla_cache
+
+            enable_xla_cache(args.xla_cache_dir)
         if getattr(args, "debug_use_fake_pod", False):
             # run outside Kubernetes: fixed pod identity, no owner refs on
             # status CRs (controller.go:133-142)

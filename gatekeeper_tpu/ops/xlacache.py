@@ -1,0 +1,46 @@
+"""Persistent XLA compilation cache (SURVEY.md §5.4: device buffers and
+executables are derived state; an on-disk compile cache is the one
+optimization kept across restarts).
+
+A gatekeeper restart rebuilds all engine state from the API server, but
+the fused executables' XLA compiles dominate cold start (~20s+ for a
+500-template corpus).  With the cache enabled, a restarted pod reloads
+each executable from disk in milliseconds as long as its HLO is unchanged
+(same template set/shapes/jax version)."""
+
+from __future__ import annotations
+
+import logging
+
+log = logging.getLogger("gatekeeper.xlacache")
+
+_enabled_dir = None
+
+
+def enable(cache_dir: str) -> bool:
+    """Idempotently point jax's persistent compilation cache at cache_dir.
+    Returns False (with a log line) when the running jax lacks support."""
+    global _enabled_dir
+    if not cache_dir or _enabled_dir == cache_dir:
+        return _enabled_dir is not None
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+    except Exception:
+        log.exception("persistent XLA cache unavailable")
+        return False
+    _enabled_dir = cache_dir
+    # best-effort: cache every executable (the fused policy programs are
+    # small by XLA standards but expensive to rebuild behind a network
+    # relay); absent knobs on older jax leave the dir active with defaults
+    for knob, val in (
+        ("jax_persistent_cache_min_entry_size_bytes", 0),
+        ("jax_persistent_cache_min_compile_time_secs", 0.0),
+    ):
+        try:
+            jax.config.update(knob, val)
+        except Exception:
+            log.warning("xla cache knob %s unavailable; using jax default", knob)
+    log.info("persistent XLA compilation cache at %s", cache_dir)
+    return True

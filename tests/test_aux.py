@@ -376,3 +376,30 @@ class TestFlagParityAdditions:
             assert line["severity"] == "INFO"
         finally:
             root.handlers = saved
+
+
+class TestXlaCache:
+    def test_enable_idempotent_and_functional(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+        from gatekeeper_tpu.ops import xlacache
+
+        d = str(tmp_path / "cache")
+        prior = jax.config.jax_compilation_cache_dir
+        try:
+            assert xlacache.enable(d) is True
+            assert xlacache.enable(d) is True  # idempotent
+            f = jax.jit(lambda x: (x * 2).sum())
+            assert float(f(jnp.ones(64))) == 128.0
+            import os
+            assert os.path.isdir(d) and len(os.listdir(d)) >= 1
+        finally:
+            # undo the global config so later compiles don't write into a
+            # pruned pytest tmp dir
+            jax.config.update("jax_compilation_cache_dir", prior)
+            xlacache._enabled_dir = None
+
+    def test_flag_wires_cache(self, tmp_path, monkeypatch):
+        from gatekeeper_tpu.main import build_parser
+        args = build_parser().parse_args(["--xla-cache-dir", str(tmp_path)])
+        assert args.xla_cache_dir == str(tmp_path)

@@ -462,13 +462,16 @@ class TestRoleSplitPods:
             # wait for the template controller to synthesize + create the
             # constraint CRD, then create the constraint CR exactly once
             deadline = time.monotonic() + 20
+            crd_ready = False
             while time.monotonic() < deadline:
                 try:
                     admin.get(CRD_GVK,
                               "k8srequiredlabels.constraints.gatekeeper.sh")
+                    crd_ready = True
                     break
                 except (NotFound, KubeError):
                     time.sleep(0.1)
+            assert crd_ready, "template controller never created the constraint CRD"
             admin.create(json.loads(json.dumps(CONSTRAINT)))
 
             # the audit pod writes violations to the shared constraint
