@@ -58,6 +58,27 @@ def _results_key(results):
     )
 
 
+def _assert_parity(ct, ci, pods, rng, seed, n_sample, label):
+    """Audit parity (uncapped, complete results) + review parity on a
+    random subset through the batched device path."""
+    assert _results_key(ct.audit().results()) == _results_key(
+        ci.audit().results()
+    ), f"{label}audit diverged (seed {seed})"
+    sample = rng.sample(pods, min(n_sample, len(pods)))
+    reqs = [{
+        "uid": "u", "kind": {"group": "", "version": "v1", "kind": "Pod"},
+        "name": p["metadata"]["name"],
+        "namespace": p["metadata"].get("namespace", ""),
+        "operation": "CREATE", "object": p,
+    } for p in sample]
+    got = ct.driver.review_batch(reqs)
+    for req, (results, _trace) in zip(reqs, got):
+        want, _ = ci.driver.review(req)
+        assert _results_key(results) == _results_key(want), (
+            f"{label}review diverged (seed {seed}, pod {req['name']})"
+        )
+
+
 @pytest.mark.parametrize("seed", [1, 2, 3])
 def test_fuzzed_workloads_device_matches_interp(seed):
     rng = random.Random(seed)
@@ -79,25 +100,7 @@ def test_fuzzed_workloads_device_matches_interp(seed):
         ct.add_data(p)
         ci.add_data(p)
 
-    # audit parity (uncapped: complete results)
-    assert _results_key(ct.audit().results()) == _results_key(
-        ci.audit().results()
-    ), f"audit diverged (seed {seed})"
-
-    # review parity on a random subset, through the batched device path
-    sample = rng.sample(pods, min(8, len(pods)))
-    reqs = [{
-        "uid": "u", "kind": {"group": "", "version": "v1", "kind": "Pod"},
-        "name": p["metadata"]["name"],
-        "namespace": p["metadata"].get("namespace", ""),
-        "operation": "CREATE", "object": p,
-    } for p in sample]
-    got = ct.driver.review_batch(reqs)
-    for req, (results, _trace) in zip(reqs, got):
-        want, _ = ci.driver.review(req)
-        assert _results_key(results) == _results_key(want), (
-            f"review diverged (seed {seed}, pod {req['name']})"
-        )
+    _assert_parity(ct, ci, pods, rng, seed, n_sample=8, label="")
 
     # capped-audit totals: exact entries must equal the oracle's
     _res, totals = ct.audit_capped(3)
@@ -116,3 +119,100 @@ def test_fuzzed_workloads_device_matches_interp(seed):
     assert _results_key(ct.audit().results()) == _results_key(
         ci.audit().results()
     ), f"post-churn audit diverged (seed {seed})"
+
+
+def _feature_template(name, kind, rego, libs=()):
+    target = {"target": "admission.k8s.gatekeeper.sh", "rego": rego}
+    if libs:
+        target["libs"] = list(libs)
+    return {
+        "apiVersion": "templates.gatekeeper.sh/v1beta1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": name},
+        "spec": {"crd": {"spec": {"names": {"kind": kind}}},
+                 "targets": [target]},
+    }
+
+
+# templates leaning on the newer engine surface: walk, else chains,
+# with modifiers, output-argument calls, import aliasing, registry builtins
+FEATURE_TEMPLATES = [
+    _feature_template("fuzzwalk", "FuzzWalk", """
+package fuzzwalk
+
+violation[{"msg": msg}] {
+  walk(input.review.object, [path, value])
+  is_string(value)
+  contains(value, "host")
+  msg := sprintf("hosty string at depth %v", [count(path)])
+}
+"""),
+    _feature_template("fuzzelse", "FuzzElse", """
+package fuzzelse
+
+risk(obj) = "privileged" { obj.spec.hostPID == true }
+else = "ported" { obj.spec.containers[_].ports[_].hostPort > 0 }
+else = "plain"
+
+violation[{"msg": msg}] {
+  r := risk(input.review.object)
+  r != "plain"
+  msg := sprintf("risk: %v", [r])
+}
+"""),
+    _feature_template("fuzzwith", "FuzzWith", """
+package fuzzwith
+
+has_containers { count(input.review.object.spec.containers) > 0 }
+
+violation[{"msg": "containerless pod"}] {
+  not has_containers
+  # counterfactual sanity: the rule itself works once containers exist
+  has_containers with input.review.object.spec.containers as [{"name": "injected"}]
+}
+"""),
+    _feature_template("fuzzoutarg", "FuzzOutArg", """
+package fuzzoutarg
+import data.lib.fuzzhelpers as fh
+
+violation[{"msg": msg}] {
+  c := input.review.object.spec.containers[_]
+  split(c.image, ":", parts)
+  count(parts, n)
+  n < 2
+  msg := fh.tagless(c)
+}
+""", libs=["""
+package lib.fuzzhelpers
+
+tagless(c) = msg { msg := sprintf("container %v has an untagged image", [object.get(c, "name", "?")]) }
+"""]),
+]
+
+
+@pytest.mark.parametrize("seed", [11, 12])
+def test_feature_templates_device_matches_interp(seed):
+    """The walk/else/with/output-arg/import surface through both drivers
+    over structure-broken workloads."""
+    rng = random.Random(seed)
+    pods = [_mutate_pod(p, rng)
+            for p in make_pods(rng.randint(40, 90), seed=seed,
+                               violation_rate=rng.random())]
+    ct = Client(driver=TpuDriver())
+    ct.driver.DEVICE_MIN_CELLS = 0
+    ci = Client(driver=InterpDriver())
+    for t in FEATURE_TEMPLATES:
+        ct.add_template(copy.deepcopy(t))
+        ci.add_template(copy.deepcopy(t))
+        kind = t["spec"]["crd"]["spec"]["names"]["kind"]
+        cons = {"apiVersion": "constraints.gatekeeper.sh/v1beta1",
+                "kind": kind, "metadata": {"name": f"c-{kind.lower()}"},
+                "spec": {"match": {"kinds": [
+                    {"apiGroups": [""], "kinds": ["Pod"]}]}}}
+        ct.add_constraint(copy.deepcopy(cons))
+        ci.add_constraint(cons)
+    for p in pods:
+        ct.add_data(p)
+        ci.add_data(copy.deepcopy(p))
+
+    _assert_parity(ct, ci, pods, rng, seed, n_sample=6, label="feature ")
