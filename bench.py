@@ -205,13 +205,80 @@ def bench_latency() -> dict:
     arr = np.array(times) * 1000
     p50, p99 = np.percentile(arr, 50), np.percentile(arr, 99)
     log(f"admission latency ms: p50={p50:.2f} p99={p99:.2f} max={arr.max():.2f}")
+    srv_p50, srv_p99 = _server_level_latency(c, req)
+    log(f"admission SERVER latency ms (TLS+batcher): p50={srv_p50:.2f} p99={srv_p99:.2f}")
     return {
         "metric": "admission handler p99 latency (demo/basic, deny path)",
         "value": round(float(p99), 3),
         "unit": "ms",
         "vs_baseline": 0,
         "p50_ms": round(float(p50), 3),
+        "server_p99_ms": round(float(srv_p99), 3),
+        "server_p50_ms": round(float(srv_p50), 3),
     }
+
+
+def _server_level_latency(client, req):
+    """p50/p99 through the PRODUCTION path: HTTPS webhook server +
+    micro-batcher + handler — what the apiserver actually observes (the
+    <=2ms north star applies here, not just to the bare handler)."""
+    import json as _json
+    import ssl
+    import urllib.request
+
+    import numpy as np
+
+    from gatekeeper_tpu.certs import CertRotator
+    from gatekeeper_tpu.kube.inmem import InMemoryKube
+    from gatekeeper_tpu.webhook import (
+        MicroBatcher, ValidationHandler, WebhookServer,
+    )
+
+    kube = InMemoryKube()
+    rot = CertRotator(kube)
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        certfile, keyfile = rot.write_cert_files(td)
+        mb = MicroBatcher(client)
+        handler = ValidationHandler(mb, kube=kube)
+        srv = WebhookServer(handler, port=0, certfile=certfile, keyfile=keyfile)
+        srv.start()
+        try:
+            ctx = ssl.create_default_context()
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+            body = _json.dumps({"request": req}).encode()
+            # persistent connection, as the apiserver's webhook client uses
+            # (keep-alive; the server speaks HTTP/1.1)
+            import http.client
+
+            conn = http.client.HTTPSConnection(
+                "127.0.0.1", srv.port, context=ctx, timeout=10
+            )
+
+            def once():
+                conn.request("POST", "/v1/admit", body=body,
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                return _json.loads(resp.read())
+
+            for _ in range(30):
+                once()
+            import gc
+
+            gc.collect()
+            gc.freeze()  # keep warmup garbage out of the timed p99
+            times = []
+            for _ in range(int(os.environ.get("BENCH_SERVER_ITERS", "300"))):
+                t0 = time.perf_counter()
+                once()
+                times.append(time.perf_counter() - t0)
+            arr = np.array(times) * 1000
+            return float(np.percentile(arr, 50)), float(np.percentile(arr, 99))
+        finally:
+            srv.stop()
+            mb.stop()
 
 
 def bench_batch1m() -> dict:
