@@ -168,6 +168,7 @@ class WebhookServer:
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._ssl_context: Optional[ssl.SSLContext] = None
+        self._stopping = False
 
     def reload_certs(self, certfile: str, keyfile: str):
         """Hot-swap the serving cert: new handshakes pick up the reloaded
@@ -192,22 +193,27 @@ class WebhookServer:
                 pass
 
             def _send_json(self, code: int, payload: dict):
-                body = json.dumps(payload).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                self._send_bytes(code, "application/json",
+                                 json.dumps(payload).encode())
 
             def _send_text(self, code: int, text: str):
-                body = text.encode()
+                self._send_bytes(code, "text/plain", text.encode())
+
+            def _send_bytes(self, code: int, ctype: str, body: bytes):
                 self.send_response(code)
-                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                if self.close_connection:
+                    # advertise the close decided by framing/shutdown so
+                    # keep-alive clients don't reuse a dying connection
+                    self.send_header("Connection", "close")
                 self.end_headers()
                 self.wfile.write(body)
 
             def do_GET(self):
+                self._read_body()  # a GET may legally carry a body too
+                if self._stopped():
+                    return
                 # healthz/readyz (reference main.go:193-196)
                 if self.path == "/healthz":
                     self._send_text(200, "ok")
@@ -224,6 +230,11 @@ class WebhookServer:
                 """Always consume the request body: under HTTP/1.1
                 keep-alive, unread body bytes would be parsed as the NEXT
                 request line, poisoning the persistent connection."""
+                if self.headers.get("Transfer-Encoding"):
+                    # chunked framing is not parsed here; the connection
+                    # cannot be reused safely
+                    self.close_connection = True
+                    return b""
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                 except (TypeError, ValueError):
@@ -232,8 +243,21 @@ class WebhookServer:
                     return b""
                 return self.rfile.read(length) if length > 0 else b""
 
+            def _stopped(self) -> bool:
+                """After stop(), established keep-alive connections must
+                not keep receiving admission decisions from a server the
+                process considers down (HTTP/1.0 closed per response, so
+                this was free before keep-alive)."""
+                if outer._stopping:
+                    self.close_connection = True
+                    self._send_text(503, "shutting down")
+                    return True
+                return False
+
             def do_POST(self):
                 body = self._read_body()
+                if self._stopped():
+                    return
                 if self.path not in ("/v1/admit", "/v1/admitlabel"):
                     self._send_text(404, "not found")
                     return
@@ -280,6 +304,10 @@ class WebhookServer:
         gc.freeze()
 
     def stop(self):
+        # established keep-alive connections keep their handler threads
+        # alive past shutdown(); the flag makes them 503 + close instead
+        # of serving admission decisions from a stopped server
+        self._stopping = True
         if self._server:
             self._server.shutdown()
             self._server.server_close()

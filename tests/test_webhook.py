@@ -465,3 +465,42 @@ class TestKeepAliveFraming:
             assert out["response"]["allowed"] is False  # denied, not 400
         finally:
             srv.stop()
+
+    def test_chunked_body_closes_connection(self):
+        """Transfer-Encoding framing is not parsed; the server must close
+        the connection rather than let chunk data poison the next request."""
+        import http.client
+        handler, client, kube = make_handler()
+        srv = WebhookServer(handler, port=0)
+        srv.start()
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=5)
+            conn.putrequest("POST", "/v1/admit")
+            conn.putheader("Transfer-Encoding", "chunked")
+            conn.endheaders()
+            conn.send(b"5\r\nhello\r\n0\r\n\r\n")
+            r = conn.getresponse()
+            r.read()
+            assert r.getheader("Connection") == "close"
+        finally:
+            srv.stop()
+
+    def test_stopped_server_refuses_keepalive_requests(self):
+        """A persistent connection must not keep receiving admission
+        decisions after stop() — handler threads outlive shutdown()."""
+        import http.client
+        handler, client, kube = make_handler()
+        srv = WebhookServer(handler, port=0)
+        srv.start()
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=5)
+        body = json.dumps({"request": ns_request()}).encode()
+        conn.request("POST", "/v1/admit", body=body)
+        assert conn.getresponse().read()  # connection established + served
+        srv.stop()
+        try:
+            conn.request("POST", "/v1/admit", body=body)
+            r = conn.getresponse()
+            r.read()
+            assert r.status == 503
+        except (ConnectionError, http.client.HTTPException):
+            pass  # the connection dropping outright is also a valid outcome
