@@ -726,6 +726,8 @@ def main():
             out[key] = sub["value"]
         if name == "latency":
             out["admission_p50_ms"] = sub.get("p50_ms")
+            out["admission_server_p99_ms"] = sub.get("server_p99_ms")
+            out["admission_server_p50_ms"] = sub.get("server_p50_ms")
     print(json.dumps(out))
 
 

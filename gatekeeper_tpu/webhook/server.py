@@ -178,6 +178,7 @@ class WebhookServer:
             self._ssl_context.load_cert_chain(certfile, keyfile)
 
     def start(self):
+        self._stopping = False  # a stopped server may be restarted
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
