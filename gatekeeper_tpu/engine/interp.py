@@ -367,26 +367,51 @@ class QueryContext:
             if r.is_default:
                 default = next(self.eval_term(cm, r.value, {}))[0]
                 continue
-            result = self._clause_chain_value(cm, r, {})
-            if result is not UNDEFINED:
-                break
+            got = self._clause_chain_value(cm, r, {})
+            if got is UNDEFINED:
+                continue
+            if result is not UNDEFINED and not values_equal(result, got):
+                # OPA topdown: eval_conflict_error (complete rules must
+                # not produce multiple outputs)
+                raise RegoEvalError(
+                    f"eval_conflict_error: complete rules must not produce "
+                    f"multiple outputs (rule '{name}')"
+                )
+            result = got
         if result is UNDEFINED:
             result = default
         self._complete[key] = result
         return result
 
-    def _clause_chain_value(self, cm: CompiledModule, r: Rule, bindings: Bindings) -> Any:
+    def _clause_chain_value(
+        self, cm: CompiledModule, r: Rule, bindings: Bindings,
+        what: str = "complete rules",
+    ) -> Any:
         """Evaluate a clause and its `else` chain: the first clause whose
-        body succeeds provides the value (true when the head has none)."""
+        body succeeds provides the value (true when the head has none).
+        ALL body bindings of that clause are folded — different head
+        values across bindings are OPA's eval_conflict_error, not
+        first-wins."""
         clause: Optional[Rule] = r
         while clause is not None:
+            if clause.value is None:
+                for _b in self.eval_body(cm, clause.body, 0, bindings):
+                    return True  # boolean head: every binding agrees
+                clause = clause.els
+                continue
+            found = UNDEFINED
             for b in self.eval_body(cm, clause.body, 0, bindings):
-                if clause.value is None:
-                    return True
                 got = next(self.eval_term(cm, clause.value, b), None)
                 if got is None:
                     continue
-                return got[0]
+                if found is not UNDEFINED and not values_equal(found, got[0]):
+                    raise RegoEvalError(
+                        f"eval_conflict_error: {what} must not produce "
+                        f"multiple outputs (rule '{clause.name}')"
+                    )
+                found = got[0]
+            if found is not UNDEFINED:
+                return found
             clause = clause.els
         return UNDEFINED
 
@@ -416,6 +441,12 @@ class QueryContext:
             for b in self.eval_body(cm, r.body, 0, {}):
                 for k, b2 in self.eval_term(cm, r.key, b):
                     for v, _ in self.eval_term(cm, r.value, b2):
+                        if k in out and not values_equal(out[k], v):
+                            # OPA: object keys must be unique
+                            raise RegoEvalError(
+                                f"eval_conflict_error: object keys must be "
+                                f"unique (rule '{name}', key {k!r})"
+                            )
                         out[k] = v
         ext = FrozenDict(out)
         self._extent[key] = ext
@@ -443,12 +474,17 @@ class QueryContext:
             if not r.is_function or len(r.args) != len(args):
                 continue
             for b in self._unify_params(cm, r.args, args, {}):
-                got = self._clause_chain_value(cm, r, b)
-                if got is not UNDEFINED:
-                    result = got
-                    break
-            if result is not UNDEFINED:
-                break
+                got = self._clause_chain_value(cm, r, b, what="functions")
+                if got is UNDEFINED:
+                    continue
+                if result is not UNDEFINED and not values_equal(result, got):
+                    # OPA: functions must not produce multiple outputs
+                    # for the same inputs
+                    raise RegoEvalError(
+                        f"eval_conflict_error: functions must not produce "
+                        f"multiple outputs for same inputs ('{name}')"
+                    )
+                result = got
         self._func[key] = result
         return result
 

@@ -560,3 +560,107 @@ violation[{"msg": "missing"}] {
             {"object": {"metadata": {"labels": {}}}},
             {"labels": ["other"]}, {},
         ) == [{"msg": "missing"}]
+
+
+class TestConflictErrors:
+    """OPA eval_conflict_error semantics: first-wins is not OPA — multiple
+    defined outputs with different values are evaluation errors."""
+
+    def _pol(self, rego):
+        return TemplatePolicy.compile(rego)
+
+    def test_complete_rule_conflict_raises(self):
+        from gatekeeper_tpu.engine.interp import RegoEvalError
+        pol = self._pol(
+            """
+package p
+
+x = 1 { input.review.a }
+x = 2 { input.review.b }
+
+violation[{"msg": "v"}] { x > 0 }
+"""
+        )
+        # only one clause defined: fine, either way
+        assert pol.eval_violations({"a": True}, {}, {}) == [{"msg": "v"}]
+        assert pol.eval_violations({"b": True}, {}, {}) == [{"msg": "v"}]
+        with pytest.raises(RegoEvalError, match="multiple outputs"):
+            pol.eval_violations({"a": True, "b": True}, {}, {})
+
+    def test_complete_rule_same_value_no_conflict(self):
+        pol = self._pol(
+            """
+package p
+
+x = 7 { input.review.a }
+x = 7 { input.review.b }
+
+violation[{"msg": "v"}] { x == 7 }
+"""
+        )
+        assert pol.eval_violations({"a": True, "b": True}, {}, {}) == [{"msg": "v"}]
+
+    def test_function_conflict_raises(self):
+        from gatekeeper_tpu.engine.interp import RegoEvalError
+        pol = self._pol(
+            """
+package p
+
+f(x) = 1 { x > 0 }
+f(x) = 2 { x > 10 }
+
+violation[{"msg": "v"}] { f(input.review.n) == 1 }
+"""
+        )
+        assert pol.eval_violations({"n": 5}, {}, {}) == [{"msg": "v"}]
+        with pytest.raises(RegoEvalError, match="multiple outputs"):
+            pol.eval_violations({"n": 20}, {}, {})
+
+    def test_partial_object_key_conflict_raises(self):
+        from gatekeeper_tpu.engine.interp import RegoEvalError
+        pol = self._pol(
+            """
+package p
+
+m["k"] = v { v := input.review.a }
+m["k"] = v { v := input.review.b }
+
+violation[{"msg": "v"}] { m["k"] }
+"""
+        )
+        assert pol.eval_violations({"a": True}, {}, {}) == [{"msg": "v"}]
+        with pytest.raises(RegoEvalError, match="keys must be unique"):
+            pol.eval_violations({"a": 1, "b": 2}, {}, {})
+        # same value on both clauses: no conflict
+        assert pol.eval_violations({"a": 3, "b": 3}, {}, {}) == [{"msg": "v"}]
+
+    def test_intra_clause_multiple_outputs_conflict(self):
+        from gatekeeper_tpu.engine.interp import RegoEvalError
+        pol = self._pol(
+            """
+package p
+
+x = v { v := input.review.items[_] }
+
+violation[{"msg": "v"}] { x > 0 }
+"""
+        )
+        assert pol.eval_violations({"items": [1]}, {}, {}) == [{"msg": "v"}]
+        assert pol.eval_violations({"items": [2, 2]}, {}, {}) == [{"msg": "v"}]
+        with pytest.raises(RegoEvalError, match="multiple outputs"):
+            pol.eval_violations({"items": [1, 2]}, {}, {})
+
+    def test_intra_clause_function_conflict(self):
+        from gatekeeper_tpu.engine.interp import RegoEvalError
+        pol = self._pol(
+            """
+package p
+
+f(a) = v { v := a[_] }
+
+violation[{"msg": "v"}] { f(input.review.items) == 1 }
+"""
+        )
+        assert pol.eval_violations({"items": [1, 1]}, {}, {}) == [{"msg": "v"}]
+        with pytest.raises(RegoEvalError, match="multiple outputs"):
+            pol.eval_violations({"items": [1, 2]}, {}, {})
