@@ -372,7 +372,11 @@ class QueryContext:
                 continue
             if result is not UNDEFINED and not values_equal(result, got):
                 # OPA topdown: eval_conflict_error (complete rules must
-                # not produce multiple outputs)
+                # not produce multiple outputs).  Deliberately aborts the
+                # WHOLE query, not just this template: the reference
+                # evaluates all templates in one Rego query, so a conflict
+                # anywhere errors the entire Review (rego.Eval err through
+                # local.go:302-324 -> client.go:763 -> webhook 500)
                 raise RegoEvalError(
                     f"eval_conflict_error: complete rules must not produce "
                     f"multiple outputs (rule '{name}')"

@@ -436,3 +436,47 @@ violation[{"msg": msg, "details": {}}] {
         results = client.review(req).results()
         assert len(results) == 1
         assert results[0].resource["metadata"]["name"] == "sara"
+
+
+def test_every_reference_template_installs_and_evaluates(client):
+    """Corpus-wide ingestion: every ConstraintTemplate fixture shipped by
+    the reference (demo/, bats/, psp testdata) installs through the full
+    client (parametrized over every driver variant) and evaluates a
+    pod review without error — a user's existing templates must load
+    as-is."""
+    from .corpus import constraint_templates
+
+    pod = {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "probe", "namespace": "default",
+                     "labels": {"app": "probe"}},
+        "spec": {"containers": [{
+            "name": "c", "image": "openpolicyagent/opa:0.9.2",
+            "resources": {"limits": {"cpu": "100m", "memory": "128Mi"}}}]},
+    }
+    c = client
+    seen = set()
+    n = 0
+    for path, tmpl in constraint_templates():
+        kind = (((tmpl.get("spec") or {}).get("crd") or {})
+                .get("spec") or {}).get("names", {}).get("kind")
+        if not kind or kind in seen:
+            continue
+        seen.add(kind)
+        c.add_template(tmpl)
+        c.add_constraint({
+            "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+            "kind": kind, "metadata": {"name": f"probe-{kind.lower()}"},
+            "spec": {"match": {"kinds": [
+                {"apiGroups": [""], "kinds": ["Pod", "Namespace"]}]}},
+        })
+        n += 1
+    # one review against the whole installed battery; eval must not
+    # error (violations are fine — many templates have no parameters)
+    req = {"uid": "u", "kind": {"group": "", "version": "v1", "kind": "Pod"},
+           "name": "probe", "namespace": "default",
+           "operation": "CREATE", "object": pod}
+    c.review(req)
+    c.add_data(pod)
+    c.audit()
+    assert n >= 12  # distinct constraint kinds across the corpus
