@@ -224,7 +224,6 @@ def _server_level_latency(client, req):
     <=2ms north star applies here, not just to the bare handler)."""
     import json as _json
     import ssl
-    import urllib.request
 
     import numpy as np
 

@@ -17,7 +17,6 @@ standalone/dev runs.  Any object implementing the same surface plugs into
 from __future__ import annotations
 
 import argparse
-import json
 import os
 import sys
 import threading

@@ -24,7 +24,7 @@ row growth until a bucket boundary.  SURVEY.md section 7 stage 4:
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 

@@ -16,7 +16,6 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from .pack import (
-    OP_UNKNOWN,
     PAD,
     SCOPE_NONE,
     SCOPE_OTHER,

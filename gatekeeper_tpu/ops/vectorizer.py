@@ -32,7 +32,6 @@ from ..rego.ast import (
     Call,
     Expr,
     Node,
-    ObjectTerm,
     Ref,
     Rule,
     Scalar,

@@ -11,7 +11,7 @@ spec preserved.
 from __future__ import annotations
 
 import threading
-from typing import List, Tuple
+from typing import Tuple
 
 from .. import logging as gklog
 from ..kube.inmem import InMemoryKube

@@ -17,10 +17,9 @@ Order of checks, matching the reference Handle:
 
 from __future__ import annotations
 
-import threading
 import time
-from dataclasses import dataclass, field
-from typing import Any, Callable, List, Optional
+from dataclasses import dataclass
+from typing import Callable, List, Optional
 
 from .. import logging as gklog
 from ..apis.config import CONFIG_NAME, GVK as CONFIG_GVK, parse_config

@@ -6,7 +6,6 @@ the device candidate mask per constraint in row order and stops rendering at
 the cap, with device-counted "resources" totals for capped constraints
 (VERDICT r1 #3)."""
 
-import numpy as np
 
 from gatekeeper_tpu.client.client import Client
 from gatekeeper_tpu.client.drivers import InterpDriver

@@ -4,7 +4,6 @@ candidate columns into host-side counts/candidate state, falling back to a
 full sweep only when the known candidate horizon runs out.
 """
 
-import numpy as np
 import pytest
 
 from gatekeeper_tpu.client.client import Client

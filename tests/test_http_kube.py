@@ -14,7 +14,6 @@ against envtest.
 import json
 import pathlib
 import ssl
-import threading
 import time
 import urllib.request
 
