@@ -222,8 +222,9 @@ class RSet:
         return "RSet(%r)" % (self.sorted_items(),)
 
 
-def freeze(v: Any) -> Any:
-    """JSON-like Python value -> frozen Rego value."""
+def _freeze_py(v: Any) -> Any:
+    """JSON-like Python value -> frozen Rego value (pure-Python reference;
+    the native fast path below is differentially tested against this)."""
     if v is None or isinstance(v, (bool, str)):
         return v
     if isinstance(v, float):
@@ -235,13 +236,47 @@ def freeze(v: Any) -> Any:
     if isinstance(v, int):
         return v
     if isinstance(v, (list, tuple)):
-        return tuple(freeze(x) for x in v)
+        return tuple(_freeze_py(x) for x in v)
     if isinstance(v, (dict, FrozenDict)):
-        items = v.items() if isinstance(v, FrozenDict) else v.items()
-        return FrozenDict({freeze(k): freeze(val) for k, val in items})
+        return FrozenDict({_freeze_py(k): _freeze_py(val) for k, val in v.items()})
     if isinstance(v, (set, frozenset, RSet)):
-        return RSet(freeze(x) for x in v)
+        return RSet(_freeze_py(x) for x in v)
     raise TypeError(f"cannot freeze {type(v)!r}")
+
+
+def _resolve_freeze():
+    """Prefer the C freeze (native/_gknative.cpp freeze_core): data
+    ingestion is ~90% freeze time on the profiled cold path.  Falls back
+    to the Python implementation when the extension is unavailable —
+    except under GK_NATIVE=require, whose fail-hard contract must not be
+    swallowed here (the loader caches failure, so a swallow would poison
+    every later load() too)."""
+    import os
+
+    try:
+        from ..native import load as _load_native
+
+        mod = _load_native()
+        if mod is not None and hasattr(mod, "freeze_core"):
+            mod.freeze_init(FrozenDict, RSet)
+            return mod.freeze_core
+    except Exception:
+        if os.environ.get("GK_NATIVE") == "require":
+            raise
+    return _freeze_py
+
+
+_freeze_impl = None
+
+
+def freeze(v: Any) -> Any:
+    """JSON-like Python value -> frozen Rego value.  Resolves the native
+    fast path lazily on first use: resolving at import time would make
+    merely importing this module spawn the g++ build subprocess."""
+    global _freeze_impl
+    if _freeze_impl is None:
+        _freeze_impl = _resolve_freeze()
+    return _freeze_impl(v)
 
 
 def thaw(v: Any) -> Any:

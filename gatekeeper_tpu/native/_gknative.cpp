@@ -13,6 +13,7 @@
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
 
+#include <cmath>
 #include <cstdint>
 #include <cstring>
 #include <string>
@@ -593,7 +594,203 @@ PyObject *keyset(PyObject *, PyObject *args) {
   return Py_BuildValue("(NN)", flat_arr, counts_arr);
 }
 
+// ---- freeze: JSON-like tree -> frozen Rego value --------------------------
+//
+// The profiled cold-start cost of data ingestion is engine/value.py
+// freeze(): a recursive Python walk over every K8s object.  This C walk
+// builds the SAME Python value types (tuples; FrozenDict/RSet instances
+// constructed through the classes registered by freeze_init), so
+// isinstance checks, hashing, and equality behave identically; parity is
+// pinned by tests/test_native.py differential cases.
+
+PyObject *g_frozendict_cls = nullptr;
+PyObject *g_rset_cls = nullptr;
+
+PyObject *freeze_rec_guarded(PyObject *v);
+
+PyObject *freeze_rec(PyObject *v) {
+  // per-level recursion guard: arbitrarily deep user JSON must raise
+  // RecursionError, not smash the C stack
+  if (Py_EnterRecursiveCall(" in freeze")) return nullptr;
+  PyObject *out = freeze_rec_guarded(v);
+  Py_LeaveRecursiveCall();
+  return out;
+}
+
+PyObject *freeze_rec_guarded(PyObject *v) {
+  if (v == Py_None || PyBool_Check(v) || PyUnicode_Check(v)) {
+    Py_INCREF(v);
+    return v;
+  }
+  if (PyFloat_Check(v)) {
+    double d = PyFloat_AS_DOUBLE(v);
+    // canonicalize integral floats (JSON "1.0") to ints like value.py
+    if (std::isfinite(d) && d == std::floor(d)) return PyLong_FromDouble(d);
+    Py_INCREF(v);
+    return v;
+  }
+  if (PyLong_Check(v)) {
+    Py_INCREF(v);
+    return v;
+  }
+  if (PyList_Check(v) || PyTuple_Check(v)) {
+    // snapshot first: freezing nested dicts calls back into Python
+    // (FrozenDict construction), which may release the eval lock to a
+    // thread mutating this very list — a cached item pointer would dangle
+    PyObject *snap = PySequence_Tuple(v);
+    if (!snap) return nullptr;
+    Py_ssize_t n = PyTuple_GET_SIZE(snap);
+    PyObject *out = PyTuple_New(n);
+    if (!out) {
+      Py_DECREF(snap);
+      return nullptr;
+    }
+    for (Py_ssize_t i = 0; i < n; i++) {
+      PyObject *f = freeze_rec(PyTuple_GET_ITEM(snap, i));
+      if (!f) {
+        Py_DECREF(snap);
+        Py_DECREF(out);
+        return nullptr;
+      }
+      PyTuple_SET_ITEM(out, i, f);
+    }
+    Py_DECREF(snap);
+    return out;
+  }
+  // frozen containers are REBUILT like the Python oracle does: a
+  // FrozenDict constructed directly around raw values must come out
+  // deep-frozen, not passed through with mutables inside
+  int is_fd = PyObject_IsInstance(v, g_frozendict_cls);
+  if (is_fd < 0) return nullptr;
+  int is_rs = is_fd ? 0 : PyObject_IsInstance(v, g_rset_cls);
+  if (is_rs < 0) return nullptr;
+  PyObject *dict_src = nullptr;  // borrowed semantics handled below
+  if (is_fd) {
+    dict_src = PyObject_GetAttrString(v, "_d");
+    if (!dict_src) return nullptr;
+  } else if (PyDict_Check(v)) {
+    dict_src = v;
+    Py_INCREF(dict_src);
+  }
+  if (dict_src) {
+    // snapshot items: freezing values runs Python, and iterating a live
+    // dict across that is unsafe under mutation
+    PyObject *items = PyDict_Items(dict_src);
+    Py_DECREF(dict_src);
+    if (!items) return nullptr;
+    PyObject *inner = PyDict_New();
+    if (!inner) {
+      Py_DECREF(items);
+      return nullptr;
+    }
+    Py_ssize_t n = PyList_GET_SIZE(items);
+    for (Py_ssize_t i = 0; i < n; i++) {
+      PyObject *pair = PyList_GET_ITEM(items, i);
+      PyObject *fk = freeze_rec(PyTuple_GET_ITEM(pair, 0));
+      if (!fk) {
+        Py_DECREF(items);
+        Py_DECREF(inner);
+        return nullptr;
+      }
+      PyObject *fv = freeze_rec(PyTuple_GET_ITEM(pair, 1));
+      if (!fv) {
+        Py_DECREF(fk);
+        Py_DECREF(items);
+        Py_DECREF(inner);
+        return nullptr;
+      }
+      int rc = PyDict_SetItem(inner, fk, fv);
+      Py_DECREF(fk);
+      Py_DECREF(fv);
+      if (rc < 0) {
+        Py_DECREF(items);
+        Py_DECREF(inner);
+        return nullptr;
+      }
+    }
+    Py_DECREF(items);
+    PyObject *out = PyObject_CallOneArg(g_frozendict_cls, inner);
+    Py_DECREF(inner);
+    return out;
+  }
+  if (is_rs) {
+    PyObject *s = PyObject_GetAttrString(v, "_s");
+    if (!s) return nullptr;
+    PyObject *items = PySequence_Tuple(s);
+    Py_DECREF(s);
+    if (!items) return nullptr;
+    Py_ssize_t n = PyTuple_GET_SIZE(items);
+    PyObject *frozen = PyTuple_New(n);
+    if (!frozen) {
+      Py_DECREF(items);
+      return nullptr;
+    }
+    for (Py_ssize_t i = 0; i < n; i++) {
+      PyObject *f = freeze_rec(PyTuple_GET_ITEM(items, i));
+      if (!f) {
+        Py_DECREF(items);
+        Py_DECREF(frozen);
+        return nullptr;
+      }
+      PyTuple_SET_ITEM(frozen, i, f);
+    }
+    Py_DECREF(items);
+    PyObject *out = PyObject_CallOneArg(g_rset_cls, frozen);
+    Py_DECREF(frozen);
+    return out;
+  }
+  if (PyAnySet_Check(v)) {
+    PyObject *items = PySequence_Tuple(v);
+    if (!items) return nullptr;
+    Py_ssize_t n = PyTuple_GET_SIZE(items);
+    PyObject *frozen = PyTuple_New(n);
+    if (!frozen) {
+      Py_DECREF(items);
+      return nullptr;
+    }
+    for (Py_ssize_t i = 0; i < n; i++) {
+      PyObject *f = freeze_rec(PyTuple_GET_ITEM(items, i));
+      if (!f) {
+        Py_DECREF(items);
+        Py_DECREF(frozen);
+        return nullptr;
+      }
+      PyTuple_SET_ITEM(frozen, i, f);
+    }
+    Py_DECREF(items);
+    PyObject *out = PyObject_CallOneArg(g_rset_cls, frozen);
+    Py_DECREF(frozen);
+    return out;
+  }
+  PyErr_Format(PyExc_TypeError, "cannot freeze %R", (PyObject *)Py_TYPE(v));
+  return nullptr;
+}
+
+PyObject *freeze_init(PyObject *, PyObject *args) {
+  PyObject *fd, *rs;
+  if (!PyArg_ParseTuple(args, "OO", &fd, &rs)) return nullptr;
+  Py_XDECREF(g_frozendict_cls);
+  Py_XDECREF(g_rset_cls);
+  Py_INCREF(fd);
+  Py_INCREF(rs);
+  g_frozendict_cls = fd;
+  g_rset_cls = rs;
+  Py_RETURN_NONE;
+}
+
+PyObject *freeze_core(PyObject *, PyObject *arg) {
+  if (!g_frozendict_cls || !g_rset_cls) {
+    PyErr_SetString(PyExc_RuntimeError, "freeze_init not called");
+    return nullptr;
+  }
+  return freeze_rec(arg);  // freeze_rec guards every recursion level
+}
+
 PyMethodDef methods[] = {
+    {"freeze_init", freeze_init, METH_VARARGS,
+     "register the FrozenDict and RSet classes"},
+    {"freeze_core", freeze_core, METH_O,
+     "JSON-like tree -> frozen Rego value (engine/value.py freeze)"},
     {"pack_reviews_core", pack_reviews_core, METH_VARARGS,
      "fill review-side fixed buffers; returns label pair flats+counts"},
     {"extract_scalar", extract_scalar, METH_VARARGS,

@@ -236,3 +236,110 @@ class TestEndToEndWithNative:
 
         assert run(True) == run(False)
         assert len(run(True)) == 7
+
+
+class TestFreezeDifferential:
+    """freeze_core (C) vs _freeze_py: identical values, types, hashes, and
+    errors over randomized and adversarial JSON-like trees."""
+
+    def _native_freeze(self):
+        from gatekeeper_tpu.native import load
+        mod = load()
+        if mod is None or not hasattr(mod, "freeze_core"):
+            pytest.skip("native extension unavailable")
+        from gatekeeper_tpu.engine.value import FrozenDict, RSet
+        mod.freeze_init(FrozenDict, RSet)
+        return mod.freeze_core
+
+    def test_randomized_trees(self):
+        import random
+        from gatekeeper_tpu.engine.value import _freeze_py
+        fz = self._native_freeze()
+        rng = random.Random(7)
+
+        def tree(depth):
+            roll = rng.random()
+            if depth > 4 or roll < 0.35:
+                return rng.choice([
+                    None, True, False, rng.randint(-10**12, 10**12),
+                    rng.random() * 100, float(rng.randint(0, 50)),
+                    "s" * rng.randint(0, 3), "λ-ünï", 2**70, -0.0,
+                ])
+            if roll < 0.6:
+                return [tree(depth + 1) for _ in range(rng.randint(0, 4))]
+            if roll < 0.9:
+                return {f"k{j}": tree(depth + 1) for j in range(rng.randint(0, 4))}
+            return {rng.randint(0, 9) for _ in range(rng.randint(0, 3))}
+
+        for _ in range(300):
+            t = tree(0)
+            a, b = fz(t), _freeze_py(t)
+            assert type(a) is type(b)
+            assert a == b
+            try:
+                assert hash(a) == hash(b)
+            except TypeError:
+                pass  # unhashable only if both are (they're frozen: never)
+
+    def test_integral_float_canonicalization(self):
+        from gatekeeper_tpu.engine.value import _freeze_py
+        fz = self._native_freeze()
+        for v in (1.0, -3.0, 0.0, 2.0**53, 1e308 // 1, 1.5, float("1e20")):
+            a, b = fz(v), _freeze_py(v)
+            assert type(a) is type(b) and a == b, v
+
+    def test_frozen_passthrough_and_errors(self):
+        from gatekeeper_tpu.engine.value import _freeze_py
+        fz = self._native_freeze()
+        fd = _freeze_py({"a": [1, {"b": {2}}]})
+        assert fz(fd) == fd
+        assert fz({"outer": fd})["outer"] == fd
+        with pytest.raises(TypeError):
+            fz(object())
+        with pytest.raises(TypeError):
+            fz({"x": b"bytes"})
+
+    def test_deep_recursion_raises_not_crashes(self):
+        fz = self._native_freeze()
+        deep = None
+        for _ in range(100000):
+            deep = [deep]
+        with pytest.raises(RecursionError):
+            fz(deep)
+
+    def test_frozen_dict_with_raw_values_is_rebuilt(self):
+        # a FrozenDict constructed around raw values must come out
+        # deep-frozen (oracle behavior), never passed through
+        from gatekeeper_tpu.engine.value import FrozenDict, _freeze_py
+        fz = self._native_freeze()
+        raw = FrozenDict({"a": [1, {"b": 2}]})
+        a, b = fz(raw), _freeze_py(raw)
+        assert a == b
+        assert isinstance(a["a"], tuple)
+        assert type(a["a"][1]).__name__ == "FrozenDict"
+
+    def test_concurrent_mutation_does_not_crash(self):
+        """Freezing a list that another thread is resizing must never
+        dereference a stale item pointer (snapshot-before-iterate)."""
+        import threading
+        fz = self._native_freeze()
+        shared = [{"k": [i]} for i in range(64)]
+        stop = threading.Event()
+
+        def mutator():
+            i = 0
+            while not stop.is_set():
+                shared.append({"k": [i]})
+                if len(shared) > 256:
+                    del shared[:128]
+                i += 1
+
+        t = threading.Thread(target=mutator, daemon=True)
+        t.start()
+        try:
+            for _ in range(2000):
+                out = fz(shared)  # snapshot semantics: some valid prefix
+                assert isinstance(out, tuple)
+        finally:
+            stop.set()
+            t.join(timeout=5)
