@@ -415,7 +415,15 @@ def bench_curve() -> dict:
         for t, k in zip(templates, constraints):
             c.add_template(t)
             c.add_constraint(k)
-        handler = ValidationHandler(c, kube=InMemoryKube())
+        kube = InMemoryKube()
+        # the review's namespace must exist: a missing namespace sends
+        # every request down the error path (LookupError + traceback
+        # logging), and the curve would measure THAT instead of policy
+        # evaluation (the reference benchmark's fakeNsGetter always
+        # succeeds, policy_benchmark_test.go:52-66)
+        kube.create({"apiVersion": "v1", "kind": "Namespace",
+                     "metadata": {"name": req["namespace"]}})
+        handler = ValidationHandler(c, kube=kube)
         iters = max(10, min(100, 20000 // max(n, 1)))
         for _ in range(3):
             handler.handle(req)

@@ -260,6 +260,11 @@ def _resolve_freeze():
         if mod is not None and hasattr(mod, "freeze_core"):
             mod.freeze_init(FrozenDict, RSet)
             return mod.freeze_core
+        if os.environ.get("GK_NATIVE") == "require":
+            raise RuntimeError(
+                "GK_NATIVE=require but the loaded extension lacks "
+                "freeze_core (stale _gknative.so?)"
+            )
     except Exception:
         if os.environ.get("GK_NATIVE") == "require":
             raise

@@ -608,6 +608,73 @@ PyObject *g_rset_cls = nullptr;
 
 PyObject *freeze_rec_guarded(PyObject *v);
 
+PyObject *freeze_rec(PyObject *v);
+
+// snapshot an iterable's items and freeze each into a fresh tuple; shared
+// by the list/tuple, set, and RSet branches
+PyObject *freeze_items_tuple(PyObject *iterable) {
+  PyObject *snap = PySequence_Tuple(iterable);
+  if (!snap) return nullptr;
+  Py_ssize_t n = PyTuple_GET_SIZE(snap);
+  PyObject *out = PyTuple_New(n);
+  if (!out) {
+    Py_DECREF(snap);
+    return nullptr;
+  }
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *f = freeze_rec(PyTuple_GET_ITEM(snap, i));
+    if (!f) {
+      Py_DECREF(snap);
+      Py_DECREF(out);
+      return nullptr;
+    }
+    PyTuple_SET_ITEM(out, i, f);
+  }
+  Py_DECREF(snap);
+  return out;
+}
+
+// snapshot a dict's items and deep-freeze into a new FrozenDict; iterating
+// a live dict across Python re-entry is unsafe under mutation
+PyObject *freeze_dict_items(PyObject *d) {
+  PyObject *items = PyDict_Items(d);
+  if (!items) return nullptr;
+  PyObject *inner = PyDict_New();
+  if (!inner) {
+    Py_DECREF(items);
+    return nullptr;
+  }
+  Py_ssize_t n = PyList_GET_SIZE(items);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *pair = PyList_GET_ITEM(items, i);
+    PyObject *fk = freeze_rec(PyTuple_GET_ITEM(pair, 0));
+    if (!fk) {
+      Py_DECREF(items);
+      Py_DECREF(inner);
+      return nullptr;
+    }
+    PyObject *fv = freeze_rec(PyTuple_GET_ITEM(pair, 1));
+    if (!fv) {
+      Py_DECREF(fk);
+      Py_DECREF(items);
+      Py_DECREF(inner);
+      return nullptr;
+    }
+    int rc = PyDict_SetItem(inner, fk, fv);
+    Py_DECREF(fk);
+    Py_DECREF(fv);
+    if (rc < 0) {
+      Py_DECREF(items);
+      Py_DECREF(inner);
+      return nullptr;
+    }
+  }
+  Py_DECREF(items);
+  PyObject *out = PyObject_CallOneArg(g_frozendict_cls, inner);
+  Py_DECREF(inner);
+  return out;
+}
+
 PyObject *freeze_rec(PyObject *v) {
   // per-level recursion guard: arbitrarily deep user JSON must raise
   // RecursionError, not smash the C stack
@@ -634,28 +701,15 @@ PyObject *freeze_rec_guarded(PyObject *v) {
     return v;
   }
   if (PyList_Check(v) || PyTuple_Check(v)) {
-    // snapshot first: freezing nested dicts calls back into Python
-    // (FrozenDict construction), which may release the eval lock to a
-    // thread mutating this very list — a cached item pointer would dangle
-    PyObject *snap = PySequence_Tuple(v);
-    if (!snap) return nullptr;
-    Py_ssize_t n = PyTuple_GET_SIZE(snap);
-    PyObject *out = PyTuple_New(n);
-    if (!out) {
-      Py_DECREF(snap);
-      return nullptr;
-    }
-    for (Py_ssize_t i = 0; i < n; i++) {
-      PyObject *f = freeze_rec(PyTuple_GET_ITEM(snap, i));
-      if (!f) {
-        Py_DECREF(snap);
-        Py_DECREF(out);
-        return nullptr;
-      }
-      PyTuple_SET_ITEM(out, i, f);
-    }
-    Py_DECREF(snap);
-    return out;
+    // snapshot-before-iterate: freezing nested dicts calls back into
+    // Python, which may release the eval lock to a thread mutating this
+    // very list — a cached item pointer would dangle
+    return freeze_items_tuple(v);
+  }
+  if (PyDict_Check(v)) {
+    // hot path first: plain dicts dominate K8s-object input; the generic
+    // isinstance checks below only matter for the rare frozen inputs
+    return freeze_dict_items(v);
   }
   // frozen containers are REBUILT like the Python oracle does: a
   // FrozenDict constructed directly around raw values must come out
@@ -664,100 +718,26 @@ PyObject *freeze_rec_guarded(PyObject *v) {
   if (is_fd < 0) return nullptr;
   int is_rs = is_fd ? 0 : PyObject_IsInstance(v, g_rset_cls);
   if (is_rs < 0) return nullptr;
-  PyObject *dict_src = nullptr;  // borrowed semantics handled below
   if (is_fd) {
-    dict_src = PyObject_GetAttrString(v, "_d");
-    if (!dict_src) return nullptr;
-  } else if (PyDict_Check(v)) {
-    dict_src = v;
-    Py_INCREF(dict_src);
-  }
-  if (dict_src) {
-    // snapshot items: freezing values runs Python, and iterating a live
-    // dict across that is unsafe under mutation
-    PyObject *items = PyDict_Items(dict_src);
-    Py_DECREF(dict_src);
-    if (!items) return nullptr;
-    PyObject *inner = PyDict_New();
-    if (!inner) {
-      Py_DECREF(items);
-      return nullptr;
-    }
-    Py_ssize_t n = PyList_GET_SIZE(items);
-    for (Py_ssize_t i = 0; i < n; i++) {
-      PyObject *pair = PyList_GET_ITEM(items, i);
-      PyObject *fk = freeze_rec(PyTuple_GET_ITEM(pair, 0));
-      if (!fk) {
-        Py_DECREF(items);
-        Py_DECREF(inner);
-        return nullptr;
-      }
-      PyObject *fv = freeze_rec(PyTuple_GET_ITEM(pair, 1));
-      if (!fv) {
-        Py_DECREF(fk);
-        Py_DECREF(items);
-        Py_DECREF(inner);
-        return nullptr;
-      }
-      int rc = PyDict_SetItem(inner, fk, fv);
-      Py_DECREF(fk);
-      Py_DECREF(fv);
-      if (rc < 0) {
-        Py_DECREF(items);
-        Py_DECREF(inner);
-        return nullptr;
-      }
-    }
-    Py_DECREF(items);
-    PyObject *out = PyObject_CallOneArg(g_frozendict_cls, inner);
-    Py_DECREF(inner);
+    PyObject *d = PyObject_GetAttrString(v, "_d");
+    if (!d) return nullptr;
+    PyObject *out = freeze_dict_items(d);
+    Py_DECREF(d);
     return out;
   }
   if (is_rs) {
     PyObject *s = PyObject_GetAttrString(v, "_s");
     if (!s) return nullptr;
-    PyObject *items = PySequence_Tuple(s);
+    PyObject *frozen = freeze_items_tuple(s);
     Py_DECREF(s);
-    if (!items) return nullptr;
-    Py_ssize_t n = PyTuple_GET_SIZE(items);
-    PyObject *frozen = PyTuple_New(n);
-    if (!frozen) {
-      Py_DECREF(items);
-      return nullptr;
-    }
-    for (Py_ssize_t i = 0; i < n; i++) {
-      PyObject *f = freeze_rec(PyTuple_GET_ITEM(items, i));
-      if (!f) {
-        Py_DECREF(items);
-        Py_DECREF(frozen);
-        return nullptr;
-      }
-      PyTuple_SET_ITEM(frozen, i, f);
-    }
-    Py_DECREF(items);
+    if (!frozen) return nullptr;
     PyObject *out = PyObject_CallOneArg(g_rset_cls, frozen);
     Py_DECREF(frozen);
     return out;
   }
   if (PyAnySet_Check(v)) {
-    PyObject *items = PySequence_Tuple(v);
-    if (!items) return nullptr;
-    Py_ssize_t n = PyTuple_GET_SIZE(items);
-    PyObject *frozen = PyTuple_New(n);
-    if (!frozen) {
-      Py_DECREF(items);
-      return nullptr;
-    }
-    for (Py_ssize_t i = 0; i < n; i++) {
-      PyObject *f = freeze_rec(PyTuple_GET_ITEM(items, i));
-      if (!f) {
-        Py_DECREF(items);
-        Py_DECREF(frozen);
-        return nullptr;
-      }
-      PyTuple_SET_ITEM(frozen, i, f);
-    }
-    Py_DECREF(items);
+    PyObject *frozen = freeze_items_tuple(v);
+    if (!frozen) return nullptr;
     PyObject *out = PyObject_CallOneArg(g_rset_cls, frozen);
     Py_DECREF(frozen);
     return out;

@@ -276,10 +276,9 @@ class TestFreezeDifferential:
             a, b = fz(t), _freeze_py(t)
             assert type(a) is type(b)
             assert a == b
-            try:
-                assert hash(a) == hash(b)
-            except TypeError:
-                pass  # unhashable only if both are (they're frozen: never)
+            # frozen values are always hashable; a one-sided TypeError
+            # here is exactly the parity break this test exists to catch
+            assert hash(a) == hash(b)
 
     def test_integral_float_canonicalization(self):
         from gatekeeper_tpu.engine.value import _freeze_py
