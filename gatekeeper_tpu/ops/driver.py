@@ -106,6 +106,11 @@ class TpuDriver(InterpDriver):
         # is hashed once and each constraint lookup is O(1).
         self._review_memo: Dict[Tuple, list] = {}
         self._review_memo_epoch = -1
+        # whole-request memo (see _request_memoable): content -> rendered
+        # entries for the ENTIRE constraint battery
+        self._request_memo: Dict[Tuple, list] = {}
+        self._request_memo_epoch = -1
+        self._request_memo_ok = None
         # constraint-side packing is invalidated on any template/constraint
         # mutation and on vocabulary growth (str-pred tables are vocab-sized)
         self._cs_epoch = 0
@@ -474,6 +479,23 @@ class TpuDriver(InterpDriver):
             frozen_review, freeze(params), inventory
         )
 
+    @staticmethod
+    def _cell_memoable(tmpl, constraint: dict) -> bool:
+        """A (constraint, object) verdict is content-determined iff the
+        template's policy is memo-safe and inventory-free and the match
+        spec carries no namespaceSelector — PRESENCE check, not truthiness:
+        an empty selector ({}) still consults the mutable namespace cache
+        (target/match.py presence semantics), so a memoized verdict could
+        outlive a namespace sync."""
+        if tmpl is None:
+            return False
+        if not getattr(tmpl.policy, "memo_safe", False):
+            return False
+        if getattr(tmpl.policy, "uses_inventory", True):
+            return False
+        match = (constraint.get("spec") or {}).get("match") or {}
+        return "namespaceSelector" not in match
+
     def _render_cell(
         self,
         results: List[Result],
@@ -496,16 +518,7 @@ class TpuDriver(InterpDriver):
         # per-request metadata (uid) so real admission traffic, where every
         # request has a fresh uid, still hits.
         tmpl = self.templates.get(kind)
-        uses_inv = (
-            True if tmpl is None
-            else getattr(tmpl.policy, "uses_inventory", True)
-        )
-        memo_safe = (
-            False if tmpl is None
-            else getattr(tmpl.policy, "memo_safe", False)
-        )
-        match = (constraint.get("spec") or {}).get("match") or {}
-        if not uses_inv and memo_safe and not match.get("namespaceSelector"):
+        if self._cell_memoable(tmpl, constraint):
             if self._review_memo_epoch != self._cs_epoch:
                 self._review_memo.clear()
                 self._review_memo_epoch = self._cs_epoch
@@ -546,20 +559,63 @@ class TpuDriver(InterpDriver):
     def review(self, review: dict, tracing: bool = False):
         return self.review_batch([review], tracing=tracing)[0]
 
+    # whole-request memo size bound (entries are per unique object content)
+    REQUEST_MEMO_MAX = 8192
+
+    def _request_memoable(self) -> bool:
+        """True when a whole request's verdict depends ONLY on its content:
+        every installed template's policy is memo-safe and inventory-free,
+        and no constraint carries a namespaceSelector (whose match — and
+        autoreject — consult the mutable namespace cache).  Then the entire
+        C-constraint walk can be served from one dict hit, which is what
+        keeps p50 flat for replica/retry storms at large constraint counts
+        (the reference re-runs the full Rego scan per request,
+        target_template_source.go:27-44)."""
+        flag = self._request_memo_ok
+        if flag is None:
+            flag = all(
+                self._cell_memoable(self.templates.get(kind), constraint)
+                for kind, by_name in self.constraints.items()
+                for constraint in by_name.values()
+            )
+            self._request_memo_ok = flag
+        return flag
+
     def _interp_review_memo(self, review: dict):
         """InterpDriver.review semantics served through the content-keyed
-        render memo: the hybrid small-batch path and the async-compile
+        render memos: the hybrid small-batch path and the async-compile
         fallback — i.e. ordinary single admission requests — skip
-        re-evaluating (constraint, object) cells they have seen before.
+        re-evaluating (constraint, object) cells they have seen before,
+        and when every cell is content-determined the whole constraint
+        walk collapses to one request-level memo hit.
         Traced reviews go to the oracle directly (drivers.py review)."""
         from ..engine.value import freeze
 
         with self._lock:
             inventory = self.store.frozen()
             cached_ns = self.store.cached_namespace
-            results: List[Result] = []
             frozen_review = freeze(review)
             memo_review = _strip_request_meta(frozen_review)
+            if self._request_memo_epoch != self._cs_epoch:
+                self._request_memo.clear()
+                self._request_memo_ok = None
+                self._request_memo_epoch = self._cs_epoch
+            memoable = self._request_memoable()
+            if memoable:
+                hit = self._request_memo.get(memo_review)
+                if hit is not None:
+                    # metadata dicts are rebuilt per hit: handing out the
+                    # cached dict by reference would let a consumer's
+                    # mutation corrupt every later replay
+                    return [
+                        Result(
+                            msg=msg, metadata={"details": details},
+                            constraint=constraint, review=review,
+                            enforcement_action=action,
+                        )
+                        for msg, details, constraint, action in hit
+                    ], None
+            results: List[Result] = []
             for kind in sorted(self.constraints):
                 for name in sorted(self.constraints[kind]):
                     constraint = self.constraints[kind][name]
@@ -582,6 +638,14 @@ class TpuDriver(InterpDriver):
                         results, constraint, kind, review, frozen_review,
                         inventory, None, memo_review=memo_review,
                     )
+            if memoable:
+                if len(self._request_memo) >= self.REQUEST_MEMO_MAX:
+                    self._request_memo.clear()
+                self._request_memo[memo_review] = [
+                    (r.msg, (r.metadata or {}).get("details", {}),
+                     r.constraint, r.enforcement_action)
+                    for r in results
+                ]
             return results, None
 
     # Below this many constraint x review cells the device dispatch costs

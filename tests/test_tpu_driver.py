@@ -352,3 +352,102 @@ violation[{"msg": "no-labels"}] {
     # the safe policy hit the memo across differing uids
     assert any(k[0] == "Safe" for k in c.driver._review_memo)
     assert not any(k[0] in ("Uidy", "Clocky") for k in c.driver._review_memo)
+
+
+class TestRequestMemo:
+    """Whole-request memo: identical-content admissions collapse the full
+    constraint walk to one dict hit — with strict validity gating."""
+
+    def _client(self, n=6):
+        from gatekeeper_tpu.client.client import Client
+        from gatekeeper_tpu.ops.driver import TpuDriver
+        from gatekeeper_tpu.util.synthetic import make_templates
+
+        templates, constraints = make_templates(n, seed=13)
+        c = Client(driver=TpuDriver())
+        for t, k in zip(templates, constraints):
+            c.add_template(t)
+            c.add_constraint(k)
+        return c
+
+    def _req(self, pod, uid="u1"):
+        return {"uid": uid,
+                "kind": {"group": "", "version": "v1", "kind": "Pod"},
+                "name": pod["metadata"]["name"],
+                "namespace": pod["metadata"].get("namespace", "default"),
+                "operation": "CREATE", "object": pod}
+
+    def test_hit_rebinds_review_and_matches_oracle(self):
+        from gatekeeper_tpu.client.client import Client
+        from gatekeeper_tpu.client.drivers import InterpDriver
+        from gatekeeper_tpu.util.synthetic import make_pods, make_templates
+
+        c = self._client()
+        templates, constraints = make_templates(6, seed=13)
+        ci = Client(driver=InterpDriver())
+        for t, k in zip(templates, constraints):
+            ci.add_template(t)
+            ci.add_constraint(k)
+        pod = make_pods(1, seed=13, violation_rate=1.0)[0]
+        r1 = c.review(self._req(pod, uid="a")).results()
+        assert c.driver._request_memo  # populated
+        r2 = c.review(self._req(pod, uid="b")).results()  # memo hit
+        want = ci.review(self._req(pod, uid="b")).results()
+        key = lambda rs: sorted((x.constraint["metadata"]["name"], x.msg) for x in rs)
+        assert key(r1) == key(r2) == key(want)
+        # the hit's results are bound to the NEW request (fresh uid)
+        assert all(x.review["uid"] == "b" for x in r2)
+
+    def test_constraint_update_invalidates(self):
+        from gatekeeper_tpu.util.synthetic import make_pods
+
+        c = self._client()
+        pod = make_pods(1, seed=13, violation_rate=1.0)[0]
+        n1 = len(c.review(self._req(pod)).results())
+        assert n1 > 0
+        # removing the violated constraints must change the verdict
+        for kind in list(c.driver.constraints):
+            for name in list(c.driver.constraints[kind]):
+                c.remove_constraint(c.driver.constraints[kind][name])
+        assert c.review(self._req(pod)).results() == []
+
+    def test_not_memoable_with_namespace_selector(self):
+        from gatekeeper_tpu.util.synthetic import make_pods
+
+        c = self._client()
+        kind = next(iter(c.driver.constraints))
+        name = next(iter(c.driver.constraints[kind]))
+        cons = c.driver.constraints[kind][name]
+        import copy
+        cons2 = copy.deepcopy(cons)
+        cons2["spec"].setdefault("match", {})["namespaceSelector"] = {
+            "matchLabels": {"team": "x"}}
+        c.add_constraint(cons2)
+        pod = make_pods(1, seed=13)[0]
+        c.review(self._req(pod))
+        assert c.driver._request_memo_ok is False
+        assert not c.driver._request_memo
+
+    def test_not_memoable_with_wallclock_policy(self):
+        from gatekeeper_tpu.util.synthetic import make_pods
+
+        c = self._client()
+        c.add_template({
+            "apiVersion": "templates.gatekeeper.sh/v1beta1",
+            "kind": "ConstraintTemplate",
+            "metadata": {"name": "k8sclocky"},
+            "spec": {"crd": {"spec": {"names": {"kind": "K8sClocky"}}},
+                     "targets": [{"target": "admission.k8s.gatekeeper.sh",
+                                  "rego": """
+package k8sclocky
+
+violation[{"msg": "tick"}] { time.now_ns() > 0 }
+"""}]}})
+        c.add_constraint({
+            "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+            "kind": "K8sClocky", "metadata": {"name": "clock"},
+            "spec": {"match": {"kinds": [
+                {"apiGroups": [""], "kinds": ["Pod"]}]}}})
+        pod = make_pods(1, seed=13)[0]
+        c.review(self._req(pod))
+        assert c.driver._request_memo_ok is False
