@@ -19,14 +19,37 @@ from .value import FrozenDict, RSet, UNDEFINED, compare, format_value, is_number
 
 
 class BuiltinError(Exception):
-    pass
+    """Makes the calling expression undefined (OPA non-strict topdown)."""
+
+
+class BuiltinLimitError(Exception):
+    """Engine resource limit exceeded: propagates as a whole-query error
+    (fail closed, like max evaluation depth) instead of the silent
+    builtin-error -> undefined path — a policy hitting a capacity cap
+    must not quietly stop firing its violation rules."""
 
 
 REGISTRY: Dict[tuple, Callable] = {}
 
 
-def builtin(*path: str):
+def builtin(*path: str, arity: int = None):
+    """Register a builtin under `path`, stamping its declared input arity
+    as fn._rego_arity.  The interpreter's output-argument dispatch and the
+    safety reorderer read the stamp instead of introspecting __code__, so
+    a builtin written with *args or defaults cannot silently misreport —
+    such functions must pass arity= explicitly or registration fails."""
+
     def deco(fn):
+        if arity is None:
+            code = fn.__code__
+            if (code.co_flags & 0x04) or fn.__defaults__:
+                raise TypeError(
+                    f"builtin {'.'.join(path)}: uses *args/defaults; "
+                    "declare arity= explicitly"
+                )
+            fn._rego_arity = code.co_argcount
+        else:
+            fn._rego_arity = arity
         REGISTRY[path] = fn
         return fn
 
@@ -736,10 +759,11 @@ def lookup(path: tuple):
 
 # --------------------------------------------------------------------------
 # OPA v0.21 registry completion (vendored opa/ast/builtins.go).  Infix
-# operators (plus/minus/eq/...) are native BinOps; http.send and the
-# RSA/ECDSA crypto family are environment-blocked (no egress, no crypto
-# library) and stubbed to a BuiltinError so policies see undefined rather
-# than silently-wrong results.
+# operators (plus/minus/eq/...) are native BinOps; the RSA/ECDSA JWT and
+# X.509 families ride the installed `cryptography` package; only
+# http.send (no egress) and regex.globs_match remain stubbed to a
+# BuiltinError so policies see undefined rather than silently-wrong
+# results.
 # --------------------------------------------------------------------------
 
 
@@ -930,14 +954,24 @@ def _bits_negate(a):
     return ~_int_arg(a, "bits.negate")
 
 
+def _shift_arg(n: Any, who: str) -> int:
+    """Shift counts must be non-negative (Python << raises ValueError,
+    which would surface as a whole-query error instead of OPA's
+    builtin-error -> undefined) and bounded (bits.lsh(1, 10**9) would
+    allocate a gigantic int)."""
+    v = _int_arg(n, who)
+    _need(0 <= v <= 1 << 20, f"{who}: shift count out of range")
+    return v
+
+
 @builtin("bits", "lsh")
 def _bits_lsh(a, n):
-    return _int_arg(a, "bits.lsh") << _int_arg(n, "bits.lsh")
+    return _int_arg(a, "bits.lsh") << _shift_arg(n, "bits.lsh")
 
 
 @builtin("bits", "rsh")
 def _bits_rsh(a, n):
-    return _int_arg(a, "bits.rsh") >> _int_arg(n, "bits.rsh")
+    return _int_arg(a, "bits.rsh") >> _shift_arg(n, "bits.rsh")
 
 
 # ---- objects / json documents --------------------------------------------
@@ -1098,7 +1132,15 @@ def _net_cidr_overlap(cidr: Any, ip: Any):
 @builtin("net", "cidr_expand")
 def _net_cidr_expand(cidr: Any):
     n = _parse_net(cidr, "net.cidr_expand")
-    _need(n.num_addresses <= 65536, "net.cidr_expand: network too large")
+    if n.num_addresses > 65536:
+        # OPA expands any size; this engine caps at a /16.  Fail CLOSED
+        # (whole-query error) rather than undefined, so a policy
+        # expanding e.g. a /15 errors loudly instead of its violation
+        # rule silently never firing.  Documented in docs/rego.md.
+        raise BuiltinLimitError(
+            "net.cidr_expand: network larger than 65536 addresses "
+            "(engine cap; OPA would expand it)"
+        )
     return RSet({str(h) for h in n})
 
 
@@ -1347,23 +1389,15 @@ def _glob_quote_meta(s: Any):
     return re.sub(r"([*?\[\]{}\\])", r"\\\1", s)
 
 
-# ---- JWT (HMAC family only: no RSA/ECDSA library in this image) -----------
+# ---- JWT (HMAC family: stdlib hmac; asymmetric family further down) -------
 
 
 def _jwt_parts(token: Any, who: str):
-    import base64
-
     _need(isinstance(token, str), f"{who}: not a string")
     parts = token.split(".")
     _need(len(parts) == 3, f"{who}: not a JWS compact token")
-
-    def dec(x):
-        return base64.urlsafe_b64decode(x + "=" * (-len(x) % 4))
-
-    try:
-        return dec(parts[0]), dec(parts[1]), dec(parts[2]), parts
-    except Exception as e:
-        raise BuiltinError(f"{who}: {e}")
+    return (_b64u_decode(parts[0], who), _b64u_decode(parts[1], who),
+            _b64u_decode(parts[2], who), parts)
 
 
 @builtin("io", "jwt", "decode")
@@ -1418,33 +1452,601 @@ def _io_jwt_verify_hs512(token: Any, secret: Any):
     return _jwt_verify_hs(token, secret, "HS512", hashlib.sha512)
 
 
-def _unsupported_builtin(name: str, why: str):
+# ---- JWT asymmetric family + X.509 (the installed `cryptography`
+# package — the same library certs/rotator.py uses for serving certs).
+# Semantics pinned to the reference's vendored OPA topdown
+# (opa/topdown/tokens.go, opa/topdown/crypto.go). ------------------------
+
+
+def _b64u_decode(s: str, who: str) -> bytes:
+    import base64
+
+    try:
+        return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+    except Exception as e:
+        raise BuiltinError(f"{who}: bad base64url: {e}")
+
+
+def _b64u_encode(b: bytes) -> str:
+    import base64
+
+    return base64.urlsafe_b64encode(b).rstrip(b"=").decode()
+
+
+def _b64u_uint(s: str, who: str) -> int:
+    return int.from_bytes(_b64u_decode(s, who), "big")
+
+
+def _jwk_field(jwk: dict, field: str, who: str) -> str:
+    v = jwk.get(field)
+    _need(isinstance(v, str), f"{who}: JWK missing field {field!r}")
+    return v
+
+
+def _jwk_public_key(jwk: dict, who: str):
+    """JWK -> cryptography public key (RSA / EC), or raw bytes for oct."""
+    from cryptography.hazmat.primitives.asymmetric import ec, rsa
+
+    kty = jwk.get("kty")
+    if kty == "RSA":
+        n = _b64u_uint(_jwk_field(jwk, "n", who), who)
+        e = _b64u_uint(_jwk_field(jwk, "e", who), who)
+        return rsa.RSAPublicNumbers(e, n).public_key()
+    if kty == "EC":
+        curve = _ec_curves().get(jwk.get("crv"))
+        _need(curve is not None, f"{who}: unsupported EC curve {jwk.get('crv')}")
+        x = _b64u_uint(_jwk_field(jwk, "x", who), who)
+        y = _b64u_uint(_jwk_field(jwk, "y", who), who)
+        return ec.EllipticCurvePublicNumbers(x, y, curve()).public_key()
+    if kty == "oct":
+        return _b64u_decode(_jwk_field(jwk, "k", who), who)
+    raise BuiltinError(f"{who}: unsupported JWK kty {kty!r}")
+
+
+def _jwk_private_key(jwk: dict, who: str):
+    """JWK -> cryptography private key (RSA / EC), or raw bytes for oct."""
+    from cryptography.hazmat.primitives.asymmetric import ec, rsa
+
+    kty = jwk.get("kty")
+    if kty == "RSA":
+        n = _b64u_uint(_jwk_field(jwk, "n", who), who)
+        e = _b64u_uint(_jwk_field(jwk, "e", who), who)
+        d = _b64u_uint(_jwk_field(jwk, "d", who), who)
+        if "p" in jwk and "q" in jwk:
+            p = _b64u_uint(_jwk_field(jwk, "p", who), who)
+            q = _b64u_uint(_jwk_field(jwk, "q", who), who)
+        else:
+            p, q = rsa.rsa_recover_prime_factors(n, e, d)
+        dmp1 = _b64u_uint(jwk["dp"], who) if "dp" in jwk else rsa.rsa_crt_dmp1(d, p)
+        dmq1 = _b64u_uint(jwk["dq"], who) if "dq" in jwk else rsa.rsa_crt_dmq1(d, q)
+        iqmp = _b64u_uint(jwk["qi"], who) if "qi" in jwk else rsa.rsa_crt_iqmp(p, q)
+        pub = rsa.RSAPublicNumbers(e, n)
+        return rsa.RSAPrivateNumbers(p, q, d, dmp1, dmq1, iqmp, pub).private_key()
+    if kty == "EC":
+        curve = _ec_curves().get(jwk.get("crv"))
+        _need(curve is not None, f"{who}: unsupported EC curve {jwk.get('crv')}")
+        return ec.derive_private_key(
+            _b64u_uint(_jwk_field(jwk, "d", who), who), curve())
+    if kty == "oct":
+        return _b64u_decode(_jwk_field(jwk, "k", who), who)
+    raise BuiltinError(f"{who}: unsupported JWK kty {kty!r}")
+
+
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=1)
+def _ec_curves() -> dict:
+    from cryptography.hazmat.primitives.asymmetric import ec
+
+    return {"P-256": ec.SECP256R1, "P-384": ec.SECP384R1, "P-521": ec.SECP521R1}
+
+
+def _verification_keys(cert: Any, who: str) -> list:
+    """tokens.go getKeysFromCertOrJWK: the `cert` argument is a PEM
+    certificate, a PEM public key, or a JWK/JWKS JSON string.  Returns a
+    list of candidate keys (public keys, or bytes for oct JWKs)."""
+    import json
+
+    _need(isinstance(cert, str), f"{who}: key material not a string")
+    if "-----BEGIN CERTIFICATE" in cert:
+        from cryptography import x509
+
+        try:
+            certs = x509.load_pem_x509_certificates(cert.encode())
+        except Exception as e:
+            raise BuiltinError(f"{who}: bad certificate: {e}")
+        return [c.public_key() for c in certs]
+    if "-----BEGIN" in cert:
+        from cryptography.hazmat.primitives import serialization
+
+        try:
+            return [serialization.load_pem_public_key(cert.encode())]
+        except Exception as e:
+            raise BuiltinError(f"{who}: bad public key PEM: {e}")
+    try:
+        doc = json.loads(cert)
+    except json.JSONDecodeError as e:
+        raise BuiltinError(f"{who}: key is neither PEM nor JWK JSON: {e}")
+    _need(isinstance(doc, dict), f"{who}: JWK document must be an object")
+    jwks = doc.get("keys") if "keys" in doc else [doc]
+    _need(isinstance(jwks, list) and jwks, f"{who}: empty JWKS")
+    return [_jwk_public_key(j, who) for j in jwks]
+
+
+def _hash_for(alg: str):
+    from cryptography.hazmat.primitives import hashes
+
+    return {"256": hashes.SHA256(), "384": hashes.SHA384(),
+            "512": hashes.SHA512()}[alg[-3:]]
+
+
+def _verify_one(key, alg: str, signing_input: bytes, sig: bytes) -> bool:
+    """Verify one candidate key against a JWS signature; False on mismatch
+    or a key type that cannot carry this algorithm."""
+    import hashlib
+    import hmac as hmac_mod
+
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives.asymmetric import ec, padding, rsa, utils
+
+    chash = _hash_for(alg)
+    fam = alg[:2]
+    try:
+        if fam == "HS":
+            if not isinstance(key, (bytes, bytearray)):
+                return False
+            digest = getattr(hashlib, chash.name.replace("-", ""))
+            want = hmac_mod.new(bytes(key), signing_input, digest).digest()
+            return hmac_mod.compare_digest(want, sig)
+        if fam == "RS":
+            if not isinstance(key, rsa.RSAPublicKey):
+                return False
+            key.verify(sig, signing_input, padding.PKCS1v15(), chash)
+            return True
+        if fam == "PS":
+            if not isinstance(key, rsa.RSAPublicKey):
+                return False
+            # AUTO salt detection: Go's rsa.VerifyPSS (the reference path)
+            # accepts any salt length, not just digest_size
+            key.verify(
+                sig, signing_input,
+                padding.PSS(mgf=padding.MGF1(chash),
+                            salt_length=padding.PSS.AUTO),
+                chash,
+            )
+            return True
+        if fam == "ES":
+            if not isinstance(key, ec.EllipticCurvePublicKey):
+                return False
+            # JWS ECDSA signatures are raw R||S (RFC 7518 section 3.4)
+            half = len(sig) // 2
+            if half == 0 or len(sig) % 2:
+                return False
+            der = utils.encode_dss_signature(
+                int.from_bytes(sig[:half], "big"),
+                int.from_bytes(sig[half:], "big"),
+            )
+            key.verify(der, signing_input, ec.ECDSA(chash))
+            return True
+    except InvalidSignature:
+        return False
+    except Exception:
+        return False
+    return False
+
+
+def _jwt_verify_asym(token: Any, cert: Any, alg: str) -> bool:
+    import json
+
+    who = f"io.jwt.verify_{alg.lower()}"
+    header_b, _payload_b, sig_b, parts = _jwt_parts(token, who)
+    keys = _verification_keys(cert, who)
+    try:
+        header = json.loads(header_b)
+    except json.JSONDecodeError:
+        return False
+    if header.get("alg") != alg:
+        return False
+    signing_input = (parts[0] + "." + parts[1]).encode()
+    return any(_verify_one(k, alg, signing_input, sig_b) for k in keys)
+
+
+def _register_jwt_verifiers():
+    for _alg in ("RS256", "RS384", "RS512", "PS256", "PS384", "PS512",
+                 "ES256", "ES384", "ES512"):
+        def _v(token: Any, cert: Any, _alg=_alg):
+            return _jwt_verify_asym(token, cert, _alg)
+
+        _v.__name__ = f"_io_jwt_verify_{_alg.lower()}"
+        builtin("io", "jwt", f"verify_{_alg.lower()}", arity=2)(_v)
+
+
+_register_jwt_verifiers()
+
+_JWS_ALGS = ("HS256", "HS384", "HS512", "RS256", "RS384", "RS512",
+             "PS256", "PS384", "PS512", "ES256", "ES384", "ES512")
+
+
+@builtin("io", "jwt", "decode_verify")
+def _io_jwt_decode_verify(token: Any, constraints: Any):
+    """tokens.go builtinJWTDecodeVerify: returns [valid, header, payload]
+    — [false, {}, {}] whenever signature or claim checks fail."""
+    import json
+
+    who = "io.jwt.decode_verify"
+    _need(isinstance(constraints, FrozenDict), f"{who}: constraints must be an object")
+    cons = _thaw(constraints)
+    unknown = set(cons) - {"cert", "secret", "alg", "iss", "aud", "time"}
+    _need(not unknown, f"{who}: unknown constraint keys {sorted(unknown)}")
+    _need("cert" in cons or "secret" in cons,
+          f"{who}: no verification key supplied (cert or secret)")
+
+    invalid = (False, FrozenDict({}), FrozenDict({}))
+    header_b, payload_b, sig_b, parts = _jwt_parts(token, who)
+    try:
+        header = json.loads(header_b)
+        payload = json.loads(payload_b)
+    except json.JSONDecodeError:
+        return invalid
+    if not isinstance(header, dict) or not isinstance(payload, dict):
+        return invalid
+    if "crit" in header:  # no crit extensions are understood here (or in OPA)
+        return invalid
+    alg = header.get("alg")
+    if alg not in _JWS_ALGS:
+        return invalid
+    if "alg" in cons and cons["alg"] != alg:
+        return invalid
+
+    if alg.startswith("HS"):
+        secret = cons.get("secret")
+        if not isinstance(secret, str):
+            return invalid
+        keys = [secret.encode()]
+    else:
+        if "cert" not in cons:
+            return invalid
+        keys = _verification_keys(cons["cert"], who)
+    signing_input = (parts[0] + "." + parts[1]).encode()
+    if not any(_verify_one(k, alg, signing_input, sig_b) for k in keys):
+        return invalid
+
+    # claim checks (tokens.go _verify: exp/nbf against time, iss, aud)
+    now_ns = cons.get("time", _time_now_ns())
+    if not is_number(now_ns):
+        raise BuiltinError(f"{who}: time constraint must be a number")
+    now_s = float(now_ns) / 1e9
+    if "iss" in cons and payload.get("iss") != cons["iss"]:
+        return invalid
+    aud = payload.get("aud")
+    if aud is not None:
+        want = cons.get("aud")
+        if want is None:
+            return invalid
+        auds = aud if isinstance(aud, list) else [aud]
+        if want not in auds:
+            return invalid
+    elif "aud" in cons:
+        return invalid
+    exp = payload.get("exp")
+    if exp is not None:
+        if not is_number(exp) or now_s >= float(exp):
+            return invalid
+    nbf = payload.get("nbf")
+    if nbf is not None:
+        if not is_number(nbf) or now_s < float(nbf):
+            return invalid
+    return (True, _freeze(header), _freeze(payload))
+
+
+def _jws_sign(header_json: bytes, payload_bytes: bytes, key, alg: str,
+              who: str) -> str:
+    import hashlib
+    import hmac as hmac_mod
+
+    from cryptography.hazmat.primitives.asymmetric import ec, padding, rsa, utils
+
+    _need(alg in _JWS_ALGS, f"{who}: unsupported alg {alg!r}")
+    chash = _hash_for(alg)
+    signing_input = (_b64u_encode(header_json) + "." +
+                     _b64u_encode(payload_bytes)).encode()
+    fam = alg[:2]
+    if fam == "HS":
+        _need(isinstance(key, (bytes, bytearray)),
+              f"{who}: {alg} needs an oct JWK")
+        digest = getattr(hashlib, chash.name.replace("-", ""))
+        sig = hmac_mod.new(bytes(key), signing_input, digest).digest()
+    elif fam in ("RS", "PS"):
+        _need(isinstance(key, rsa.RSAPrivateKey),
+              f"{who}: {alg} needs an RSA private JWK")
+        pad = (padding.PKCS1v15() if fam == "RS" else
+               padding.PSS(mgf=padding.MGF1(chash),
+                           salt_length=chash.digest_size))
+        sig = key.sign(signing_input, pad, chash)
+    else:  # ES
+        _need(isinstance(key, ec.EllipticCurvePrivateKey),
+              f"{who}: {alg} needs an EC private JWK")
+        der = key.sign(signing_input, ec.ECDSA(chash))
+        r, s = utils.decode_dss_signature(der)
+        nbytes = (key.curve.key_size + 7) // 8
+        sig = r.to_bytes(nbytes, "big") + s.to_bytes(nbytes, "big")
+    return signing_input.decode() + "." + _b64u_encode(sig)
+
+
+@builtin("io", "jwt", "encode_sign")
+def _io_jwt_encode_sign(headers: Any, payload: Any, key: Any):
+    import json
+
+    who = "io.jwt.encode_sign"
+    _need(isinstance(headers, FrozenDict), f"{who}: headers must be an object")
+    _need(isinstance(payload, FrozenDict), f"{who}: payload must be an object")
+    _need(isinstance(key, FrozenDict), f"{who}: key must be a JWK object")
+    hdr = _thaw(headers)
+    alg = hdr.get("alg")
+    _need(isinstance(alg, str), f"{who}: headers missing alg")
+    priv = _jwk_private_key(_thaw(key), who)
+    hdr_json = json.dumps(hdr, separators=(",", ":"), sort_keys=False).encode()
+    pl_json = json.dumps(_thaw(payload), separators=(",", ":")).encode()
+    return _jws_sign(hdr_json, pl_json, priv, alg, who)
+
+
+@builtin("io", "jwt", "encode_sign_raw")
+def _io_jwt_encode_sign_raw(headers: Any, payload: Any, key: Any):
+    """Same as encode_sign but every argument is a JSON *string*
+    (tokens.go builtinJWTEncodeSignRaw)."""
+    import json
+
+    who = "io.jwt.encode_sign_raw"
+    for x in (headers, payload, key):
+        _need(isinstance(x, str), f"{who}: arguments must be JSON strings")
+    try:
+        hdr = json.loads(headers)
+        jwk = json.loads(key)
+    except json.JSONDecodeError as e:
+        raise BuiltinError(f"{who}: {e}")
+    _need(isinstance(hdr, dict), f"{who}: headers must encode an object")
+    _need(isinstance(jwk, dict), f"{who}: key must encode a JWK object")
+    alg = hdr.get("alg")
+    _need(isinstance(alg, str), f"{who}: headers missing alg")
+    priv = _jwk_private_key(jwk, who)
+    return _jws_sign(headers.encode(), payload.encode(), priv, alg, who)
+
+
+# Go crypto/x509 enum values (x509.go), so policies written against the
+# reference's field encoding keep working.
+_GO_SIG_ALGS = {
+    "md5WithRSAEncryption": 2, "sha1WithRSAEncryption": 3,
+    "sha256WithRSAEncryption": 4, "sha384WithRSAEncryption": 5,
+    "sha512WithRSAEncryption": 6, "dsaWithSHA1": 7, "dsaWithSHA256": 8,
+    "ecdsaWithSHA1": 9, "ecdsaWithSHA256": 10, "ecdsaWithSHA384": 11,
+    "ecdsaWithSHA512": 12, "rsassaPss": 13, "ed25519": 16,
+}
+_GO_KEY_USAGE_BITS = (
+    "digital_signature", "content_commitment", "key_encipherment",
+    "data_encipherment", "key_agreement", "key_cert_sign", "crl_sign",
+    "encipher_only", "decipher_only",
+)
+
+
+def _go_name(name) -> dict:
+    """pkix.Name JSON shape (crypto/x509/pkix) for Subject/Issuer."""
+    from cryptography.x509.oid import NameOID
+
+    def vals(oid):
+        return [a.value for a in name.get_attributes_for_oid(oid)]
+
+    cn = vals(NameOID.COMMON_NAME)
+    serial = vals(NameOID.SERIAL_NUMBER)
+    return {
+        "Country": vals(NameOID.COUNTRY_NAME),
+        "Organization": vals(NameOID.ORGANIZATION_NAME),
+        "OrganizationalUnit": vals(NameOID.ORGANIZATIONAL_UNIT_NAME),
+        "Locality": vals(NameOID.LOCALITY_NAME),
+        "Province": vals(NameOID.STATE_OR_PROVINCE_NAME),
+        "StreetAddress": vals(NameOID.STREET_ADDRESS),
+        "PostalCode": vals(NameOID.POSTAL_CODE),
+        "SerialNumber": serial[0] if serial else "",
+        "CommonName": cn[0] if cn else "",
+    }
+
+
+def _go_time(dt) -> str:
+    return dt.strftime("%Y-%m-%dT%H:%M:%SZ")
+
+
+def _x509_input_certs(s: str, who: str):
+    """PEM chain, or base64(DER concatenation) (crypto.go
+    getX509CertsFromString)."""
+    import base64
+
+    from cryptography import x509
+
+    try:
+        if "-----BEGIN" in s:
+            return x509.load_pem_x509_certificates(s.encode())
+        der = base64.b64decode(s)
+        certs = []
+        while der:
+            # outer SEQUENCE header gives this certificate's extent
+            # (the DER parser rejects trailing data, so slice first)
+            _need(der[0] == 0x30, f"{who}: not a DER SEQUENCE")
+            if der[1] & 0x80:
+                nlen = der[1] & 0x7F
+                body = int.from_bytes(der[2:2 + nlen], "big")
+                end = 2 + nlen + body
+            else:
+                end = 2 + der[1]
+            certs.append(x509.load_der_x509_certificate(der[:end]))
+            der = der[end:]
+        return certs
+    except Exception as e:
+        raise BuiltinError(f"{who}: {e}")
+
+
+def _cert_to_go(c) -> dict:
+    """Go x509.Certificate JSON field subset (names + encodings match
+    encoding/json over the Go struct; uncommon fields are omitted —
+    documented in docs/rego.md)."""
+    import base64
+
+    from cryptography import x509
+    from cryptography.hazmat.primitives.asymmetric import ec, rsa
+    from cryptography.hazmat.primitives.serialization import Encoding
+
+    out: dict = {
+        "Version": 3 if c.version.name == "v3" else 1,
+        "SerialNumber": c.serial_number,
+        "Issuer": _go_name(c.issuer),
+        "Subject": _go_name(c.subject),
+        "NotBefore": _go_time(c.not_valid_before_utc),
+        "NotAfter": _go_time(c.not_valid_after_utc),
+        "SignatureAlgorithm": _GO_SIG_ALGS.get(
+            c.signature_algorithm_oid._name, 0),
+        "Signature": base64.b64encode(c.signature).decode(),
+        "Raw": base64.b64encode(c.public_bytes(Encoding.DER)).decode(),
+        "KeyUsage": 0,
+        "IsCA": False,
+        "BasicConstraintsValid": False,
+        "DNSNames": [],
+        "EmailAddresses": [],
+        "IPAddresses": [],
+        "URIs": [],
+    }
+    pub = c.public_key()
+    if isinstance(pub, rsa.RSAPublicKey):
+        nums = pub.public_numbers()
+        out["PublicKeyAlgorithm"] = 1  # x509.RSA
+        out["PublicKey"] = {"N": nums.n, "E": nums.e}
+    elif isinstance(pub, ec.EllipticCurvePublicKey):
+        nums = pub.public_numbers()
+        out["PublicKeyAlgorithm"] = 3  # x509.ECDSA
+        out["PublicKey"] = {"Curve": pub.curve.name, "X": nums.x, "Y": nums.y}
+    else:
+        out["PublicKeyAlgorithm"] = 0
+    try:
+        bc = c.extensions.get_extension_for_class(x509.BasicConstraints)
+        out["IsCA"] = bool(bc.value.ca)
+        out["BasicConstraintsValid"] = True
+    except x509.ExtensionNotFound:
+        pass
+    try:
+        ku = c.extensions.get_extension_for_class(x509.KeyUsage).value
+        bits = 0
+        for i, attr in enumerate(_GO_KEY_USAGE_BITS):
+            try:
+                if getattr(ku, attr):
+                    bits |= 1 << i
+            except ValueError:  # encipher/decipher_only w/o key_agreement
+                pass
+        out["KeyUsage"] = bits
+    except x509.ExtensionNotFound:
+        pass
+    try:
+        san = c.extensions.get_extension_for_class(
+            x509.SubjectAlternativeName).value
+        out["DNSNames"] = san.get_values_for_type(x509.DNSName)
+        out["EmailAddresses"] = san.get_values_for_type(x509.RFC822Name)
+        out["IPAddresses"] = [str(ip) for ip in
+                              san.get_values_for_type(x509.IPAddress)]
+        out["URIs"] = san.get_values_for_type(x509.UniformResourceIdentifier)
+    except x509.ExtensionNotFound:
+        pass
+    return out
+
+
+@builtin("crypto", "x509", "parse_certificates")
+def _crypto_x509_parse_certificates(certs: Any):
+    who = "crypto.x509.parse_certificates"
+    _need(isinstance(certs, str), f"{who}: not a string")
+    return _freeze([_cert_to_go(c) for c in _x509_input_certs(certs, who)])
+
+
+@builtin("crypto", "x509", "parse_certificate_request")
+def _crypto_x509_parse_certificate_request(csr: Any):
+    import base64
+
+    from cryptography import x509
+    from cryptography.hazmat.primitives.serialization import Encoding
+
+    who = "crypto.x509.parse_certificate_request"
+    _need(isinstance(csr, str), f"{who}: not a string")
+    try:
+        if "-----BEGIN" in csr:
+            req = x509.load_pem_x509_csr(csr.encode())
+        else:
+            req = x509.load_der_x509_csr(base64.b64decode(csr))
+    except Exception as e:
+        raise BuiltinError(f"{who}: {e}")
+    out = {
+        "Subject": _go_name(req.subject),
+        "SignatureAlgorithm": _GO_SIG_ALGS.get(
+            req.signature_algorithm_oid._name, 0),
+        "Signature": base64.b64encode(req.signature).decode(),
+        "Raw": base64.b64encode(req.public_bytes(Encoding.DER)).decode(),
+        "Version": 0,  # Go: CSR version is always 0 (v1)
+        "DNSNames": [],
+        "EmailAddresses": [],
+        "IPAddresses": [],
+        "URIs": [],
+    }
+    try:
+        san = req.extensions.get_extension_for_class(
+            x509.SubjectAlternativeName).value
+        out["DNSNames"] = san.get_values_for_type(x509.DNSName)
+        out["EmailAddresses"] = san.get_values_for_type(x509.RFC822Name)
+        out["IPAddresses"] = [str(ip) for ip in
+                              san.get_values_for_type(x509.IPAddress)]
+        out["URIs"] = san.get_values_for_type(x509.UniformResourceIdentifier)
+    except x509.ExtensionNotFound:
+        pass
+    return _freeze(out)
+
+
+@builtin("rego", "parse_module")
+def _rego_parse_module(filename: Any, src: Any):
+    """Reflective parse via this engine's own parser.  Emits the subset of
+    OPA's ast.Module JSON shape policies actually navigate (package path +
+    rule heads); full term-level AST is a documented divergence
+    (docs/rego.md)."""
+    who = "rego.parse_module"
+    _need(isinstance(filename, str) and isinstance(src, str),
+          f"{who}: arguments must be strings")
+    from ..rego.parser import parse_module as _parse
+
+    try:
+        mod = _parse(src)  # filename is error-context only in OPA; unused
+    except Exception as e:
+        raise BuiltinError(f"{who}: {e}")
+    pkg_path = [{"type": "var", "value": "data"}] + [
+        {"type": "string", "value": p} for p in mod.package
+    ]
+    rules = []
+    for r in mod.rules:
+        rules.append({
+            "head": {
+                "name": r.name,
+                "args": [{"type": "var", "value": getattr(a, "name", "_")}
+                         for a in (r.args or [])],
+            },
+            "default": bool(getattr(r, "is_default", False)),
+        })
+    return _freeze({"package": {"path": pkg_path}, "rules": rules})
+
+
+def _unsupported_builtin(name: str, why: str, arity: int):
     def stub(*_args):
         raise BuiltinError(f"{name}: {why}")
 
+    stub._rego_arity = arity  # true OPA arity, so call-form checks stay sound
     return stub
 
 
-for _name, _why in [
-    ("http.send", "outbound HTTP is disabled in this runtime"),
-    ("io.jwt.decode_verify", "asymmetric JWT verification requires a crypto library"),
-    ("io.jwt.encode_sign", "JWT signing requires a crypto library"),
-    ("io.jwt.encode_sign_raw", "JWT signing requires a crypto library"),
-    ("io.jwt.verify_rs256", "RSA verification requires a crypto library"),
-    ("io.jwt.verify_rs384", "RSA verification requires a crypto library"),
-    ("io.jwt.verify_rs512", "RSA verification requires a crypto library"),
-    ("io.jwt.verify_ps256", "RSA-PSS verification requires a crypto library"),
-    ("io.jwt.verify_ps384", "RSA-PSS verification requires a crypto library"),
-    ("io.jwt.verify_ps512", "RSA-PSS verification requires a crypto library"),
-    ("io.jwt.verify_es256", "ECDSA verification requires a crypto library"),
-    ("io.jwt.verify_es384", "ECDSA verification requires a crypto library"),
-    ("io.jwt.verify_es512", "ECDSA verification requires a crypto library"),
-    ("crypto.x509.parse_certificates", "X.509 parsing requires a crypto library"),
-    ("crypto.x509.parse_certificate_request", "X.509 parsing requires a crypto library"),
-    ("regex.globs_match", "glob-language intersection is not implemented"),
-    ("rego.parse_module", "reflective module parsing is not exposed"),
+for _name, _why, _arity in [
+    ("http.send", "outbound HTTP is disabled in this runtime", 1),
+    ("regex.globs_match", "glob-language intersection is not implemented", 2),
 ]:
-    REGISTRY[tuple(_name.split("."))] = _unsupported_builtin(_name, _why)
+    REGISTRY[tuple(_name.split("."))] = _unsupported_builtin(_name, _why, _arity)
 
 
 # ---- misc -----------------------------------------------------------------

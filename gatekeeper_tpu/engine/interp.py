@@ -937,7 +937,9 @@ class QueryContext:
         fn = bi.lookup(path)
         if fn is None:
             return None
-        return fn.__code__.co_argcount
+        # declared at @builtin registration; never introspect __code__
+        # (builtins with *args/defaults would misreport)
+        return fn._rego_arity
 
     def _eval_walk(self, cm: CompiledModule, t: Call, b: Bindings) -> Iterator[Tuple[Any, Bindings]]:
         """`walk` is OPA's only relational builtin: walk(x) enumerates

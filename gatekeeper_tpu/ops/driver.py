@@ -14,6 +14,7 @@ throughput does.  Templates with no vectorized program get all-true columns
 
 from __future__ import annotations
 
+import copy
 import os
 from typing import Dict, List, Optional, Tuple
 
@@ -604,12 +605,13 @@ class TpuDriver(InterpDriver):
             if memoable:
                 hit = self._request_memo.get(memo_review)
                 if hit is not None:
-                    # metadata dicts are rebuilt per hit: handing out the
-                    # cached dict by reference would let a consumer's
-                    # mutation corrupt every later replay
+                    # rebuilt per hit down to the details object: handing
+                    # out any cached mutable by reference would let a
+                    # consumer's mutation corrupt every later replay
                     return [
                         Result(
-                            msg=msg, metadata={"details": details},
+                            msg=msg,
+                            metadata={"details": copy.deepcopy(details)},
                             constraint=constraint, review=review,
                             enforcement_action=action,
                         )
@@ -641,8 +643,12 @@ class TpuDriver(InterpDriver):
             if memoable:
                 if len(self._request_memo) >= self.REQUEST_MEMO_MAX:
                     self._request_memo.clear()
+                # deepcopy at STORE time too: the miss caller holds the
+                # same details object the results carry, and its later
+                # mutation must not corrupt the memoized copy
                 self._request_memo[memo_review] = [
-                    (r.msg, (r.metadata or {}).get("details", {}),
+                    (r.msg,
+                     copy.deepcopy((r.metadata or {}).get("details", {})),
                      r.constraint, r.enforcement_action)
                     for r in results
                 ]

@@ -67,7 +67,7 @@ def _is_output_form(t: Call) -> bool:
 
     fn = lookup(t.path)
     if fn is not None:
-        return len(t.args) == fn.__code__.co_argcount + 1
+        return len(t.args) == fn._rego_arity + 1
     if len(t.path) == 1:
         arity = getattr(_REORDER_TLS, "arities", {}).get(t.path[0])
         if arity is not None:

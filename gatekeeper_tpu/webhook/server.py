@@ -212,7 +212,9 @@ class WebhookServer:
                 self.wfile.write(body)
 
             def do_GET(self):
-                self._read_body()  # a GET may legally carry a body too
+                # a GET may legally carry a body too
+                if self._read_body() is None:
+                    return
                 if self._stopped():
                     return
                 # healthz/readyz (reference main.go:193-196)
@@ -227,22 +229,81 @@ class WebhookServer:
                 else:
                     self._send_text(404, "not found")
 
-            def _read_body(self) -> bytes:
+            # Admission payloads are small; a body this large is abuse or
+            # corruption, never a legitimate AdmissionReview.
+            MAX_BODY = 32 * 1024 * 1024
+
+            def _read_body(self) -> Optional[bytes]:
                 """Always consume the request body: under HTTP/1.1
                 keep-alive, unread body bytes would be parsed as the NEXT
-                request line, poisoning the persistent connection."""
-                if self.headers.get("Transfer-Encoding"):
-                    # chunked framing is not parsed here; the connection
-                    # cannot be reused safely
+                request line, poisoning the persistent connection.
+
+                Returns None when the body could not be framed — in that
+                case an error response has already been sent and the
+                caller must bail out (the Go reference's net/http parses
+                chunked transparently; evaluating an unframeable body as
+                b"" would be a fail-open admission decision)."""
+                te = self.headers.get("Transfer-Encoding")
+                if te:
+                    if te.strip().lower() == "chunked":
+                        return self._read_chunked()
                     self.close_connection = True
-                    return b""
+                    self._send_text(411, "Length Required")
+                    return None
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                 except (TypeError, ValueError):
-                    # unframeable body: the connection cannot be reused
                     self.close_connection = True
-                    return b""
+                    self._send_text(400, "bad Content-Length")
+                    return None
+                if length > self.MAX_BODY:
+                    self.close_connection = True
+                    self._send_text(413, "body too large")
+                    return None
                 return self.rfile.read(length) if length > 0 else b""
+
+            def _read_chunked(self) -> Optional[bytes]:
+                """RFC 7230 §4.1 chunked decoding (net/http does this
+                inside the transport; here it is explicit)."""
+                chunks: list = []
+                total = 0
+                try:
+                    while True:
+                        line = self.rfile.readline(65536)
+                        if not line.endswith(b"\n"):
+                            raise ValueError("chunk size line overflow")
+                        size = int(line.strip().split(b";", 1)[0], 16)
+                        if size < 0:
+                            raise ValueError("negative chunk size")
+                        if size == 0:
+                            # consume trailers up to the blank line,
+                            # bounded like the body (an endless trailer
+                            # stream must not pin the handler thread)
+                            budget = 65536
+                            while True:
+                                trailer = self.rfile.readline(65536)
+                                if trailer in (b"\r\n", b"\n", b""):
+                                    break
+                                budget -= len(trailer)
+                                if budget < 0:
+                                    raise ValueError("trailers too large")
+                            return b"".join(chunks)
+                        total += size
+                        if total > self.MAX_BODY:
+                            raise ValueError("chunked body too large")
+                        data = self.rfile.read(size)
+                        if len(data) != size:
+                            raise ValueError("truncated chunk")
+                        chunks.append(data)
+                        crlf = self.rfile.read(2)
+                        if crlf not in (b"\r\n",):
+                            raise ValueError("missing chunk terminator")
+                except (ValueError, OSError):
+                    # malformed framing: the connection cannot be reused
+                    # and the request must NOT be evaluated as empty
+                    self.close_connection = True
+                    self._send_text(400, "malformed chunked body")
+                    return None
 
             def _stopped(self) -> bool:
                 """After stop(), established keep-alive connections must
@@ -257,6 +318,8 @@ class WebhookServer:
 
             def do_POST(self):
                 body = self._read_body()
+                if body is None:
+                    return
                 if self._stopped():
                     return
                 if self.path not in ("/v1/admit", "/v1/admitlabel"):

@@ -1,0 +1,371 @@
+"""Asymmetric JWT + X.509 builtins (reference: vendored OPA
+topdown/tokens.go and topdown/crypto.go).
+
+Differential anchors: the RFC 7515 appendix-A fixed vectors (the same
+vectors OPA's own token tests pin), plus sign->verify round-trips through
+the `cryptography` package for every algorithm family.
+"""
+
+import json
+
+import pytest
+
+from gatekeeper_tpu.engine.builtins import (
+    REGISTRY,
+    BuiltinError,
+    BuiltinLimitError,
+)
+from gatekeeper_tpu.engine.value import freeze
+
+from .test_builtins_library import run_bi
+
+
+def bi(name):
+    return REGISTRY[tuple(name.split("."))]
+
+
+# --- RFC 7515 A.2: JWS using RS256 -----------------------------------------
+
+RFC7515_A2_TOKEN = (
+    "eyJhbGciOiJSUzI1NiJ9"
+    ".eyJpc3MiOiJqb2UiLA0KICJleHAiOjEzMDA4MTkzODAsDQogImh0dHA6Ly9leGFt"
+    "cGxlLmNvbS9pc19yb290Ijp0cnVlfQ"
+    ".cC4hiUPoj9Eetdgtv3hF80EGrhuB__dzERat0XF9g2VtQgr9PJbu3XOiZj5RZmh7"
+    "AAuHIm4Bh-0Qc_lF5YKt_O8W2Fp5jujGbds9uJdbF9CUAr7t1dnZcAcQjbKBYNX4"
+    "BAynRFdiuB--f_nZLgrnbyTyWzO75vRK5h6xBArLIARNPvkSjtQBMHlb1L07Qe7K"
+    "0GarZRmB_eSN9383LcOLn6_dO--xi12jzDwusC-eOkHWEsqtFZESc6BfI7noOPqv"
+    "hJ1phCnvWh6IeYI2w9QOYEUipUTI8np6LbgGY9Fs98rqVt5AXLIhWkWywlVmtVrB"
+    "p0igcN_IoypGlUPQGe77Rw"
+)
+RFC7515_A2_JWK = json.dumps({
+    "kty": "RSA",
+    "n": "ofgWCuLjybRlzo0tZWJjNiuSfb4p4fAkd_wWJcyQoTbji9k0l8W26mPddxHmfHQp"
+         "-Vaw-4qPCJrcS2mJPMEzP1Pt0Bm4d4QlL-yRT-SFd2lZS-pCgNMsD1W_YpRPEwOW"
+         "vG6b32690r2jZ47soMZo9wGzjb_7OMg0LOL-bSf63kpaSHSXndS5z5rexMdbBYUs"
+         "LA9e-KXBdQOS-UTo7WTBEMa2R2CapHg665xsmtdVMTBQY4uDZlxvb3qCo5ZwKh9k"
+         "G4LT6_I5IhlJH7aGhyxXFvUK-DWNmoudF8NAco9_h9iaGNj8q2ethFkMLs91kzk2"
+         "PAcDTW9gb54h4FRWyuXpoQ",
+    "e": "AQAB",
+})
+
+# --- RFC 7515 A.3: JWS using ES256 -----------------------------------------
+
+RFC7515_A3_TOKEN = (
+    "eyJhbGciOiJFUzI1NiJ9"
+    ".eyJpc3MiOiJqb2UiLA0KICJleHAiOjEzMDA4MTkzODAsDQogImh0dHA6Ly9leGFt"
+    "cGxlLmNvbS9pc19yb290Ijp0cnVlfQ"
+    ".DtEhU3ljbEg8L38VWAfUAqOyKAM6-Xx-F4GawxaepmXFCgfTjDxw5djxLa8IS"
+    "lSApmWQxfKTUJqPP3-Kg6NU1Q"
+)
+RFC7515_A3_JWK = json.dumps({
+    "kty": "EC",
+    "crv": "P-256",
+    "x": "f83OJ3D2xF1Bg8vub9tLe1gHMzV76e8Tus9uPHvRVEU",
+    "y": "x_FEzRu9m36HLN_tue659LNpXW6pCyStikYjKIWI5a0",
+})
+
+
+def _b64u_int(i: int) -> str:
+    import base64
+
+    b = i.to_bytes((i.bit_length() + 7) // 8 or 1, "big")
+    return base64.urlsafe_b64encode(b).rstrip(b"=").decode()
+
+
+@pytest.fixture(scope="module")
+def rsa_jwks():
+    from cryptography.hazmat.primitives.asymmetric import rsa
+
+    k = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    nums = k.private_numbers()
+    pub = nums.public_numbers
+    priv = {"kty": "RSA", "n": _b64u_int(pub.n), "e": _b64u_int(pub.e),
+            "d": _b64u_int(nums.d), "p": _b64u_int(nums.p),
+            "q": _b64u_int(nums.q)}
+    pub_jwk = {"kty": "RSA", "n": _b64u_int(pub.n), "e": _b64u_int(pub.e)}
+    return k, priv, json.dumps({"keys": [pub_jwk]})
+
+
+@pytest.fixture(scope="module")
+def ec_jwks():
+    from cryptography.hazmat.primitives.asymmetric import ec
+
+    k = ec.generate_private_key(ec.SECP384R1())
+    nums = k.private_numbers()
+    pub = nums.public_numbers
+    priv = {"kty": "EC", "crv": "P-384", "x": _b64u_int(pub.x),
+            "y": _b64u_int(pub.y), "d": _b64u_int(nums.private_value)}
+    pub_jwk = {"kty": "EC", "crv": "P-384", "x": _b64u_int(pub.x),
+               "y": _b64u_int(pub.y)}
+    return k, priv, json.dumps(pub_jwk)
+
+
+class TestJwtFixedVectors:
+    """The RFC 7515 appendix vectors are bit-exact external anchors: a
+    wrong padding mode, hash, or R||S split cannot pass them."""
+
+    def test_rs256_rfc7515_a2(self):
+        assert run_bi("io.jwt.verify_rs256", RFC7515_A2_TOKEN,
+                      RFC7515_A2_JWK) is True
+
+    def test_rs256_rejects_tampered_payload(self):
+        h, p, s = RFC7515_A2_TOKEN.split(".")
+        tampered = h + "." + p[:-2] + ("AA" if p[-2:] != "AA" else "BB") + "." + s
+        assert run_bi("io.jwt.verify_rs256", tampered, RFC7515_A2_JWK) is False
+
+    def test_rs256_wrong_family_and_alg(self):
+        assert run_bi("io.jwt.verify_rs384", RFC7515_A2_TOKEN,
+                      RFC7515_A2_JWK) is False
+        assert run_bi("io.jwt.verify_ps256", RFC7515_A2_TOKEN,
+                      RFC7515_A2_JWK) is False
+
+    def test_es256_rfc7515_a3(self):
+        assert run_bi("io.jwt.verify_es256", RFC7515_A3_TOKEN,
+                      RFC7515_A3_JWK) is True
+
+    def test_es256_rejects_wrong_key(self):
+        from cryptography.hazmat.primitives.asymmetric import ec
+
+        other = ec.generate_private_key(ec.SECP256R1()).public_key()
+        nums = other.public_numbers()
+        wrong = {"kty": "EC", "crv": "P-256",
+                 "x": _b64u_int(nums.x), "y": _b64u_int(nums.y)}
+        assert run_bi("io.jwt.verify_es256", RFC7515_A3_TOKEN,
+                      json.dumps(wrong)) is False
+
+    def test_decode_verify_rfc7515_a2(self):
+        # token exp is 1300819380 (2011): pin `time` before expiry
+        valid, header, payload = bi("io.jwt.decode_verify")(
+            freeze(RFC7515_A2_TOKEN),
+            freeze({"cert": RFC7515_A2_JWK, "iss": "joe",
+                    "time": 1300000000 * 10**9}),
+        )
+        assert valid is True
+        assert header["alg"] == "RS256"
+        assert payload["iss"] == "joe"
+
+    def test_decode_verify_expired(self):
+        valid, _, _ = bi("io.jwt.decode_verify")(
+            freeze(RFC7515_A2_TOKEN),
+            freeze({"cert": RFC7515_A2_JWK, "time": 1400000000 * 10**9}),
+        )
+        assert valid is False
+
+    def test_decode_verify_wrong_iss(self):
+        valid, _, _ = bi("io.jwt.decode_verify")(
+            freeze(RFC7515_A2_TOKEN),
+            freeze({"cert": RFC7515_A2_JWK, "iss": "eve",
+                    "time": 1300000000 * 10**9}),
+        )
+        assert valid is False
+
+
+class TestJwtRoundTrips:
+    ALGS_RSA = ["RS256", "RS384", "RS512", "PS256", "PS384", "PS512"]
+
+    @pytest.mark.parametrize("alg", ALGS_RSA)
+    def test_rsa_sign_verify(self, rsa_jwks, alg):
+        _, priv, pub = rsa_jwks
+        tok = bi("io.jwt.encode_sign")(
+            freeze({"alg": alg}), freeze({"sub": "x"}), freeze(priv))
+        assert run_bi(f"io.jwt.verify_{alg.lower()}", tok, pub) is True
+        other = "RS256" if alg != "RS256" else "PS256"
+        assert run_bi(f"io.jwt.verify_{other.lower()}", tok, pub) is False
+
+    def test_ec_sign_verify(self, ec_jwks):
+        _, priv, pub = ec_jwks
+        tok = bi("io.jwt.encode_sign")(
+            freeze({"alg": "ES384"}), freeze({"sub": "y"}), freeze(priv))
+        assert run_bi("io.jwt.verify_es384", tok, pub) is True
+
+    def test_encode_sign_raw(self, rsa_jwks):
+        _, priv, pub = rsa_jwks
+        tok = run_bi("io.jwt.encode_sign_raw",
+                     json.dumps({"alg": "RS256"}),
+                     json.dumps({"raw": True}),
+                     json.dumps(priv))
+        assert run_bi("io.jwt.verify_rs256", tok, pub) is True
+        _, payload, _sig = run_bi("io.jwt.decode", tok)
+        assert payload == {"raw": True}
+
+    def test_decode_verify_hs_family(self):
+        tok = bi("io.jwt.encode_sign")(
+            freeze({"alg": "HS256"}), freeze({"k": 1}),
+            freeze({"kty": "oct", "k": "c2VjcmV0"}))  # "secret"
+        valid, _, payload = bi("io.jwt.decode_verify")(
+            freeze(tok), freeze({"secret": "secret"}))
+        assert valid is True and payload["k"] == 1
+        valid2, _, _ = bi("io.jwt.decode_verify")(
+            freeze(tok), freeze({"secret": "wrong"}))
+        assert valid2 is False
+
+    def test_decode_verify_aud(self, rsa_jwks):
+        _, priv, pub = rsa_jwks
+        tok = bi("io.jwt.encode_sign")(
+            freeze({"alg": "RS256"}),
+            freeze({"aud": ["svc-a", "svc-b"]}), freeze(priv))
+        ok, _, _ = bi("io.jwt.decode_verify")(
+            freeze(tok), freeze({"cert": pub, "aud": "svc-b"}))
+        assert ok is True
+        # token carries aud but constraints don't name one -> invalid
+        bad, _, _ = bi("io.jwt.decode_verify")(freeze(tok),
+                                               freeze({"cert": pub}))
+        assert bad is False
+
+    def test_decode_verify_requires_key(self):
+        with pytest.raises(BuiltinError):
+            bi("io.jwt.decode_verify")(freeze(RFC7515_A2_TOKEN), freeze({}))
+
+    @pytest.mark.parametrize("jwk", [
+        {"kty": "RSA", "e": "AQAB"},          # missing n
+        {"kty": "EC", "crv": "P-256", "x": "AA"},  # missing y
+        {"kty": "oct"},                        # missing k
+        {"kty": "RSA", "n": 5, "e": "AQAB"},   # non-string field
+    ])
+    def test_malformed_jwk_is_builtin_error(self, jwk):
+        """Missing/ill-typed JWK fields must be BuiltinError (-> expression
+        undefined), never a KeyError that aborts the whole query."""
+        with pytest.raises(BuiltinError):
+            run_bi("io.jwt.verify_rs256", RFC7515_A2_TOKEN, json.dumps(jwk))
+        with pytest.raises(BuiltinError):
+            bi("io.jwt.encode_sign")(
+                freeze({"alg": "RS256"}), freeze({}), freeze(jwk))
+
+
+class TestX509:
+    @pytest.fixture(scope="class")
+    def cert_pem(self):
+        import datetime
+
+        from cryptography import x509
+        from cryptography.hazmat.primitives import hashes, serialization
+        from cryptography.hazmat.primitives.asymmetric import rsa
+        from cryptography.x509.oid import NameOID
+
+        k = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+        name = x509.Name([
+            x509.NameAttribute(NameOID.COMMON_NAME, "gatekeeper.test"),
+            x509.NameAttribute(NameOID.ORGANIZATION_NAME, "Acme"),
+            x509.NameAttribute(NameOID.COUNTRY_NAME, "US"),
+        ])
+        cert = (
+            x509.CertificateBuilder()
+            .subject_name(name).issuer_name(name)
+            .public_key(k.public_key()).serial_number(0xC0FFEE)
+            .not_valid_before(datetime.datetime(2020, 1, 1))
+            .not_valid_after(datetime.datetime(2030, 1, 1))
+            .add_extension(x509.BasicConstraints(ca=True, path_length=None),
+                           critical=True)
+            .add_extension(x509.KeyUsage(
+                digital_signature=True, content_commitment=False,
+                key_encipherment=True, data_encipherment=False,
+                key_agreement=False, key_cert_sign=True, crl_sign=True,
+                encipher_only=False, decipher_only=False), critical=True)
+            .add_extension(x509.SubjectAlternativeName(
+                [x509.DNSName("gatekeeper.test"),
+                 x509.DNSName("alt.test")]), critical=False)
+            .sign(k, hashes.SHA256())
+        )
+        return k, cert, cert.public_bytes(serialization.Encoding.PEM).decode()
+
+    def test_parse_certificates_fields(self, cert_pem):
+        _, _, pem = cert_pem
+        out = run_bi("crypto.x509.parse_certificates", pem)
+        assert len(out) == 1
+        c = out[0]
+        assert c["Subject"]["CommonName"] == "gatekeeper.test"
+        assert c["Subject"]["Organization"] == ["Acme"]
+        assert c["Issuer"]["Country"] == ["US"]
+        assert c["SerialNumber"] == 0xC0FFEE
+        assert c["IsCA"] is True and c["BasicConstraintsValid"] is True
+        assert c["NotBefore"] == "2020-01-01T00:00:00Z"
+        assert c["NotAfter"] == "2030-01-01T00:00:00Z"
+        assert c["DNSNames"] == ["gatekeeper.test", "alt.test"]
+        # Go x509: SHA256WithRSA == 4; DigitalSignature|KeyEncipherment|
+        # CertSign|CRLSign == 1|4|32|64
+        assert c["SignatureAlgorithm"] == 4
+        assert c["KeyUsage"] == 1 | 4 | 32 | 64
+        assert c["PublicKeyAlgorithm"] == 1
+
+    def test_parse_certificates_pem_chain_and_der(self, cert_pem):
+        import base64
+
+        from cryptography.hazmat.primitives import serialization
+
+        _, cert, pem = cert_pem
+        out = run_bi("crypto.x509.parse_certificates", pem + pem)
+        assert len(out) == 2
+        der = cert.public_bytes(serialization.Encoding.DER)
+        out2 = run_bi("crypto.x509.parse_certificates",
+                      base64.b64encode(der + der).decode())
+        assert len(out2) == 2
+        assert out2[0]["Subject"]["CommonName"] == "gatekeeper.test"
+
+    def test_parse_certificates_garbage(self):
+        with pytest.raises(BuiltinError):
+            run_bi("crypto.x509.parse_certificates", "not a certificate")
+
+    def test_parse_certificate_request(self, cert_pem):
+        from cryptography import x509
+        from cryptography.hazmat.primitives import hashes, serialization
+        from cryptography.x509.oid import NameOID
+
+        k, _, _ = cert_pem
+        csr = (
+            x509.CertificateSigningRequestBuilder()
+            .subject_name(x509.Name([
+                x509.NameAttribute(NameOID.COMMON_NAME, "csr.test")]))
+            .add_extension(x509.SubjectAlternativeName(
+                [x509.DNSName("csr.test")]), critical=False)
+            .sign(k, hashes.SHA256())
+        )
+        out = run_bi("crypto.x509.parse_certificate_request",
+                     csr.public_bytes(serialization.Encoding.PEM).decode())
+        assert out["Subject"]["CommonName"] == "csr.test"
+        assert out["DNSNames"] == ["csr.test"]
+        assert out["SignatureAlgorithm"] == 4
+
+
+class TestRegoParseModule:
+    def test_parse_module(self):
+        out = run_bi(
+            "rego.parse_module", "t.rego",
+            'package foo.bar\n\nviolation[{"msg": m}] { m := "x" }\n'
+            "default allow = false\n")
+        assert [e["value"] for e in out["package"]["path"]] == \
+            ["data", "foo", "bar"]
+        names = [r["head"]["name"] for r in out["rules"]]
+        assert names == ["violation", "allow"]
+        assert out["rules"][1]["default"] is True
+
+    def test_parse_module_syntax_error(self):
+        with pytest.raises(BuiltinError):
+            run_bi("rego.parse_module", "t.rego", "package {{{")
+
+
+class TestRegistryHygiene:
+    def test_every_builtin_declares_arity(self):
+        missing = [".".join(p) for p, fn in REGISTRY.items()
+                   if not hasattr(fn, "_rego_arity")]
+        assert not missing, f"builtins without declared arity: {missing}"
+
+    def test_remaining_stubs_are_truthful(self):
+        """Only http.send (no egress: true) and regex.globs_match may stub."""
+        stubs = []
+        for path, fn in REGISTRY.items():
+            if fn.__name__ == "stub":
+                stubs.append(".".join(path))
+        assert sorted(stubs) == ["http.send", "regex.globs_match"]
+
+    def test_shift_guards(self):
+        with pytest.raises(BuiltinError):
+            run_bi("bits.lsh", 1, -1)
+        with pytest.raises(BuiltinError):
+            run_bi("bits.lsh", 1, 10**9)
+        with pytest.raises(BuiltinError):
+            run_bi("bits.rsh", 1, -1)
+
+    def test_cidr_expand_fails_closed(self):
+        assert len(run_bi("net.cidr_expand", "10.0.0.0/30")) == 4
+        with pytest.raises(BuiltinLimitError):
+            run_bi("net.cidr_expand", "10.0.0.0/15")

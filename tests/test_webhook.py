@@ -466,9 +466,40 @@ class TestKeepAliveFraming:
         finally:
             srv.stop()
 
-    def test_chunked_body_closes_connection(self):
-        """Transfer-Encoding framing is not parsed; the server must close
-        the connection rather than let chunk data poison the next request."""
+    def test_chunked_body_is_parsed(self):
+        """A chunked POST must be decoded and evaluated exactly like a
+        Content-Length one (Go's net/http does this in the transport);
+        silently evaluating b"" would be a fail-open admission path."""
+        import http.client
+        handler, client, kube = make_handler()
+        srv = WebhookServer(handler, port=0)
+        srv.start()
+        try:
+            body = json.dumps({"request": ns_request()}).encode()
+            conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=5)
+            conn.putrequest("POST", "/v1/admit")
+            conn.putheader("Transfer-Encoding", "chunked")
+            conn.endheaders()
+            # split the payload across two chunks
+            mid = len(body) // 2
+            for part in (body[:mid], body[mid:]):
+                conn.send(("%x\r\n" % len(part)).encode() + part + b"\r\n")
+            conn.send(b"0\r\n\r\n")
+            r = conn.getresponse()
+            out = json.loads(r.read())
+            assert r.status == 200
+            # same decision as the Content-Length path for this request
+            conn.request("POST", "/v1/admit", body=body,
+                         headers={"Content-Type": "application/json"})
+            r2 = conn.getresponse()
+            out2 = json.loads(r2.read())
+            assert out["response"]["allowed"] == out2["response"]["allowed"]
+        finally:
+            srv.stop()
+
+    def test_malformed_chunked_body_rejected(self):
+        """Bad chunk framing must produce 400 + close — never an
+        allowed=true evaluation of an empty body."""
         import http.client
         handler, client, kube = make_handler()
         srv = WebhookServer(handler, port=0)
@@ -478,9 +509,27 @@ class TestKeepAliveFraming:
             conn.putrequest("POST", "/v1/admit")
             conn.putheader("Transfer-Encoding", "chunked")
             conn.endheaders()
-            conn.send(b"5\r\nhello\r\n0\r\n\r\n")
+            conn.send(b"ZZZ\r\nnot-a-size\r\n0\r\n\r\n")
             r = conn.getresponse()
             r.read()
+            assert r.status == 400
+            assert r.getheader("Connection") == "close"
+        finally:
+            srv.stop()
+
+    def test_unknown_transfer_encoding_rejected(self):
+        import http.client
+        handler, client, kube = make_handler()
+        srv = WebhookServer(handler, port=0)
+        srv.start()
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=5)
+            conn.putrequest("POST", "/v1/admit")
+            conn.putheader("Transfer-Encoding", "gzip")
+            conn.endheaders()
+            r = conn.getresponse()
+            r.read()
+            assert r.status == 411
             assert r.getheader("Connection") == "close"
         finally:
             srv.stop()
