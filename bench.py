@@ -197,24 +197,47 @@ def bench_latency() -> dict:
 
     gc.collect()
     gc.freeze()
-    times = []
-    for _ in range(int(os.environ.get("BENCH_ITERS", "500"))):
-        t0 = time.perf_counter()
-        handler.handle(req)
-        times.append(time.perf_counter() - t0)
-    arr = np.array(times) * 1000
-    p50, p99 = np.percentile(arr, 50), np.percentile(arr, 99)
-    log(f"admission latency ms: p50={p50:.2f} p99={p99:.2f} max={arr.max():.2f}")
-    srv_p50, srv_p99 = _server_level_latency(c, req)
-    log(f"admission SERVER latency ms (TLS+batcher): p50={srv_p50:.2f} p99={srv_p99:.2f}")
+    # k runs inside one invocation: the >=2ms target must hold on bad runs
+    # (relay/load variance), so the artifact reports median AND max p99
+    # across runs, not one lucky sample
+    n_runs = int(os.environ.get("BENCH_LATENCY_RUNS", "5"))
+    iters = int(os.environ.get("BENCH_ITERS", "500"))
+    p50s, p99s = [], []
+    for r in range(n_runs):
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            handler.handle(req)
+            times.append(time.perf_counter() - t0)
+        arr = np.array(times) * 1000
+        p50s.append(float(np.percentile(arr, 50)))
+        p99s.append(float(np.percentile(arr, 99)))
+        log(f"admission latency run {r}: p50={p50s[-1]:.2f} "
+            f"p99={p99s[-1]:.2f} max={arr.max():.2f} ms")
+    p50, p99 = float(np.median(p50s)), float(np.median(p99s))
+    log(f"admission latency ms over {n_runs} runs: p99 median={p99:.2f} "
+        f"max={max(p99s):.2f}")
+    srv_runs = [
+        _server_level_latency(c, req)
+        for _ in range(int(os.environ.get("BENCH_SERVER_RUNS", "3")))
+    ]
+    srv_p50 = float(np.median([r[0] for r in srv_runs]))
+    srv_p99 = float(np.median([r[1] for r in srv_runs]))
+    log(f"admission SERVER latency ms (TLS+batcher, {len(srv_runs)} runs): "
+        f"p50 median={srv_p50:.2f} p99 median={srv_p99:.2f} "
+        f"p99 max={max(r[1] for r in srv_runs):.2f}")
     return {
         "metric": "admission handler p99 latency (demo/basic, deny path)",
-        "value": round(float(p99), 3),
+        "value": round(p99, 3),
         "unit": "ms",
         "vs_baseline": 0,
-        "p50_ms": round(float(p50), 3),
-        "server_p99_ms": round(float(srv_p99), 3),
-        "server_p50_ms": round(float(srv_p50), 3),
+        "p50_ms": round(p50, 3),
+        "p99_runs_ms": [round(x, 3) for x in p99s],
+        "p99_max_ms": round(max(p99s), 3),
+        "server_p99_ms": round(srv_p99, 3),
+        "server_p50_ms": round(srv_p50, 3),
+        "server_p99_runs_ms": [round(r[1], 3) for r in srv_runs],
+        "server_p99_max_ms": round(max(r[1] for r in srv_runs), 3),
     }
 
 
@@ -360,7 +383,14 @@ def bench_ingest() -> dict:
         "object": pod,
     }
     c = Client(driver=TpuDriver(async_compile=True))
-    lat = []
+    # production webhook processes freeze long-lived state out of the
+    # cyclic GC (webhook/server.py); without it gen-2 collections land in
+    # the storm's p99
+    import gc
+
+    gc.collect()
+    gc.freeze()
+    lat, waits, evals = [], [], []
     t0 = time.time()
     for t, k in zip(templates, constraints):
         c.add_template(t)
@@ -368,20 +398,34 @@ def bench_ingest() -> dict:
         s = time.perf_counter()
         c.review(req)  # lands mid-storm; interp-served while compiling
         lat.append(time.perf_counter() - s)
+        stats = getattr(c.driver, "last_review_stats", {})
+        waits.append(stats.get("lock_wait_ms", 0.0))
+        evals.append(stats.get("eval_ms", 0.0))
     storm_s = time.time() - t0
     c.driver.wait_ready(timeout=600.0)
     ready_s = time.time() - t0
     arr = np.array(lat) * 1000
     p50 = float(np.percentile(arr, 50))
+    p99 = float(np.percentile(arr, 99))
+    w50 = float(np.percentile(np.array(waits), 50))
+    e50 = float(np.percentile(np.array(evals), 50))
+    w99 = float(np.percentile(np.array(waits), 99))
+    e99 = float(np.percentile(np.array(evals), 99))
     log(f"ingest storm: {n_templates} templates in {storm_s:.1f}s "
         f"(device-ready at {ready_s:.1f}s); interleaved review latency "
-        f"p50={p50:.1f}ms p99={np.percentile(arr, 99):.1f}ms")
+        f"p50={p50:.2f}ms p99={p99:.2f}ms "
+        f"(lock-wait p50 {w50:.2f}/p99 {w99:.2f}ms, "
+        f"eval p50 {e50:.2f}/p99 {e99:.2f}ms)")
+    gc.unfreeze()
     c.driver._compiler.stop()
     return {
         "metric": f"ingest-to-first-eval p50 ({n_templates}-template storm, async compile)",
         "value": round(p50, 3),
         "unit": "ms",
         "vs_baseline": 0,
+        "p99_ms": round(p99, 3),
+        "queue_wait_p50_ms": round(w50, 3),
+        "eval_p50_ms": round(e50, 3),
     }
 
 
@@ -478,6 +522,7 @@ def bench_mesh() -> dict:
     code = f"N_T, N_R = {n_t}, {n_r}\n" + r"""
 import time, json, sys
 import jax, numpy as np
+import jax.numpy as jnp
 sys.path.insert(0, ".")
 from gatekeeper_tpu.util.synthetic import build_driver
 
@@ -501,6 +546,51 @@ for mesh_on in (False, True):
         client.audit_capped(20)
         ts.append(time.perf_counter() - t0)
     out["mesh" if mesh_on else "single"] = min(ts)
+
+# device-only scaling series: the fused packed-only kernel at 1/2/4/8
+# shards, N chained executions per dispatch (optimization_barrier per
+# iteration so XLA cannot CSE), median per-sweep time.  Virtual devices
+# share one host's cores, so the honest signal is per-shard WORK (rows
+# per device falls ~1/N) plus the measured wall series as context.
+from gatekeeper_tpu.parallel.mesh import audit_mesh, shard_review_side
+
+driver.mesh_enabled = False
+driver._mesh_cache = None
+with driver._lock:
+    K = driver._audit_topk(20)
+    fn, _o, cp, gparams = driver._audit_inputs(K)
+raw = fn.__wrapped__
+ap = driver._audit_pack
+N_REP = 8
+series = {}
+shard_rows = {}
+for k in (1, 2, 4, 8):
+    mesh = audit_mesh(k)
+    rv_p, cols_p, target = shard_review_side(mesh, ap.capacity, ap.rp, ap.cols)
+    with driver._lock:
+        driver._cs_device_cache = None
+        cs_p, gp_p = driver._constraint_device_side(cp.arrays, gparams, None, mesh)
+
+    def rep_n(rv, cs, cols, gp):
+        def body(carry, _):
+            a, b, c, d = jax.lax.optimization_barrier((rv, cs, cols, gp))
+            packed = raw(a, b, c, d)
+            return carry + packed[0, 0], None
+        c0, _ = jax.lax.scan(body, jnp.int32(0), None, length=N_REP)
+        return c0
+
+    with mesh:
+        rj = jax.jit(rep_n)
+        rj(rv_p, cs_p, cols_p, gp_p).block_until_ready()  # compile
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            rj(rv_p, cs_p, cols_p, gp_p).block_until_ready()
+            ts.append(time.perf_counter() - t0)
+    series[k] = float(np.median(ts)) / N_REP * 1e3
+    shard_rows[k] = target // k
+out["device_scaling_ms"] = series
+out["rows_per_shard"] = shard_rows
 print(json.dumps(out))
 """
     env = dict(os.environ)
@@ -519,6 +609,13 @@ print(json.dumps(out))
     log(f"mesh scaling (virtual 8-dev CPU, 48x8192): single {data['single']*1000:.0f}ms "
         f"mesh {data['mesh']*1000:.0f}ms -> x{factor:.2f} "
         f"(virtual devices share one host: overhead check, not speedup)")
+    scaling = data.get("device_scaling_ms", {})
+    if scaling:
+        log("mesh device-only series (N-rep chained, virtual CPU devices): "
+            + ", ".join(f"{k} shard(s) {v:.1f}ms"
+                        f" ({data['rows_per_shard'][k]} rows/shard)"
+                        for k, v in sorted(scaling.items(),
+                                           key=lambda kv: int(kv[0]))))
     return {
         "metric": "virtual 8-device mesh sweep vs single device",
         "value": round(factor, 3),
@@ -526,6 +623,104 @@ print(json.dumps(out))
         "vs_baseline": 0,
         "single_s": round(data["single"], 4),
         "mesh_s": round(data["mesh"], 4),
+        "device_scaling_ms": {
+            str(k): round(v, 3) for k, v in scaling.items()
+        },
+        "rows_per_shard": data.get("rows_per_shard", {}),
+    }
+
+
+def bench_multihost() -> dict:
+    """Two REAL OS processes joined via jax.distributed (gRPC coordinator,
+    the DCN control-plane analogue), 4 virtual CPU devices each, one
+    8-device (host, data) mesh: the fused capped-audit reduction runs SPMD
+    across both processes (tests/test_multihost.py recipe, SURVEY §5.8).
+    Reports parity vs the single-process sweep, warm sweep wall time, and
+    the bytes crossing the host boundary per sweep (the replicated
+    [C, 1+K] reduction — nothing [C, R]-sized ever crosses DCN)."""
+    import socket
+    import subprocess
+
+    n_t = int(os.environ.get("BENCH_MH_TEMPLATES", "10"))
+    n_r = int(os.environ.get("BENCH_MH_ROWS", "2000"))
+    worker = f"N_T, N_R = {n_t}, {n_r}\n" + r"""
+import os, sys, json, time
+sys.path.insert(0, ".")
+import numpy as np
+import jax
+from gatekeeper_tpu.parallel.multihost import (
+    init_distributed, multihost_audit_mesh, multihost_capped_sweep,
+)
+
+pid = int(os.environ["GK_PROC"])
+init_distributed(os.environ["GK_COORD"], 2, pid)
+from gatekeeper_tpu.util.synthetic import build_driver
+
+client = build_driver(N_T, N_R, seed=0)
+driver = client.driver
+driver.mesh_enabled = False
+driver._mesh_cache = None
+K = 64
+ordered, counts, topk = multihost_capped_sweep(driver, K=K)  # compile+warm
+ts = []
+for _ in range(3):  # every call re-dispatches (no result cache here)
+    t0 = time.perf_counter()
+    ordered, counts, topk = multihost_capped_sweep(driver, K=K)
+    ts.append(time.perf_counter() - t0)
+
+driver2 = build_driver(N_T, N_R, seed=0).driver
+driver2.mesh_enabled = False
+driver2._mesh_cache = None
+sweep = driver2._audit_sweep(K)
+_r, _o, _m, ref_counts, ref_topk = sweep
+parity = bool((counts == ref_counts).all() and (topk == ref_topk).all())
+packed_bytes = int((counts.shape[0]) * (1 + K) * 4)
+print(json.dumps({"pid": pid, "parity": parity,
+                  "sweep_s": min(ts), "packed_bytes": packed_bytes}),
+      flush=True)
+"""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    coord = f"127.0.0.1:{s.getsockname()[1]}"
+    s.close()
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.update(GK_COORD=coord, GK_PROC=str(pid),
+                   PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+        kept = [f for f in env.get("XLA_FLAGS", "").split()
+                if "xla_force_host_platform_device_count" not in f]
+        kept.append("--xla_force_host_platform_device_count=4")
+        env["XLA_FLAGS"] = " ".join(kept)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", worker], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=600)
+            if p.returncode != 0:
+                raise RuntimeError(
+                    f"multihost worker rc={p.returncode}:\n{err[-2000:]}")
+            outs.append(json.loads(out.strip().splitlines()[-1]))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    parity = all(o["parity"] for o in outs)
+    sweep_s = max(o["sweep_s"] for o in outs)
+    dcn_bytes = outs[0]["packed_bytes"]
+    log(f"multihost (2 procs x 4 virtual devices): parity={parity} "
+        f"warm sweep {sweep_s*1000:.0f}ms, ~{dcn_bytes/1e3:.1f}KB "
+        f"([C,1+K] reduction) crossing the host boundary per sweep")
+    return {
+        "metric": "2-process multihost capped sweep (DCN lane)",
+        "value": round(sweep_s, 4),
+        "unit": "s",
+        "vs_baseline": 0,
+        "parity": parity,
+        "sweep_s": round(sweep_s, 4),
+        "dcn_bytes_per_sweep": dcn_bytes,
     }
 
 
@@ -607,37 +802,86 @@ def bench_synthetic() -> dict:
         f"| device {full_stats.get('device_ms', 0):.1f}ms "
         f"({cells/full_s/1e6:.1f}M cell-evals/s end-to-end)")
 
-    # ---- utilization estimate: HBM bandwidth roofline for the FULL fused
-    # sweep (the computation that actually touches every input byte and the
-    # [C, R] candidate mask); at v5e's 819 GB/s that bound is the floor.
+    # ---- CLEAN on-device sweep time + bandwidth utilization.  N
+    # back-to-back executions of the fused packed-only sweep kernel run
+    # inside ONE dispatch (lax.scan with an optimization_barrier per
+    # iteration, carry data-dependent on each result, so XLA can neither
+    # CSE nor reorder them); the relay's dispatch RTT amortizes across N
+    # and is subtracted via a separately-timed trivial dispatch.  The
+    # published device_util is measured against the v5e HBM roofline —
+    # the artifact field the near-roofline claim rests on.
     import jax
+    import jax.numpy as jnp
     import numpy as np
 
     try:
+        N_REP = int(os.environ.get("BENCH_DEVICE_REPS", "20"))
+        with driver._lock:
+            K = driver._audit_topk(cap)
+            fn, _ord2, cp2, gp2 = driver._audit_inputs(K)
+            rv_d, cols_d = driver._audit_device_inputs()
+            cs_d, gp_d = driver._constraint_device_side(
+                cp2.arrays, gp2, None, None
+            )
+        raw = fn.__wrapped__
+
+        def rep_n(rv, cs, cols, gp):
+            def body(carry, _):
+                rv2, cs2, cols2, gp2_ = jax.lax.optimization_barrier(
+                    (rv, cs, cols, gp))
+                packed = raw(rv2, cs2, cols2, gp2_)
+                return carry + packed[0, 0], None
+
+            c, _ = jax.lax.scan(body, jnp.int32(0), None, length=N_REP)
+            return c
+
+        rep_jit = jax.jit(rep_n)
+        rep_jit(rv_d, cs_d, cols_d, gp_d).block_until_ready()  # compile
+        tiny = jax.jit(lambda x: x + 1)
+        xd = jax.device_put(np.int32(1))
+        tiny(xd).block_until_ready()
+        rtts = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            tiny(xd).block_until_ready()
+            rtts.append(time.perf_counter() - t0)
+        rtt = float(np.median(rtts))
+        rep_totals = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            rep_jit(rv_d, cs_d, cols_d, gp_d).block_until_ready()
+            rep_totals.append(time.perf_counter() - t0)
+        device_sweep_ms = max(
+            0.0, float(np.median(rep_totals)) - rtt) / N_REP * 1e3
+
         in_bytes = sum(
             a.nbytes for a in jax.tree_util.tree_leaves(
                 (driver._audit_pack.rp, driver._audit_pack.cols))
         )
-        cs_bytes = 0
-        if driver._cs_device_cache:
-            cs_bytes = sum(
-                a.nbytes for a in jax.tree_util.tree_leaves(
-                    driver._cs_device_cache[1]))
+        cs_bytes = sum(
+            a.nbytes for a in jax.tree_util.tree_leaves((cs_d, gp_d)))
         C = len(driver._ordered_constraints())
-        mask_bytes = C * driver._audit_pack.capacity  # bool
+        mask_bytes = C * driver._audit_pack.capacity  # bool intermediate
         roofline_ms = (in_bytes + cs_bytes + 2 * mask_bytes) / (
             V5E_HBM_GBPS * 1e9) * 1e3
-        device_ms = full_stats.get("device_ms", 0.0) or float("nan")
-        util = roofline_ms / device_ms if device_ms else 0.0
-        log(f"utilization: full-sweep device portion {device_ms:.1f}ms vs HBM "
-            f"roofline {roofline_ms:.2f}ms (inputs {in_bytes/1e6:.0f}MB + "
-            f"constraint side {cs_bytes/1e6:.0f}MB + mask 2x{mask_bytes/1e6:.0f}MB "
-            f"@ {V5E_HBM_GBPS:.0f}GB/s) -> {util*100:.1f}% of bandwidth bound "
-            f"(rest is relay/dispatch overhead of this env's network-tunneled "
-            f"device; on-device compute measured at ~0.2ms)")
+        util = roofline_ms / device_sweep_ms if device_sweep_ms else 0.0
+        device_cells_per_s = (
+            cells / (device_sweep_ms / 1e3) if device_sweep_ms else 0.0
+        )
+        achieved_gbps = (
+            (in_bytes + cs_bytes + 2 * mask_bytes) / 1e9
+            / (device_sweep_ms / 1e3) if device_sweep_ms else 0.0
+        )
+        log(f"on-device sweep: {device_sweep_ms:.3f}ms/sweep (median of 5 x "
+            f"{N_REP}-rep chained dispatches, RTT {rtt*1e3:.1f}ms subtracted) "
+            f"= {device_cells_per_s/1e9:.2f}B cell-evals/s, "
+            f"{achieved_gbps:.0f}GB/s touched vs {V5E_HBM_GBPS:.0f}GB/s HBM "
+            f"-> {util*100:.1f}% of bandwidth bound "
+            f"(roofline {roofline_ms:.2f}ms: inputs {in_bytes/1e6:.0f}MB + "
+            f"constraint side {cs_bytes/1e6:.0f}MB + mask 2x{mask_bytes/1e6:.0f}MB)")
     except Exception as e:  # pragma: no cover
-        log(f"utilization estimate failed: {e}")
-        roofline_ms, util = 0.0, 0.0
+        log(f"on-device measurement failed: {e!r}")
+        roofline_ms, util, device_sweep_ms, device_cells_per_s = 0.0, 0.0, 0.0, 0.0
 
     # ---- baseline: interpreter oracle on a slice, derated (BASELINE.md) --
     from gatekeeper_tpu.client.client import Client
@@ -684,8 +928,13 @@ def bench_synthetic() -> dict:
         },
         "sweep_fetch_bytes": best_stats.get("fetch_bytes", 0.0),
         "full_sweep_device_ms": round(full_stats.get("device_ms", 0.0), 2),
+        # clean ON-DEVICE numbers (repeat-dispatch median, RTT subtracted):
+        # the fields the near-roofline claim rests on; full_sweep_device_ms
+        # above stays relay-inclusive for honesty
+        "device_sweep_ms": round(device_sweep_ms, 4),
+        "device_cell_evals_per_s": round(device_cells_per_s, 1),
         "hbm_roofline_ms": round(roofline_ms, 2),
-        "full_sweep_bandwidth_util": round(util, 4),
+        "device_util": round(util, 4),
     }
 
 
@@ -698,6 +947,7 @@ CONFIGS = {
     "ingest": bench_ingest,
     "curve": bench_curve,
     "mesh": bench_mesh,
+    "multihost": bench_multihost,
 }
 
 # secondary configs folded into the default run, with the extra-key name
@@ -710,6 +960,7 @@ _FOLDED = [
     ("ingest", "ingest_p50_ms"),
     ("curve", "curve_p50_ms"),
     ("mesh", "mesh_scaling_x8"),
+    ("multihost", "multihost_sweep_s"),
 ]
 
 
@@ -754,8 +1005,21 @@ def main():
             out[key] = sub["value"]
         if name == "latency":
             out["admission_p50_ms"] = sub.get("p50_ms")
+            out["admission_p99_runs_ms"] = sub.get("p99_runs_ms")
+            out["admission_p99_max_ms"] = sub.get("p99_max_ms")
             out["admission_server_p99_ms"] = sub.get("server_p99_ms")
             out["admission_server_p50_ms"] = sub.get("server_p50_ms")
+            out["admission_server_p99_max_ms"] = sub.get("server_p99_max_ms")
+        if name == "mesh":
+            out["mesh_device_scaling"] = sub.get("device_scaling_ms")
+        if name == "ingest":
+            out["ingest_p99_ms"] = sub.get("p99_ms")
+            out["ingest_queue_wait_p50_ms"] = sub.get("queue_wait_p50_ms")
+        if name == "multihost":
+            out["multihost"] = {
+                k: sub.get(k) for k in
+                ("parity", "sweep_s", "dcn_bytes_per_sweep")
+            }
     print(json.dumps(out))
 
 

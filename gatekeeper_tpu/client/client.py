@@ -206,11 +206,7 @@ class Client:
         for review, (results, trace) in zip(
             reviews, self.driver.review_batch(reviews, tracing=tracing)
         ):
-            for r in results:
-                try:
-                    r.resource = self.target.handle_violation(r.review)
-                except Exception:
-                    r.resource = None
+            self._rebuild_resources(results)
             out.append(
                 Responses(
                     by_target={
@@ -239,13 +235,15 @@ class Client:
         results, totals, trace = self.driver.audit_capped(cap, tracing=tracing)
         return self._audit_responses(results, trace), totals
 
-    def _audit_responses(self, results, trace) -> Responses:
-        # handle_violation deep-copies the object out of the review
-        # (target.go:193-244) — ~20us per result, which at 10k results per
-        # sweep dominates the steady state.  Results reused across sweeps
-        # (driver render cache) keep their resource; fresh results sharing
-        # one review share one rebuild.  Consumers treat resources as
-        # read-only (the audit manager extracts status fields).
+    def _rebuild_resources(self, results):
+        """handle_violation deep-copies the object out of the review
+        (target.go:193-244) — ~20us per result, which at 10k results per
+        sweep (or hundreds of violations per admission) dominates.
+        Results reused across sweeps (driver render cache) keep their
+        resource; fresh results sharing one review share one rebuild —
+        the same aliasing contract as r.review itself.  Consumers treat
+        resources as read-only (the audit manager extracts status
+        fields)."""
         per_review: dict = {}
         for r in results:
             if r.resource is not None:
@@ -259,6 +257,9 @@ class Client:
                     res = None
                 per_review[key] = res
             r.resource = res
+
+    def _audit_responses(self, results, trace) -> Responses:
+        self._rebuild_resources(results)
         return Responses(
             by_target={
                 self.target.name: Response(

@@ -38,12 +38,126 @@ class NeedsFullSweep(Exception):
     """Capped rendering needs candidates beyond the known horizon."""
 
 
+import atexit as _atexit
+import threading as _threading
+import weakref as _weakref
+
+_BG_THREADS = _weakref.WeakSet()
+
+
+@_atexit.register
+def _join_bg_threads():
+    # interpreter exit JOINS background workers first: a thread killed
+    # mid-jax-dispatch aborts the runtime's teardown (observed as a gloo
+    # terminate in the multi-host lane).  atexit hooks run LIFO, so this
+    # one (registered after jax's import-time hooks) runs before jax
+    # tears down.
+    for t in list(_BG_THREADS):
+        t.join(timeout=120.0)
+
+
+def spawn_bg(name: str, target):
+    """Daemon worker for background warm-ups, joined at interpreter exit
+    (see _join_bg_threads)."""
+    t = _threading.Thread(target=target, daemon=True, name=name)
+    _BG_THREADS.add(t)
+    t.start()
+    return t
+
+
+class MaskSource:
+    """Device-resident [C, R] base candidate mask, dispatched LAZILY and
+    (on the capped path) never fetched.
+
+    Why lazy: the capped full sweep fetches only the [C, 1+K] reduction.
+    Materializing the [C, R] mask as a co-output of that fetch makes the
+    relay-attached device charge the big array's transfer against the
+    small fetch (~30MB/s measured — the 2.8s r3 full-resweep regression).
+    Instead the mask is its own dispatch, issued only when the delta path
+    (or the uncapped audit) first needs it, against the SAME committed
+    device input buffers the reduction ran on — the scatter updater never
+    donates, so those buffers stay valid as the base state even after
+    later host-side row packs."""
+
+    #: peek() sentinel: a background resolver owns the resolution
+    BUSY = object()
+
+    def __init__(self, thunk):
+        import threading
+
+        self._lock = threading.Lock()
+        self._thunk = thunk
+        self._val = None
+        self._done = threading.Event()
+        # flipped before the resolver thread starts (cleared by it on
+        # failure) so peek() can distinguish "resolver scheduled" from
+        # "nobody is resolving" — without it a caller racing
+        # Thread.start() would pay the whole trace/compile synchronously
+        self._resolving = False
+
+    @classmethod
+    def resolved(cls, val):
+        src = cls(None)
+        src._val = val
+        src._done.set()
+        return src
+
+    def get(self):
+        with self._lock:
+            if self._val is None:
+                try:
+                    self._val = self._thunk()
+                except Exception:
+                    # wake peek() waiters: _val stays None and _resolving
+                    # clears, so they fall into the contained sync-get
+                    # path instead of sleeping out the full timeout
+                    self._done.set()
+                    raise
+                finally:
+                    self._resolving = False
+                self._thunk = None
+                self._done.set()
+            return self._val
+
+    def peek(self, wait_s: float = 0.0):
+        """The mask if it resolves within wait_s; None if unresolved with
+        no resolver running (the caller should get() synchronously); BUSY
+        when a background resolver is still working past wait_s (the
+        caller should fall back to a full sweep rather than block behind
+        the trace/compile)."""
+        if self._done.wait(wait_s if self._resolving else 0):
+            return self._val
+        return self.BUSY if self._resolving else None
+
+    def prefetch(self, after=None):
+        """Resolve on a daemon thread: the mask executable's trace/compile
+        (and its dispatch) happen in the background right after the full
+        sweep instead of landing on the first delta sweep's latency.
+        `after(mask)` runs on the same thread once resolved (best-effort;
+        used to warm downstream executables against the mask)."""
+        self._resolving = True
+
+        def run():
+            try:
+                val = self.get()
+            except Exception:
+                return  # next get() retries; peek no longer reports BUSY
+            if after is not None:
+                try:
+                    after(val)
+                except Exception:
+                    pass
+
+        spawn_bg("gk-mask-prefetch", run)
+
+
 class DeltaState:
     """Host-side incremental reduction state for one (constraint side,
     pack layout) generation.  All access under the driver lock."""
 
     def __init__(self, counts: np.ndarray, topk: np.ndarray, K: int,
-                 mask_dev, cs_epoch: int, layout_gen: int, store_epoch: int):
+                 mask_src: "MaskSource", cs_epoch: int, layout_gen: int,
+                 store_epoch: int):
         self.K = K
         self.counts = counts.astype(np.int64).copy()
         self.cand: List[List[int]] = []
@@ -68,7 +182,7 @@ class DeltaState:
         # (count, candidates, row generations) signature (driver
         # _render_capped); traced renders bypass it
         self.render_cache: Dict = {}
-        self.mask_dev = mask_dev
+        self.mask_src = mask_src
         self.cs_epoch = cs_epoch
         self.layout_gen = layout_gen
         self.store_epoch = store_epoch

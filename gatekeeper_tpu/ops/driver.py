@@ -28,10 +28,35 @@ from ..target.target import K8sValidationTarget
 from .columns import extract_columns
 from .interning import Interner, PredicateTable
 from .matchkernel import match_kernel
-from .pack import pack_constraints, pack_reviews
+from .pack import _bucket as _bucket_pow2, pack_constraints, pack_reviews
 from .params import pack_params
 from .vectorizer import vectorize
 from .vexpr import EvalEnv, VProgram, eval_program
+
+
+def _tree_sig(tree):
+    """Shape/dtype/structure signature of a pytree: two sides with equal
+    signatures produce identical traces for the same program structure."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    return (
+        str(treedef),
+        tuple(
+            (tuple(getattr(l, "shape", ())), str(getattr(l, "dtype", "")))
+            for l in leaves
+        ),
+    )
+
+
+def _packed_reduction(mask, K: int):
+    """[C] counts + first-K candidate row indices -> one [C, 1+K] int32.
+    lax.top_k is stable (equal elements keep index order), so the K
+    largest of the 0/1 mask are the K smallest true indices, ascending —
+    exactly the first-k walk order the host renders."""
+    counts = jnp.sum(mask, axis=1, dtype=jnp.int32)
+    k = min(K, mask.shape[1])
+    vals, idx = jax.lax.top_k(mask.astype(jnp.int8), k)
+    idx = jnp.where(vals > 0, idx, -1)
+    return jnp.concatenate([counts[:, None], idx.astype(jnp.int32)], axis=1)
 
 
 @jax.jit
@@ -107,15 +132,26 @@ class TpuDriver(InterpDriver):
         # is hashed once and each constraint lookup is O(1).
         self._review_memo: Dict[Tuple, list] = {}
         self._review_memo_epoch = -1
-        # whole-request memo (see _request_memoable): content -> rendered
-        # entries for the ENTIRE constraint battery
-        self._request_memo: Dict[Tuple, list] = {}
+        # whole-request memo (see _request_memoable): content ->
+        # (epoch, {(kind, name): [(msg, details, action), ...]}, flat
+        # replay list).  Entries from older epochs are REPAIRED via the
+        # constraint-side change log (only changed constraints
+        # re-evaluate) instead of discarded — a template-ingest storm then
+        # costs O(changed) per admission, not O(installed templates) —
+        # and current-epoch replays walk the flat list, O(violations).
+        self._request_memo: Dict[Tuple, tuple] = {}
         self._request_memo_epoch = -1
         self._request_memo_ok = None
+        self._cs_change_log: List[Tuple[int, str, Optional[str]]] = []
+        self._cs_log_floor = 0  # entries with epoch > floor are complete
         # constraint-side packing is invalidated on any template/constraint
         # mutation and on vocabulary growth (str-pred tables are vocab-sized)
         self._cs_epoch = 0
         self._cs_cache = None
+        # bumped only when the fused executable is actually rebuilt (its
+        # structure signature changed); dependent jits key on this, so
+        # shape-stable constraint churn preserves every warm executable
+        self._fused_gen = 0
         # audit-side sweep cache: the production audit loop sweeps a
         # mostly-unchanged inventory every interval; the device is
         # dispatched only when the inventory or constraint side changed.
@@ -126,9 +162,12 @@ class TpuDriver(InterpDriver):
         # (full re-upload only on pack layout changes) so a steady-state
         # sweep uploads ~KBs, not the whole 100k-row pack, across the link.
         self._audit_dev = None
-        # capped-audit fused fn (mask + per-constraint count/top-k compaction)
+        # capped-audit fused fns: packed-only (single-device; the mask is
+        # a separate lazy dispatch) and two-output (mesh)
         self._fused_audit = None
         self._fused_audit_key = None
+        self._fused_audit_mesh = None
+        self._fused_audit_mesh_key = None
         # incremental O(changes) sweep (ops/deltasweep.py): steady-state
         # capped audits evaluate only dirty rows on-device and fold them
         # into host-side counts/candidate state; GK_DELTA=0 forces every
@@ -196,6 +235,20 @@ class TpuDriver(InterpDriver):
                 else "sustained template/constraint churn?",
             )
 
+    # constraint-side change log: (epoch-after-change, kind, name-or-None
+    # for kind-wide).  Lets the whole-request memo repair entries by
+    # re-evaluating ONLY the constraints that changed since the entry was
+    # stored — the fix for interp-served admission latency growing O(N)
+    # during a template-ingest storm.
+    CS_LOG_MAX = 4096
+
+    def _log_cs_change(self, kind: str, name: Optional[str]):
+        self._cs_change_log.append((self._cs_epoch, kind, name))
+        if len(self._cs_change_log) > self.CS_LOG_MAX:
+            drop = len(self._cs_change_log) // 2
+            self._cs_log_floor = self._cs_change_log[drop - 1][0]
+            del self._cs_change_log[:drop]
+
     def put_template(self, kind: str, artifact: CompiledTemplate):
         # all mutators hold the driver lock for their FULL body (the async
         # compiler snapshots under this lock) and bump the epoch last, so a
@@ -204,6 +257,7 @@ class TpuDriver(InterpDriver):
             super().put_template(kind, artifact)
             self.programs[kind] = vectorize(artifact.policy)
             self._cs_epoch += 1
+            self._log_cs_change(kind, None)
         self._epoch_bumped()
 
     def delete_template(self, kind: str) -> bool:
@@ -211,6 +265,7 @@ class TpuDriver(InterpDriver):
             self.programs.pop(kind, None)
             out = super().delete_template(kind)
             self._cs_epoch += 1
+            self._log_cs_change(kind, None)
         self._epoch_bumped()
         return out
 
@@ -218,12 +273,14 @@ class TpuDriver(InterpDriver):
         with self._lock:
             super().put_constraint(kind, name, constraint)
             self._cs_epoch += 1
+            self._log_cs_change(kind, name)
         self._epoch_bumped()
 
     def delete_constraint(self, kind: str, name: str) -> bool:
         with self._lock:
             out = super().delete_constraint(kind, name)
             self._cs_epoch += 1
+            self._log_cs_change(kind, name)
         self._epoch_bumped()
         return out
 
@@ -243,10 +300,16 @@ class TpuDriver(InterpDriver):
             self._audit_dev = None  # layout gens restart with the new pack
             self._fused_audit = None
             self._fused_audit_key = None
+            self._fused_audit_mesh = None
+            self._fused_audit_mesh_key = None
             self._delta_state = None
             self._delta_jit = None
             self._delta_jit_key = None
             self._cs_epoch += 1
+            # wholesale wipe: the change log cannot describe a reset
+            self._request_memo.clear()
+            self._cs_change_log.clear()
+            self._cs_log_floor = self._cs_epoch
         self._epoch_bumped()
 
     # ---- device evaluation ------------------------------------------------
@@ -281,12 +344,24 @@ class TpuDriver(InterpDriver):
             sk = prog.structure_key()
             by_struct.setdefault(sk, [prog, []])[1].append(i)
         groups = []
+        # padded scatter target: one past the (bucketed) mask C axis, so
+        # padded group rows are DROPPED by the mode="drop" scatter in fused
+        c_rows = len(cp.arrays["valid"]) if "valid" in cp.arrays else len(ordered)
         for _sk, (prog, idxs) in sorted(by_struct.items()):
             for spec in prog.column_specs:
                 specs[spec.key] = spec
             kcs = [ordered[i][2] for i in idxs]
-            packed = pack_params(kcs, prog, self.interner, self.pred_cache, len(kcs))
-            groups.append((prog, np.asarray(idxs, np.int32), packed))
+            # bucket the group's C axis so a template clone added to an
+            # existing group keeps every array shape — and therefore the
+            # compiled fused executable — unchanged (params and idxs are
+            # runtime arguments, not trace constants)
+            B = _bucket_pow2(len(kcs))
+            packed = pack_params(kcs, prog, self.interner, self.pred_cache, B)
+            idxs_pad = np.full(B, c_rows, np.int32)
+            idxs_pad[: len(idxs)] = idxs
+            groups.append(
+                (prog, np.asarray(idxs, np.int32), (idxs_pad,) + packed)
+            )
         side = (ordered, cp, groups, list(specs.values()))
         # key uses the vocab size BEFORE param packing interned new strings;
         # recompute so the cache stays valid next call
@@ -294,25 +369,47 @@ class TpuDriver(InterpDriver):
         self._cs_cache = (key, side)
         return side
 
+    def _structure_sig(self, side):
+        """Trace signature of the fused fn for this constraint side: group
+        program structures + every constraint-side array shape/dtype.  Two
+        sides with equal signatures share one compiled executable — group
+        parameters AND the group->mask row indices are runtime arguments,
+        so adding a template clone inside existing shape buckets costs no
+        retrace/recompile (the ingest-storm latency fix)."""
+        ordered, cp, groups, col_specs = side
+        return (
+            _tree_sig(cp.arrays),
+            tuple(
+                (prog.structure_key(), _tree_sig(packed))
+                for prog, _idxs, packed in groups
+            ),
+            tuple(sorted(s.key for s in col_specs)),
+        )
+
     def _fused_fn(self):
         """One jitted function for the whole sweep: match kernel + every
         violation-program group, combined into the candidate mask.  ONE
         dispatch and ONE device->host fetch per evaluation — essential when
-        the device sits behind a network relay (each fetch is an RTT)."""
+        the device sits behind a network relay (each fetch is an RTT).
+
+        Keyed on the STRUCTURE signature, not the epoch: params, string
+        tables (vocab-bucketed) and group index vectors are all runtime
+        arguments, so constraint churn that keeps shapes inside their
+        power-of-two buckets reuses the warm executable as-is."""
         side = self._constraint_side()
-        # Keyed on the epoch only: vocabulary growth re-packs arrays but the
-        # table shapes are bucketed (ops/params.py), so the compiled
-        # executable survives new strings.
-        if self._fused is not None and self._fused_key == self._cs_epoch:
+        sig = self._structure_sig(side)
+        if self._fused is not None and self._fused_key == sig:
             return self._fused, side
         _ordered, _cp, groups, _col_specs = side
-        static = [(prog, idxs) for prog, idxs, _packed in groups]
+        static = [prog for prog, _idxs, _packed in groups]
 
         def fused(rv, cs, cols, group_params):
             match, autoreject = match_kernel(rv, cs)
             mask = match
             R = match.shape[1]
-            for (prog, idxs), (params, elems, tables) in zip(static, group_params):
+            for prog, (idxs, params, elems, tables) in zip(
+                static, group_params
+            ):
                 keysets = {
                     spec.key: cols[spec.key]["ids"]
                     for spec in prog.column_specs
@@ -324,14 +421,20 @@ class TpuDriver(InterpDriver):
                     if spec.kind != "keyset"
                 }
                 env = EvalEnv(
-                    prog_cols, params, elems, tables, keysets, len(idxs), R
+                    prog_cols, params, elems, tables, keysets,
+                    idxs.shape[0], R,
                 )
-                vmask = eval_program(prog, env)  # [Ck, R]
-                mask = mask.at[idxs].set(mask[idxs] & vmask)
+                vmask = eval_program(prog, env)  # [B, R], B = C bucket
+                # padded group rows carry an out-of-bounds index: the
+                # gather clips (their value is irrelevant), the scatter
+                # DROPS them
+                old = mask.at[idxs].get(mode="clip")
+                mask = mask.at[idxs].set(old & vmask, mode="drop")
             return mask, autoreject
 
         self._fused = jax.jit(fused)
-        self._fused_key = self._cs_epoch
+        self._fused_key = sig
+        self._fused_gen += 1
         return self._fused, side
 
     def _repack_if_vocab_grew(self, fn, side):
@@ -590,36 +693,70 @@ class TpuDriver(InterpDriver):
         and when every cell is content-determined the whole constraint
         walk collapses to one request-level memo hit.
         Traced reviews go to the oracle directly (drivers.py review)."""
+        import time as _time
+
         from ..engine.value import freeze
 
+        t_enter = _time.perf_counter()
         with self._lock:
+            t_locked = _time.perf_counter()
+            # lock-wait vs evaluation breakdown (read by bench.py's ingest
+            # config): distinguishes queueing behind a concurrent template
+            # compile from actual interp evaluation cost
+            self.last_review_stats = {
+                "lock_wait_ms": (t_locked - t_enter) * 1e3,
+            }
             inventory = self.store.frozen()
             cached_ns = self.store.cached_namespace
             frozen_review = freeze(review)
             memo_review = _strip_request_meta(frozen_review)
             if self._request_memo_epoch != self._cs_epoch:
-                self._request_memo.clear()
+                # do NOT clear: stale entries repair incrementally below
                 self._request_memo_ok = None
                 self._request_memo_epoch = self._cs_epoch
             memoable = self._request_memoable()
             if memoable:
                 hit = self._request_memo.get(memo_review)
+                if hit is not None and hit[0] != self._cs_epoch:
+                    per_key = self._repair_memo_entry(
+                        hit[0], hit[1], review, frozen_review, memo_review,
+                        inventory, cached_ns,
+                    )
+                    if per_key is None:
+                        hit = None  # change log overran: full re-eval
+                    else:
+                        # flatten ONCE per repair (O(C)); every replay at
+                        # this epoch is then O(violations)
+                        flat = [
+                            (kind, name, entry)
+                            for kind in sorted(self.constraints)
+                            for name in sorted(self.constraints[kind])
+                            for entry in per_key.get((kind, name), ())
+                        ]
+                        hit = (self._cs_epoch, per_key, flat)
+                        self._request_memo[memo_review] = hit
                 if hit is not None:
                     # rebuilt per hit down to the details object: handing
                     # out any cached mutable by reference would let a
                     # consumer's mutation corrupt every later replay
+                    self.last_review_stats["eval_ms"] = (
+                        _time.perf_counter() - t_locked) * 1e3
                     return [
                         Result(
                             msg=msg,
                             metadata={"details": copy.deepcopy(details)},
-                            constraint=constraint, review=review,
+                            constraint=self.constraints[kind][name],
+                            review=review,
                             enforcement_action=action,
                         )
-                        for msg, details, constraint, action in hit
+                        for kind, name, (msg, details, action) in hit[2]
                     ], None
             results: List[Result] = []
+            per_key_acc = {} if memoable else None
+            flat_acc: list = []
             for kind in sorted(self.constraints):
                 for name in sorted(self.constraints[kind]):
+                    start = len(results)
                     constraint = self.constraints[kind][name]
                     if needs_autoreject(constraint, review, cached_ns):
                         results.append(
@@ -640,19 +777,101 @@ class TpuDriver(InterpDriver):
                         results, constraint, kind, review, frozen_review,
                         inventory, None, memo_review=memo_review,
                     )
+                    if per_key_acc is not None and len(results) > start:
+                        # deepcopy at STORE time too: the miss caller holds
+                        # the same details object the results carry, and
+                        # its later mutation must not corrupt the memo
+                        entries = [
+                            (r.msg,
+                             copy.deepcopy(
+                                 (r.metadata or {}).get("details", {})),
+                             r.enforcement_action)
+                            for r in results[start:]
+                        ]
+                        per_key_acc[(kind, name)] = entries
+                        flat_acc.extend(
+                            (kind, name, e) for e in entries
+                        )
             if memoable:
                 if len(self._request_memo) >= self.REQUEST_MEMO_MAX:
                     self._request_memo.clear()
-                # deepcopy at STORE time too: the miss caller holds the
-                # same details object the results carry, and its later
-                # mutation must not corrupt the memoized copy
-                self._request_memo[memo_review] = [
-                    (r.msg,
-                     copy.deepcopy((r.metadata or {}).get("details", {})),
-                     r.constraint, r.enforcement_action)
-                    for r in results
-                ]
+                self._request_memo[memo_review] = (
+                    self._cs_epoch, per_key_acc, flat_acc
+                )
+            self.last_review_stats["eval_ms"] = (
+                _time.perf_counter() - t_locked) * 1e3
             return results, None
+
+    def _eval_one_key(self, kind, name, review, frozen_review, memo_review,
+                      inventory, cached_ns):
+        """Evaluate a single constraint for the request memo's repair
+        path: the same autoreject + render walk _interp_review_memo runs
+        per key, returning the memoized tuple list (None when the
+        constraint no longer exists)."""
+        constraint = self.constraints.get(kind, {}).get(name)
+        if constraint is None:
+            return None
+        out: List[Result] = []
+        if needs_autoreject(constraint, review, cached_ns):
+            out.append(
+                Result(
+                    msg="Namespace is not cached in OPA.",
+                    metadata={"details": {}},
+                    constraint=constraint, review=review,
+                    enforcement_action=self._enforcement_action(constraint),
+                )
+            )
+        self._render_cell(
+            out, constraint, kind, review, frozen_review, inventory, None,
+            memo_review=memo_review,
+        )
+        return [
+            (r.msg, copy.deepcopy((r.metadata or {}).get("details", {})),
+             r.enforcement_action)
+            for r in out
+        ]
+
+    def _repair_memo_entry(self, entry_epoch, per_key, review,
+                           frozen_review, memo_review, inventory,
+                           cached_ns):
+        """Bring a stale request-memo entry current by re-evaluating ONLY
+        the constraints the change log records after entry_epoch.  Returns
+        the repaired per-key dict, or None when the log no longer covers
+        the entry (caller falls back to a full evaluation)."""
+        if entry_epoch < self._cs_log_floor:
+            return None
+        changed_kinds = set()
+        changed_keys = set()
+        for ep, kind, name in reversed(self._cs_change_log):
+            if ep <= entry_epoch:
+                break
+            if name is None:
+                changed_kinds.add(kind)
+            else:
+                changed_keys.add((kind, name))
+        per_key = dict(per_key)
+        for kind in changed_kinds:
+            for k in [k for k in per_key if k[0] == kind]:
+                del per_key[k]
+            for name in self.constraints.get(kind, {}):
+                res = self._eval_one_key(
+                    kind, name, review, frozen_review, memo_review,
+                    inventory, cached_ns,
+                )
+                if res:
+                    per_key[(kind, name)] = res
+        for kind, name in changed_keys:
+            if kind in changed_kinds:
+                continue
+            res = self._eval_one_key(
+                kind, name, review, frozen_review, memo_review, inventory,
+                cached_ns,
+            )
+            if res:
+                per_key[(kind, name)] = res
+            else:
+                per_key.pop((kind, name), None)
+        return per_key
 
     # Below this many constraint x review cells the device dispatch costs
     # more than it saves (kernel launch + host<->device transfer — or a
@@ -781,38 +1000,52 @@ class TpuDriver(InterpDriver):
         """The capped-audit fused function: the full evaluation step PLUS
         the per-constraint reduction on-device — violation-candidate counts
         and the first K candidate row indices, packed into one [C, 1+K]
-        int32 array.  Only that small array crosses back to the host per
-        sweep (~40KB at 500 constraints); the [C, R] mask stays device-
-        resident for the uncapped path and per-constraint fallbacks.  This
+        int32 array.  ONLY that small array is an output: the [C, R] mask
+        stays an XLA-internal intermediate, because a relay-attached device
+        charges large co-OUTPUTS against the small fetch (~30MB/s measured
+        — r3's 2.8s full-resweep regression).  The mask the delta path and
+        the uncapped audit need is a separate lazy dispatch of the plain
+        fused fn over the same committed device buffers (MaskSource).  This
         is what keeps the 500x100k sweep's device->host traffic under the
         BASELINE <1s budget behind a network relay (reference cap contract:
         pkg/audit/manager.go:49)."""
-        side = self._constraint_side()
+        fused, side = self._fused_fn()
         if (
             self._fused_audit is not None
-            and self._fused_audit_key == (self._cs_epoch, K)
+            and self._fused_audit_key == (self._fused_gen, K)
         ):
             return self._fused_audit, side
-        fused, side = self._fused_fn()
         raw = fused.__wrapped__
 
         def fused_audit(rv, cs, cols, gp):
             mask, _autoreject = raw(rv, cs, cols, gp)
-            counts = jnp.sum(mask, axis=1, dtype=jnp.int32)
-            k = min(K, mask.shape[1])
-            # lax.top_k is stable (equal elements keep index order), so the
-            # K largest of the 0/1 mask are the K smallest true indices,
-            # ascending — exactly the first-k walk order the host renders
-            vals, idx = jax.lax.top_k(mask.astype(jnp.int8), k)
-            idx = jnp.where(vals > 0, idx, -1)
-            packed = jnp.concatenate(
-                [counts[:, None], idx.astype(jnp.int32)], axis=1
-            )
-            return mask, packed
+            return _packed_reduction(mask, K)
 
         self._fused_audit = jax.jit(fused_audit)
-        self._fused_audit_key = (self._cs_epoch, K)
+        self._fused_audit_key = (self._fused_gen, K)
         return self._fused_audit, side
+
+    def _fused_audit_mesh_fn(self, K: int):
+        """Two-output (mask, packed) capped-audit variant for the mesh
+        path: one dispatch produces the reduction AND the device-resident
+        mask.  ICI-attached devices don't charge a co-output against the
+        small fetch the way the relay does, and a single dispatch avoids
+        a double [C, R] evaluation + duplicate review-side shard upload."""
+        fused, _side = self._fused_fn()
+        if (
+            self._fused_audit_mesh is not None
+            and self._fused_audit_mesh_key == (self._fused_gen, K)
+        ):
+            return self._fused_audit_mesh
+        raw = fused.__wrapped__
+
+        def fused_audit_mesh(rv, cs, cols, gp):
+            mask, _autoreject = raw(rv, cs, cols, gp)
+            return mask, _packed_reduction(mask, K)
+
+        self._fused_audit_mesh = jax.jit(fused_audit_mesh)
+        self._fused_audit_mesh_key = (self._fused_gen, K)
+        return self._fused_audit_mesh
 
     def _audit_inputs(self, K: int):
         """Sync the resident incremental audit pack (ops/auditpack.py) and
@@ -829,6 +1062,43 @@ class TpuDriver(InterpDriver):
         group_params = [packed for _prog, _idxs, packed in groups]
         return fn, ordered, cp, group_params
 
+    # Scatter width buckets: one executable covers every dirty count up to
+    # 256 (then powers of 4).  A per-power-of-two bucket recompiles the
+    # many-leaf scatter (~3-5s XLA) on the first full sweep after each new
+    # churn magnitude — measured as the dominant cost of r3's warm full
+    # resweep.  The wider bucket trades a few hundred KB of inline row
+    # upload (rare: full sweeps only) for compile stability.
+    SCATTER_WIDTH_MIN = 256
+
+    def _scatter_width(self, n: int) -> int:
+        width = self.SCATTER_WIDTH_MIN
+        while width < n:
+            width *= 4
+        return width
+
+    def _warm_scatter(self, placed):
+        """Compile+dispatch the width-SCATTER_WIDTH_MIN scatter in the
+        background right after a full upload (result discarded; writes row
+        0's own values).  The first timed full resweep then finds the
+        executable warm instead of paying its XLA compile."""
+        ap = self._audit_pack
+        if ap.capacity == 0:
+            return
+        rows = np.zeros(self.SCATTER_WIDTH_MIN, np.int32)
+        host_rows = jax.tree_util.tree_map(
+            lambda a: a[rows], (ap.rp, ap.cols)
+        )
+
+        def warm():
+            try:
+                _scatter_rows(placed, rows, host_rows)
+            except Exception:  # pragma: no cover - warm-up is best-effort
+                pass
+
+        from .deltasweep import spawn_bg
+
+        spawn_bg("gk-scatter-warm", warm)
+
     def _audit_device_inputs(self):
         """Device-resident review-side audit arrays (single-device path).
         Full upload when the pack layout changed (rebuild, growth, new
@@ -841,15 +1111,14 @@ class TpuDriver(InterpDriver):
         if cache is None or cache[0] != ap.layout_gen:
             placed = jax.device_put((ap.rp, ap.cols))
             self._audit_dev = [ap.layout_gen, placed]
+            self._warm_scatter(placed)
             return placed
         if dirty:
             rows = np.fromiter(sorted(dirty), np.int32, len(dirty))
             # bucket the scatter width (repeat the last row; duplicate
             # indices write identical values) so the jitted updater does
             # not recompile per distinct dirty count
-            width = 1
-            while width < len(rows):
-                width *= 2
+            width = self._scatter_width(len(rows))
             rows = np.pad(rows, (0, width - len(rows)), mode="edge")
             host_rows = jax.tree_util.tree_map(
                 lambda a: a[rows], (ap.rp, ap.cols)
@@ -860,12 +1129,15 @@ class TpuDriver(InterpDriver):
 
     def _audit_sweep(self, K: int, reuse_any_k: bool = False):
         """One device sweep over the resident audit pack ->
-        (reviews, ordered, mask_dev [C, R'] ON DEVICE, counts [C] int64,
-        topk [C, K] int32 with -1 padding), or None when the inventory is
-        empty.  Cached on (store epoch, constraint epoch, K): the device is
-        dispatched only when the inventory or the constraint side actually
-        changed.  reuse_any_k accepts a cached sweep of any K (the uncapped
-        path only needs the mask)."""
+        (reviews, ordered, mask_src MaskSource for the device-resident
+        [C, R'] mask, counts [C] int64, topk [C, K] int32 with -1 padding),
+        or None when the inventory is empty.  Cached on (store epoch,
+        constraint epoch, K): the device is dispatched only when the
+        inventory or the constraint side actually changed.  reuse_any_k
+        accepts a cached sweep of any K (the uncapped path only needs the
+        mask)."""
+        from .deltasweep import DeltaState, MaskSource
+
         key = (self.store.epoch, self._cs_epoch, K)
         if self._audit_cache is not None:
             ckey = self._audit_cache[0]
@@ -889,26 +1161,45 @@ class TpuDriver(InterpDriver):
             cs_d, gp_d = self._constraint_device_side(
                 cp.arrays, group_params, None, None
             )
-            mask_dev, packed_dev = fn(rv_d, cs_d, cols_d, gp_d)
-        else:
-            mask_dev, packed_dev = self._dispatch(
-                fn, ap.rp, cp.arrays, ap.cols, group_params, ap.capacity
+            packed_dev = fn(rv_d, cs_d, cols_d, gp_d)
+            # lazy: the [C, R] mask is its own (never-fetched) dispatch
+            # against the SAME committed buffers, issued only when the
+            # delta path or the uncapped audit first needs it — keeping it
+            # out of the capped fetch avoids the relay's big-co-output
+            # transfer charge (the r3 full-resweep regression)
+            fused = self._fused  # this epoch's compiled plain fused fn
+            mask_src = MaskSource(
+                lambda: fused(rv_d, cs_d, cols_d, gp_d)[0]
             )
+            # background-resolve the mask, then warm the width-8 delta
+            # executable against it: both trace/compiles happen off the
+            # sweep path, so neither this sweep's fetch nor the first
+            # delta sweep pays them (delta falls back to a full sweep
+            # while this runs — peek/BUSY in _try_delta)
+            self._warm_delta_async(mask_src, cs_d, gp_d)
+        else:
+            # mesh path: ONE two-output dispatch (mask stays device-
+            # resident, only packed is fetched); resolved eagerly because
+            # ap's host arrays mutate in place on later row packs, so a
+            # deferred upload would capture a post-base state
+            mask_dev, packed_dev = self._dispatch(
+                self._fused_audit_mesh_fn(K), ap.rp, cp.arrays, ap.cols,
+                group_params, ap.capacity,
+            )
+            mask_src = MaskSource.resolved(mask_dev)
         packed_dev.block_until_ready()
         t2 = _time.perf_counter()
         packed = np.asarray(packed_dev)  # the ONE small fetch per sweep
         t3 = _time.perf_counter()
         counts = packed[:, 0].astype(np.int64)
-        sweep = (ap.reviews, ordered, mask_dev, counts, packed[:, 1:])
+        sweep = (ap.reviews, ordered, mask_src, counts, packed[:, 1:])
         # re-read the epochs: packing may have interned new strings and
         # bumped the constraint-side cache, but the INPUTS are these epochs'
         self._audit_cache = (key, sweep, None)
         # a full sweep (re)bases the incremental state: its inputs include
         # every dirty row the scatter just applied
-        from .deltasweep import DeltaState
-
         self._delta_state = DeltaState(
-            counts, packed[:, 1:], K, mask_dev,
+            counts, packed[:, 1:], K, mask_src,
             cs_epoch=self._cs_epoch, layout_gen=ap.layout_gen,
             store_epoch=self.store.epoch,
         )
@@ -942,7 +1233,7 @@ class TpuDriver(InterpDriver):
                 # (a capacity change bumps layout_gen, invalidating it);
                 # copy: np.asarray of a jax array is a read-only view
                 st.host_mask = np.array(
-                    st.mask_dev, copy=True
+                    st.mask_src.get(), copy=True
                 )[:, : ap.capacity]
                 st.pending_mask_rows = set(st.row_cols)
             for r in st.pending_mask_rows:
@@ -952,10 +1243,10 @@ class TpuDriver(InterpDriver):
         sweep = self._audit_sweep(self.AUDIT_TOPK_MIN, reuse_any_k=True)
         if sweep is None:
             return [], [], None
-        reviews, ordered, mask_dev, _counts, _topk = sweep
+        reviews, ordered, mask_src, _counts, _topk = sweep
         key, cached_sweep, host = self._audit_cache
         if host is None:
-            host = np.asarray(mask_dev)[:, : self._audit_pack.capacity]
+            host = np.asarray(mask_src.get())[:, : self._audit_pack.capacity]
             self._audit_cache = (key, cached_sweep, host)
         # a full sweep just rebased the incremental state; seed its host
         # mask from this fetch so the next delta-path audit doesn't
@@ -964,7 +1255,7 @@ class TpuDriver(InterpDriver):
         if (
             st is not None
             and st.host_mask is None
-            and st.mask_dev is mask_dev
+            and st.mask_src is mask_src
         ):
             st.host_mask = host.copy()
             st.pending_mask_rows = set(st.row_cols)
@@ -1048,6 +1339,36 @@ class TpuDriver(InterpDriver):
     # cumulative rows tracked since the last full sweep beyond which the
     # state is rebased (bounds row_cols host memory at ~ROWS_MAX x C bytes)
     DELTA_ROW_COLS_MAX = 8192
+    # how long a delta sweep waits for the background base-mask resolution
+    # before falling back to a full sweep.  This wait happens UNDER the
+    # driver lock (admission reviews queue behind it), so production keeps
+    # it near zero — a sub-second full sweep beats any stall; the test
+    # conftest raises it for CPU-backend determinism.
+    DELTA_MASK_WAIT_S = 0.05
+
+    def _warm_delta_async(self, mask_src, cs_d, gp_d):
+        """Resolve the base mask, then compile+dispatch the width-8 delta
+        executable against it, on the MaskSource's resolver thread.  All
+        state it needs is captured here under the driver lock; the thread
+        itself only calls thread-safe jax entry points."""
+        ap = self._audit_pack
+        if not self.delta_enabled or ap.n_rows == 0:
+            # no delta path will consume the mask: leave it lazy (the
+            # uncapped audit resolves it on demand) instead of paying a
+            # background full evaluation nobody may read
+            return
+        delta_jit = self._delta_fn()  # cheap wrapper; cached per epoch
+        rows_pad = np.zeros(8, np.int32)
+        rv_slice = {k: a[rows_pad] for k, a in ap.rp.items()}
+        cols_slice = {
+            ck: {leaf: a[rows_pad] for leaf, a in leaves.items()}
+            for ck, leaves in ap.cols.items()
+        }
+        mask_src.prefetch(
+            after=lambda m: delta_jit(
+                m, rows_pad, rv_slice, cs_d, cols_slice, gp_d
+            )
+        )
 
     def _delta_fn(self):
         """Jitted fused evaluation restricted to a [d]-row slice of the
@@ -1055,9 +1376,9 @@ class TpuDriver(InterpDriver):
         the resident full-sweep mask, in ONE dispatch ->
         [C, 2d] (old | new) int8.  Same traced computation as the full
         sweep, tiny intermediates, one round trip."""
-        if self._delta_jit is not None and self._delta_jit_key == self._cs_epoch:
-            return self._delta_jit
         fused, _side = self._fused_fn()
+        if self._delta_jit is not None and self._delta_jit_key == self._fused_gen:
+            return self._delta_jit
         raw = fused.__wrapped__
 
         def delta(mask_dev, idx, rv, cs, cols, gp):
@@ -1068,7 +1389,7 @@ class TpuDriver(InterpDriver):
             )
 
         self._delta_jit = jax.jit(delta)
-        self._delta_jit_key = self._cs_epoch
+        self._delta_jit_key = self._fused_gen
         return self._delta_jit
 
     def _try_delta(self, K: int):
@@ -1105,6 +1426,31 @@ class TpuDriver(InterpDriver):
             return ap.reviews, ordered, st
         if len(ap.delta_dirty) > self.DELTA_MAX_ROWS:
             return None
+        from .deltasweep import MaskSource
+
+        got = st.mask_src.peek(wait_s=self.DELTA_MASK_WAIT_S)
+        if got is MaskSource.BUSY:
+            # the base mask is still tracing/compiling in the prefetch
+            # thread: a full sweep (sub-second now) beats blocking the
+            # audit behind that compile; the delta path resumes once it
+            # lands (the full sweep rebases state with a resolved-or-
+            # prefetching source either way)
+            return None
+        if got is None:
+            # no resolver running (prefetch crashed or was never kicked):
+            # resolve here, with the same failure containment as
+            # _apply_delta — a dispatch error must degrade to a full
+            # sweep, not crash the audit
+            try:
+                st.mask_src.get()
+            except Exception:
+                import logging
+
+                logging.getLogger("gatekeeper_tpu.driver").exception(
+                    "base-mask resolution failed; rebasing via a full sweep"
+                )
+                self._delta_state = None
+                return None
         # drained only once eligibility is certain; any failure past this
         # point must invalidate the state (the caller then runs a full
         # sweep, which rebases knowledge and clears both dirty channels)
@@ -1142,7 +1488,8 @@ class TpuDriver(InterpDriver):
         )
         both = np.asarray(
             self._delta_fn()(
-                st.mask_dev, rows_pad, rv_slice, cs_d, cols_slice, gp_d
+                st.mask_src.get(), rows_pad, rv_slice, cs_d, cols_slice,
+                gp_d
             )
         ).astype(bool)
         fetch_bytes = both.nbytes
@@ -1285,7 +1632,7 @@ class TpuDriver(InterpDriver):
                 return
             if st.row_cols:
                 raise NeedsFullSweep(ci)
-            row = np.asarray(st.mask_dev[ci])[:R]
+            row = np.asarray(st.mask_src.get()[ci])[:R]
             fallback_rows += 1
             fallback_bytes += row.nbytes
             full = [int(x) for x in np.nonzero(row)[0]]

@@ -114,9 +114,9 @@ def multihost_capped_sweep(driver, K: int):
     if cached is not None and cached[0] == key:
         sharded = cached[1]
     else:
-        raw = fn.__wrapped__
+        raw = fn.__wrapped__  # fused_audit: already packed-only
         sharded = jax.jit(
-            lambda rv, cs, c, gp: raw(rv, cs, c, gp)[1],  # packed only
+            lambda rv, cs, c, gp: raw(rv, cs, c, gp),
             out_shardings=NamedSharding(mesh, P()),
         )
         driver._multihost_jit = (key, sharded)

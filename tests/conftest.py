@@ -34,3 +34,12 @@ REFERENCE = pathlib.Path("/root/reference")
 
 def reference_available() -> bool:
     return REFERENCE.exists()
+
+
+# Deterministic delta-path tests: give the background base-mask resolution
+# time to land (CPU-backend compiles finish well within this) instead of
+# falling back to a full sweep.  Production keeps the wait near zero
+# because it happens under the driver lock (ops/driver.py).
+from gatekeeper_tpu.ops.driver import TpuDriver  # noqa: E402
+
+TpuDriver.DELTA_MASK_WAIT_S = 300.0

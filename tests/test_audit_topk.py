@@ -152,13 +152,13 @@ def test_incremental_scatter_matches_full_upload():
     ct.add_data(changed)
     ct.remove_data(pods[5])
     _res, _totals = ct.audit_capped(5)  # scatter path
-    scattered = np.asarray(drv._audit_cache[1][2])  # mask_dev
+    scattered = np.asarray(drv._audit_cache[1][2].get())  # base mask
     counts_s = drv._audit_cache[1][3].copy()
     # force a full re-upload of the identical pack and re-dispatch
     drv._audit_dev = None
     drv._audit_cache = None
     _res2, _totals2 = ct.audit_capped(5)
-    fresh = np.asarray(drv._audit_cache[1][2])
+    fresh = np.asarray(drv._audit_cache[1][2].get())
     counts_f = drv._audit_cache[1][3]
     assert (scattered == fresh).all()
     assert (counts_s == counts_f).all()

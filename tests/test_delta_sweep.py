@@ -74,7 +74,18 @@ def test_delta_path_used_and_matches_oracle_over_many_mutations():
                 r.constraint["metadata"]["name"], 0) + 1
         for k, (n, how) in tot_t.items():
             if how == "exact":
-                assert n == tot_i[k][0], (i, k, n, tot_i[k])
+                # on failure, capture which sweep path produced the count
+                # and the incremental state (rare-flake diagnostics)
+                st = ct.driver._delta_state
+                assert n == tot_i[k][0], (
+                    i, k, n, tot_i[k], ct.driver.last_sweep_stats,
+                    None if st is None else {
+                        "counts": st.counts.tolist(),
+                        "row_cols": sorted(st.row_cols),
+                        "store_epoch": st.store_epoch,
+                        "cs_epoch": st.cs_epoch,
+                    },
+                )
         # full uncapped parity (forces a fresh full sweep for audit())
         assert _audit_keys(ct) == _audit_keys(ci), f"sweep {i}"
     assert delta_sweeps >= 4, f"delta path unused ({delta_sweeps} sweeps)"

@@ -135,7 +135,7 @@ def test_sweep_determinism_bit_identical(mesh):
         c = build()
         res, totals = c.audit_capped(5)
         sweep = c.driver._audit_cache[1]
-        mask = np.asarray(sweep[2])
+        mask = np.asarray(sweep[2].get())
         outs.append((
             mask.copy(), sweep[3].copy(), sweep[4].copy(),
             sorted((r.constraint["metadata"]["name"], r.msg)
@@ -166,7 +166,7 @@ def test_mesh_vs_single_device_masks_identical():
             c.add_data(p)
         c.audit_capped(5)
         sweep = c.driver._audit_cache[1]
-        return np.asarray(sweep[2]), sweep[3], sweep[4]
+        return np.asarray(sweep[2].get()), sweep[3], sweep[4]
 
     m1, c1, t1 = masks(False)
     m2, c2, t2 = masks(True)
