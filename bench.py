@@ -50,6 +50,18 @@ def log(msg: str):
     print(msg, file=sys.stderr, flush=True)
 
 
+def settle_warmups():
+    """Join the driver's background warm-ups (base-mask resolve + delta
+    executable compile).  Production audit sweeps are interval-spaced, so
+    these always finish between sweeps; the bench's back-to-back loop
+    must wait explicitly or every sweep lands in the warm window and
+    falls back to a full sweep."""
+    from gatekeeper_tpu.ops import deltasweep
+
+    for t in list(deltasweep._BG_THREADS):
+        t.join(timeout=300)
+
+
 def load_yaml_dir(pattern):
     import glob
 
@@ -92,8 +104,9 @@ def bench_agilebank() -> dict:
             total += 1
     log(f"agilebank: {n_cons} constraints x {total} resources")
     c.audit_capped(20)  # compile + warm (full sweep)
-    # warm the delta path too (its jit compiles on first use), then time an
-    # honest steady-state sweep: one object mutated since the last sweep
+    settle_warmups()  # base-mask + delta executable compile off-path
+    # warm the delta path too, then time an honest steady-state sweep:
+    # one object mutated since the last sweep
     c.add_data({"apiVersion": "v1", "kind": "Namespace",
                 "metadata": {"name": "bench-warm-bump"}})
     c.audit_capped(20)
@@ -142,6 +155,7 @@ def bench_psp() -> dict:
             total += 1
     log(f"psp: {n_cons} constraints x {total} pods")
     c.audit_capped(20)  # compile + warm (full sweep)
+    settle_warmups()  # base-mask + delta executable compile off-path
     c.add_data({"apiVersion": "v1", "kind": "Namespace",
                 "metadata": {"name": "psp-warm"}})
     c.audit_capped(20)  # warm the delta path
@@ -461,6 +475,10 @@ def bench_curve() -> dict:
     req = req_for(uniq_pods[0])
     curve = {}
     curve_memo = {}
+    curve_device = {}
+    curve_interp = {}
+    routes = {}
+    cal_logged = None
     for n in counts:
         templates, constraints = make_templates(n)
         c = Client(driver=TpuDriver())
@@ -480,15 +498,41 @@ def bench_curve() -> dict:
         iters = max(10, min(100, 20000 // max(n, 1)))
         for _ in range(3):
             handler.handle(req)
-        # unique-content: every iteration evaluates a different object
-        ts = []
-        for j in range(iters):
-            r = req_for(uniq_pods[(j + 7) % len(uniq_pods)])
-            t0 = time.perf_counter()
-            handler.handle(r)
-            ts.append(time.perf_counter() - t0)
-        p50 = float(np.percentile(np.array(ts) * 1000, 50))
+        # startup calibration: the measured cost model picks the route
+        cal = c.driver.calibrate_routing()
+        if cal and cal_logged is None:
+            cal_logged = {k: round(v, 3) for k, v in cal.items()}
+            log(f"routing calibration: {cal_logged}")
+        routes[n] = "interp" if c.driver._route_to_interp(n) else "device"
+
+        def series(offset, forced=None):
+            # distinct pod offset per series: unique content must not hit
+            # request-memo entries another series populated
+            saved = c.driver.DEVICE_MIN_CELLS
+            cal_saved = c.driver._route_cal
+            if forced == "interp":
+                c.driver.DEVICE_MIN_CELLS = 1 << 30
+                c.driver._route_cal = None
+            elif forced == "device":
+                c.driver.DEVICE_MIN_CELLS = 0
+            ts = []
+            try:
+                for j in range(iters):
+                    r = req_for(uniq_pods[(offset + j) % len(uniq_pods)])
+                    t0 = time.perf_counter()
+                    handler.handle(r)
+                    ts.append(time.perf_counter() - t0)
+            finally:
+                c.driver.DEVICE_MIN_CELLS = saved
+                c.driver._route_cal = cal_saved
+            return float(np.percentile(np.array(ts) * 1000, 50))
+
+        # adaptive (production default), then the two forced paths so the
+        # crossover is visible in the artifact
+        p50 = series(7)
         curve[n] = round(p50, 3)
+        curve_interp[n] = round(series(1100, "interp"), 3)
+        curve_device[n] = round(series(2200, "device"), 3)
         # repeat-content: identical object, fresh uid (request-memo hits)
         ts = []
         for _ in range(iters):
@@ -497,8 +541,9 @@ def bench_curve() -> dict:
             ts.append(time.perf_counter() - t0)
         m50 = float(np.percentile(np.array(ts) * 1000, 50))
         curve_memo[n] = round(m50, 3)
-        log(f"curve N={n}: unique p50 {p50:.2f}ms, repeat(memo) p50 "
-            f"{m50:.2f}ms ({iters} iters)")
+        log(f"curve N={n}: adaptive p50 {p50:.2f}ms (route={routes[n]}), "
+            f"interp {curve_interp[n]:.2f}ms, device {curve_device[n]:.2f}ms, "
+            f"repeat(memo) {m50:.2f}ms ({iters} iters)")
     return {
         "metric": "admission handler p50 vs constraint count (unique-content)",
         "value": curve[max(counts)],
@@ -506,6 +551,10 @@ def bench_curve() -> dict:
         "vs_baseline": 0,
         "curve_p50_ms": curve,
         "curve_repeat_p50_ms": curve_memo,
+        "curve_interp_p50_ms": curve_interp,
+        "curve_device_p50_ms": curve_device,
+        "curve_route": routes,
+        "routing_calibration": cal_logged,
     }
 
 
@@ -558,7 +607,7 @@ driver.mesh_enabled = False
 driver._mesh_cache = None
 with driver._lock:
     K = driver._audit_topk(20)
-    fn, _o, cp, gparams = driver._audit_inputs(K)
+    fn, _o, cp, gparams, _crow = driver._audit_inputs(K)
 raw = fn.__wrapped__
 ap = driver._audit_pack
 N_REP = 8
@@ -752,6 +801,7 @@ def bench_synthetic() -> dict:
     t0 = time.time()
     res, totals = client.audit_capped(cap)
     cold_s = time.time() - t0
+    settle_warmups()  # base-mask + delta executable compile off-path
     n_results = len(res.results())
     n_capped = sum(1 for v in totals.values() if v[1] == "resources")
     log(f"cold end-to-end capped audit: {cold_s:.1f}s "
@@ -818,7 +868,7 @@ def bench_synthetic() -> dict:
         N_REP = int(os.environ.get("BENCH_DEVICE_REPS", "20"))
         with driver._lock:
             K = driver._audit_topk(cap)
-            fn, _ord2, cp2, gp2 = driver._audit_inputs(K)
+            fn, _ord2, cp2, gp2, _crow2 = driver._audit_inputs(K)
             rv_d, cols_d = driver._audit_device_inputs()
             cs_d, gp_d = driver._constraint_device_side(
                 cp2.arrays, gp2, None, None
@@ -1001,6 +1051,10 @@ def main():
         log(f"[{name}] done in {time.time()-t0:.0f}s")
         if name == "curve":
             out[key] = sub["curve_p50_ms"]
+            out["curve_device_p50_ms"] = sub.get("curve_device_p50_ms")
+            out["curve_interp_p50_ms"] = sub.get("curve_interp_p50_ms")
+            out["curve_route"] = sub.get("curve_route")
+            out["routing_calibration"] = sub.get("routing_calibration")
         else:
             out[key] = sub["value"]
         if name == "latency":

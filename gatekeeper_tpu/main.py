@@ -344,9 +344,11 @@ class App:
         self.metrics_addr_exporter: Optional[MetricsExporter] = None
         self.micro_batcher: Optional[MicroBatcher] = None
         self.profile_server: Optional[ProfileServer] = None
+        self._stopping = False
 
     def start(self):
         args = self.args
+        self._stopping = False  # a stopped App may be restarted
         # cert bootstrap gates everything (main.go:219-220); write_cert_files
         # runs ensure_certs synchronously, so readiness is set before start()
         # spins the refresh thread
@@ -464,6 +466,7 @@ class App:
 
             jax.profiler.start_server(args.jax_profile_port)
             self._jax_profiler_on = True
+        self._start_routing_calibration()
         log.info(
             "gatekeeper-tpu started",
             extra={"kv": {
@@ -472,7 +475,49 @@ class App:
             }},
         )
 
+    def _start_routing_calibration(self):
+        """Background startup calibration of the driver's interp-vs-device
+        routing cost model (TpuDriver.calibrate_routing): waits for the
+        first templates to sync + compile, then measures once.  Retries a
+        few times because an empty cluster has nothing to calibrate
+        against yet."""
+        driver = self.client.driver
+        if not hasattr(driver, "calibrate_routing"):
+            return  # interp driver
+        if getattr(driver, "DEVICE_MIN_CELLS", 0) == 0:
+            return  # forced-device configuration
+
+        def run():
+            import time as _time
+
+            for _ in range(30):
+                if self._stopping:
+                    return
+                try:
+                    driver.wait_ready(timeout=30.0)
+                    if driver.calibrate_routing() is not None:
+                        cal = driver._route_cal
+                        log.info(
+                            "routing calibrated",
+                            extra={"kv": {
+                                "rtt_ms": round(cal["rtt_ms"], 3),
+                                "device_cells_per_ms": round(
+                                    cal["device_cells_per_ms"], 1),
+                                "interp_cells_per_ms": round(
+                                    cal["interp_cells_per_ms"], 1),
+                            }},
+                        )
+                        return
+                except Exception:
+                    log.exception("routing calibration attempt failed")
+                _time.sleep(10.0)
+
+        from .ops.deltasweep import spawn_bg
+
+        spawn_bg("gk-route-cal", run)
+
     def stop(self):
+        self._stopping = True
         for component in (
             self.audit_manager,
             self.webhook_server,

@@ -149,7 +149,7 @@ class AsyncCompiler:
                     self._ready_epoch = epoch
                     self._cond.notify_all()
                 return
-            fn, _ordered, rp, cp, cols, group_params = d._device_inputs(
+            fn, _ordered, rp, cp, cols, group_params, _crow = d._device_inputs(
                 [dict(_PROBE_REVIEW)]
             )
             rows = len(rp.arrays["valid"])

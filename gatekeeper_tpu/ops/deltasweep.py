@@ -157,7 +157,7 @@ class DeltaState:
 
     def __init__(self, counts: np.ndarray, topk: np.ndarray, K: int,
                  mask_src: "MaskSource", cs_epoch: int, layout_gen: int,
-                 store_epoch: int):
+                 store_epoch: int, crow=None):
         self.K = K
         self.counts = counts.astype(np.int64).copy()
         self.cand: List[List[int]] = []
@@ -183,6 +183,11 @@ class DeltaState:
         # _render_capped); traced renders bypass it
         self.render_cache: Dict = {}
         self.mask_src = mask_src
+        # ordered-constraint -> group-major mask row (device mask/delta
+        # outputs are [C_total]-row; host state here is per ordered
+        # constraint)
+        self.crow = crow if crow is not None else np.arange(
+            len(counts), dtype=np.int64)
         self.cs_epoch = cs_epoch
         self.layout_gen = layout_gen
         self.store_epoch = store_epoch

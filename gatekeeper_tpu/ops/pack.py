@@ -259,7 +259,10 @@ def _pack_selector(selector: Any, interner: Interner):
     return pairs, exprs
 
 
-def pack_constraints(constraints: List[dict], interner: Interner) -> ConstraintPack:
+def pack_constraints(constraints: List[Optional[dict]], interner: Interner) -> ConstraintPack:
+    """None entries are PAD rows (valid=False, match never fires): the
+    driver lays constraints out group-major with per-group padded blocks
+    so the fused update per group is a static slice."""
     n = len(constraints)
     rows = _bucket(n, 1)
 
@@ -278,6 +281,15 @@ def pack_constraints(constraints: List[dict], interner: Interner) -> ConstraintP
     nssel_ex: List[List] = []
 
     for i, c in enumerate(constraints):
+        if c is None:  # pad row: valid stays False, empty lists below
+            kind_pairs.append([])
+            ns_lists.append([])
+            ex_lists.append([])
+            sel_ml.append([])
+            sel_ex.append([])
+            nssel_ml.append([])
+            nssel_ex.append([])
+            continue
         valid[i] = True
         match = _get(_get(c, "spec", {}), "match", {})
         if not isinstance(match, dict):

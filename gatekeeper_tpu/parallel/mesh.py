@@ -135,21 +135,26 @@ def sharded_masks(driver, reviews, mesh: Mesh):
     the resource axis partitioned.  Returns (ordered, mask, autoreject) like
     TpuDriver.compute_masks (R axis trimmed back to the single-device
     bucket so results compare bit-for-bit)."""
-    fn, ordered, rp, cp, cols, group_params = driver._device_inputs(reviews)
+    fn, ordered, rp, cp, cols, group_params, crow = driver._device_inputs(
+        reviews
+    )
     rows = len(rp.arrays["valid"])
     args = (rp.arrays, cp.arrays, cols, group_params)
     placed, target = shard_args(mesh, rows, args)
     with mesh:
         mask, autoreject = fn(*placed)
     both = np.asarray(jax.device_get((mask, autoreject)))
-    return ordered, both[0][:, :rows], both[1][:, :rows]
+    # crow folds the group-major pad rows out (driver._constraint_side)
+    return ordered, both[0][crow][:, :rows], both[1][crow][:, :rows]
 
 
 def sharded_violation_counts(driver, reviews, mesh: Mesh):
     """Per-constraint violation counts with the reduction on-device:
     sum over the sharded R axis (an XLA psum over ICI) so only [C] ints
     cross back to the host."""
-    fn, ordered, rp, cp, cols, group_params = driver._device_inputs(reviews)
+    fn, ordered, rp, cp, cols, group_params, crow = driver._device_inputs(
+        reviews
+    )
     rows = len(rp.arrays["valid"])
     args = (rp.arrays, cp.arrays, cols, group_params)
     placed, target = shard_args(mesh, rows, args)
@@ -165,4 +170,4 @@ def sharded_violation_counts(driver, reviews, mesh: Mesh):
     )
     with mesh:
         counts, rejects = sharded(*placed)
-    return ordered, np.asarray(counts), np.asarray(rejects)
+    return ordered, np.asarray(counts)[crow], np.asarray(rejects)[crow]

@@ -97,7 +97,7 @@ def multihost_capped_sweep(driver, K: int):
     """The full capped-audit device sweep over the multi-host mesh: fused
     evaluation + on-device [C, 1+K] reduction, returned REPLICATED so every
     host can render/write status.  -> (ordered, counts [C], topk [C, K])."""
-    fn, ordered, cp, group_params = driver._audit_inputs(K)
+    fn, ordered, cp, group_params, crow = driver._audit_inputs(K)
     ap = driver._audit_pack
     if ap.n_rows == 0:
         return [], None, None
@@ -122,5 +122,6 @@ def multihost_capped_sweep(driver, K: int):
         driver._multihost_jit = (key, sharded)
     with mesh:
         packed = sharded(rv_g, cs_g, cols_g, gp_g)
-    packed = np.asarray(packed.addressable_data(0))
+    # crow folds group-major pad rows out (driver._constraint_side)
+    packed = np.asarray(packed.addressable_data(0))[crow]
     return ordered, packed[:, 0].astype(np.int64), packed[:, 1:]

@@ -119,7 +119,7 @@ def test_driver_audit_results_identical_on_mesh():
 
 def test_shard_args_places_row_arrays_on_data_axis():
     driver, reviews = _workload(n_templates=4, n_resources=16)
-    fn, _ordered, rp, cp, cols, gp = driver._device_inputs(reviews)
+    fn, _ordered, rp, cp, cols, gp, _crow = driver._device_inputs(reviews)
     rows = len(rp.arrays["valid"])
     mesh = audit_mesh(8)
     placed, target = shard_args(mesh, rows, (rp.arrays, cp.arrays, cols, gp))
