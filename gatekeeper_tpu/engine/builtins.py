@@ -761,9 +761,8 @@ def lookup(path: tuple):
 # OPA v0.21 registry completion (vendored opa/ast/builtins.go).  Infix
 # operators (plus/minus/eq/...) are native BinOps; the RSA/ECDSA JWT and
 # X.509 families ride the installed `cryptography` package; only
-# http.send (no egress) and regex.globs_match remain stubbed to a
-# BuiltinError so policies see undefined rather than silently-wrong
-# results.
+# http.send (no egress) remains stubbed to a BuiltinError so policies
+# see undefined rather than silently-wrong results.
 # --------------------------------------------------------------------------
 
 
@@ -958,9 +957,14 @@ def _shift_arg(n: Any, who: str) -> int:
     """Shift counts must be non-negative (Python << raises ValueError,
     which would surface as a whole-query error instead of OPA's
     builtin-error -> undefined) and bounded (bits.lsh(1, 10**9) would
-    allocate a gigantic int)."""
+    allocate a gigantic int).  Negative counts are a plain builtin error
+    (undefined, matching OPA); over-cap counts fail CLOSED via
+    BuiltinLimitError, like net.cidr_expand's cap — a violation rule must
+    not silently stop firing because an attacker passed a huge shift."""
     v = _int_arg(n, who)
-    _need(0 <= v <= 1 << 20, f"{who}: shift count out of range")
+    _need(v >= 0, f"{who}: negative shift count")
+    if v > 1 << 20:
+        raise BuiltinLimitError(f"{who}: shift count {v} exceeds cap 2^20")
     return v
 
 
@@ -2034,6 +2038,29 @@ def _rego_parse_module(filename: Any, src: Any):
     return _freeze({"package": {"path": pkg_path}, "rules": rules})
 
 
+@builtin("regex", "globs_match")
+def _regex_globs_match(g1: Any, g2: Any):
+    """Non-empty intersection of two regex-style globs.
+
+    Reference: vendor/.../opa/topdown/regex.go:119 (builtinGlobsMatch).
+    Implemented per the documented semantics via a product-NFA emptiness
+    check (engine/globintersect.py); see docs/rego.md for the two
+    documented divergences from the vendored greedy library.
+    """
+    from .globintersect import GlobError, GlobLimitError, globs_intersect
+
+    _need(isinstance(g1, str), "regex.globs_match: not a string")
+    _need(isinstance(g2, str), "regex.globs_match: not a string")
+    try:
+        return globs_intersect(g1, g2)
+    except GlobLimitError as e:
+        # fail CLOSED, like net.cidr_expand's cap: a pathological glob
+        # must not silence a violation rule via undefined
+        raise BuiltinLimitError(f"regex.globs_match: {e}")
+    except GlobError as e:
+        raise BuiltinError(f"regex.globs_match: {e}")
+
+
 def _unsupported_builtin(name: str, why: str, arity: int):
     def stub(*_args):
         raise BuiltinError(f"{name}: {why}")
@@ -2044,7 +2071,6 @@ def _unsupported_builtin(name: str, why: str, arity: int):
 
 for _name, _why, _arity in [
     ("http.send", "outbound HTTP is disabled in this runtime", 1),
-    ("regex.globs_match", "glob-language intersection is not implemented", 2),
 ]:
     REGISTRY[tuple(_name.split("."))] = _unsupported_builtin(_name, _why, _arity)
 

@@ -1,0 +1,248 @@
+"""Glob-language intersection for ``regex.globs_match``.
+
+The reference evaluates this builtin via a vendored glob-intersection
+library (reference: vendor/github.com/open-policy-agent/opa/topdown/
+regex.go:119, which delegates to vendor/github.com/yashtewari/
+glob-intersection/non_empty.go).  The glob dialect is regex-flavoured:
+
+    token   := CHAR | '.' | '[' set ']'        (CHAR may be '\\'-escaped)
+    flagged := token ('+' | '*')?              (at most one flag per token)
+    set     := (CHAR | CHAR '-' CHAR)*         ('-' ranges, inclusive)
+
+OPA documents the builtin as "true if the intersection of the two globs
+matches a non-empty set of non-empty strings".  We implement exactly that
+— each glob is lowered to a small NFA over character classes and the
+product automaton is searched for an accepting path of length >= 1 —
+rather than re-deriving the vendored library's greedy token-gobbling
+scan.  The greedy scan has false negatives (e.g. ``a*`` vs ``a*b*`` is
+reported empty even though "a" is in both languages) and answers true
+for two empty globs (whose only common string is empty).  Both
+divergences-toward-the-documented-spec are listed in docs/rego.md.
+
+Resource bounds (globs may be attacker-derived via AdmissionReview
+content): character classes are interval lists, never materialized
+per-codepoint (``[\\x20-\\U0010FFFE]`` is one (lo, hi) pair), and globs
+longer than TOKEN_CAP tokens raise GlobLimitError -> whole-query error,
+failing CLOSED like net.cidr_expand's expansion cap — a violation rule
+must not be silenced (nor the webhook wedged) by a pathological glob.
+
+Tokenisation validity rules mirror the reference library so that the
+same inputs error (and the builtin call becomes undefined): stray ']',
+a flag with no preceding token, doubled flags, trailing backslash,
+unterminated sets, and malformed '-' ranges are all rejected.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+__all__ = ["GlobError", "GlobLimitError", "globs_intersect", "TOKEN_CAP"]
+
+
+class GlobError(ValueError):
+    """Raised for inputs the glob dialect rejects (-> undefined)."""
+
+
+class GlobLimitError(ValueError):
+    """Raised for globs over the resource cap (-> whole-query error)."""
+
+
+# Worst-case product-BFS work grows ~quartically in token count for
+# adversarial all-starred globs; 64 keeps that under ~100ms while being
+# far beyond any real-world match pattern.
+TOKEN_CAP = 64
+
+# A character class is None for '.' (any character) or a merged, sorted
+# tuple of (lo, hi) inclusive codepoint intervals — possibly empty: the
+# literal '[]' admits no character.  A token is (cls, flag) with flag in
+# {'', '+', '*'}.
+Cls = Optional[Tuple[Tuple[int, int], ...]]
+Token = Tuple[Cls, str]
+
+_FLAGS = {"+", "*"}
+_DOT: Cls = None
+
+
+def _merge_intervals(pairs: List[Tuple[int, int]]) -> Cls:
+    if not pairs:
+        return ()
+    pairs.sort()
+    out = [pairs[0]]
+    for lo, hi in pairs[1:]:
+        plo, phi = out[-1]
+        if lo <= phi + 1:
+            out[-1] = (plo, max(phi, hi))
+        else:
+            out.append((lo, hi))
+    return tuple(out)
+
+
+def _tokenize(pattern: str) -> List[Token]:
+    chars = list(pattern)
+    n = len(chars)
+    i = 0
+    out: List[Token] = []
+    while i < n:
+        c = chars[i]
+        escaped = False
+        if c == "\\":
+            if i + 1 >= n:
+                raise GlobError(f"glob {pattern!r}: trailing escape")
+            i += 1
+            c = chars[i]
+            escaped = True
+        if not escaped and c == "]":
+            raise GlobError(f"glob {pattern!r}: ']' with no preceding '['")
+        if not escaped and c in _FLAGS:
+            raise GlobError(f"glob {pattern!r}: flag {c!r} must follow a token")
+        if not escaped and c == ".":
+            cls: Cls = _DOT
+            i += 1
+        elif not escaped and c == "[":
+            cls, i = _scan_set(pattern, chars, i + 1)
+        else:
+            o = ord(c)
+            cls = ((o, o),)
+            i += 1
+        flag = ""
+        if i < n and chars[i] in _FLAGS:
+            flag = chars[i]
+            i += 1
+        out.append((cls, flag))
+        if len(out) > TOKEN_CAP:
+            raise GlobLimitError(
+                f"glob exceeds {TOKEN_CAP} tokens (length {len(pattern)})"
+            )
+    return out
+
+
+def _scan_set(pattern: str, chars: List[str], i: int) -> Tuple[Cls, int]:
+    """Scan a '[...]' class body starting just past the '['."""
+    n = len(chars)
+    pairs: List[Tuple[int, int]] = []
+    prev: Optional[str] = None  # last single member, eligible as range start
+    while i < n:
+        c = chars[i]
+        escaped = False
+        if c == "\\":
+            if i + 1 >= n:
+                raise GlobError(f"glob {pattern!r}: trailing escape in set")
+            i += 1
+            c = chars[i]
+            escaped = True
+        if not escaped and c == "]":
+            return _merge_intervals(pairs), i + 1
+        if not escaped and c == "-":
+            if prev is None:
+                raise GlobError(f"glob {pattern!r}: '-' needs a range start")
+            if i + 1 >= n:
+                raise GlobError(f"glob {pattern!r}: '-' needs a range end")
+            i += 1
+            hi = chars[i]
+            if hi == "\\":
+                if i + 1 >= n:
+                    raise GlobError(f"glob {pattern!r}: trailing escape in set")
+                i += 1
+                hi = chars[i]
+            elif hi in ("]", "-"):
+                raise GlobError(f"glob {pattern!r}: bad '-' range end {hi!r}")
+            if hi < prev:
+                raise GlobError(
+                    f"glob {pattern!r}: range {prev!r}-{hi!r} out of order"
+                )
+            pairs.append((ord(prev), ord(hi)))
+            prev = None
+            i += 1
+            continue
+        pairs.append((ord(c), ord(c)))
+        prev = c
+        i += 1
+    raise GlobError(f"glob {pattern!r}: '[' without matching ']'")
+
+
+def _classes_meet(a: Cls, b: Cls) -> bool:
+    if a is _DOT:
+        return b is _DOT or bool(b)
+    if b is _DOT:
+        return bool(a)
+    # two-pointer sweep over the sorted interval lists
+    ia = ib = 0
+    while ia < len(a) and ib < len(b):
+        alo, ahi = a[ia]
+        blo, bhi = b[ib]
+        if ahi < blo:
+            ia += 1
+        elif bhi < alo:
+            ib += 1
+        else:
+            return True
+    return False
+
+
+class _Nfa:
+    """NFA over character classes for one glob.
+
+    States are 0..len(tokens); state k sits *before* token k and
+    len(tokens) is the sole accepting state.  Consuming edges carry the
+    token's class; '*' additionally makes its state skippable (an
+    epsilon edge k -> k+1) and both flags add a self-loop so the class
+    may repeat ('+' loops on the target state: a+ == a a*).
+
+    Epsilon edges stay EXPLICIT (never closure-expanded): the product
+    BFS walks them as zero-cost moves.  Each state has at most 3 raw
+    consuming edges, so total BFS work is O(|states_a| * |states_b|) —
+    closure expansion would make adversarial all-starred globs
+    quartic (the code-review DoS finding).
+    """
+
+    def __init__(self, tokens: List[Token]):
+        self.n = len(tokens)
+        self.accept = self.n
+        self.edges: List[List[Tuple[Cls, int]]] = [
+            [] for _ in range(self.n + 1)
+        ]
+        self.eps_next: List[bool] = [False] * (self.n + 1)
+        for k, (cls, flag) in enumerate(tokens):
+            self.edges[k].append((cls, k + 1))
+            if flag == "+":
+                self.edges[k + 1].append((cls, k + 1))
+            elif flag == "*":
+                self.edges[k].append((cls, k))
+                self.eps_next[k] = True
+
+
+def globs_intersect(lhs: str, rhs: str) -> bool:
+    """True iff some non-empty string is matched by both globs."""
+    a = _Nfa(_tokenize(lhs))
+    b = _Nfa(_tokenize(rhs))
+    # Product-automaton BFS over (state_a, state_b, consumed) triples,
+    # where consumed records whether >= 1 character has been jointly
+    # consumed — acceptance only counts with consumed=1, which encodes
+    # OPA's documented "non-empty string" requirement.  Epsilon moves
+    # advance one side for free and never change consumed.
+    start = (0, 0, 0)
+    seen = {start}
+    stack = [start]
+    while stack:
+        p, q, consumed = stack.pop()
+        if p == a.accept and q == b.accept and consumed:
+            return True
+        if a.eps_next[p]:
+            t = (p + 1, q, consumed)
+            if t not in seen:
+                seen.add(t)
+                stack.append(t)
+        if b.eps_next[q]:
+            t = (p, q + 1, consumed)
+            if t not in seen:
+                seen.add(t)
+                stack.append(t)
+        for (ca, p2) in a.edges[p]:
+            for (cb, q2) in b.edges[q]:
+                if not _classes_meet(ca, cb):
+                    continue
+                t = (p2, q2, 1)
+                if t not in seen:
+                    seen.add(t)
+                    stack.append(t)
+    return False

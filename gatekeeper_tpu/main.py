@@ -349,6 +349,9 @@ class App:
     def start(self):
         args = self.args
         self._stopping = False  # a stopped App may be restarted
+        from .ops.deltasweep import BG_STOP
+
+        BG_STOP.clear()  # re-arm background workers after a stop()
         # cert bootstrap gates everything (main.go:219-220); write_cert_files
         # runs ensure_certs synchronously, so readiness is set before start()
         # spins the refresh thread
@@ -487,14 +490,23 @@ class App:
         if getattr(driver, "DEVICE_MIN_CELLS", 0) == 0:
             return  # forced-device configuration
 
+        from .ops.deltasweep import BG_STOP
+
         def run():
-            import time as _time
+            def stopped() -> bool:
+                return self._stopping or BG_STOP.is_set()
 
             for _ in range(30):
-                if self._stopping:
+                if stopped():
                     return
                 try:
-                    driver.wait_ready(timeout=30.0)
+                    # the 30s ready-wait in interruptible 2s slices, so
+                    # interpreter exit never stalls behind it
+                    for _ in range(15):
+                        if stopped():
+                            return
+                        if driver.wait_ready(timeout=2.0):
+                            break
                     if driver.calibrate_routing() is not None:
                         cal = driver._route_cal
                         log.info(
@@ -510,7 +522,8 @@ class App:
                         return
                 except Exception:
                     log.exception("routing calibration attempt failed")
-                _time.sleep(10.0)
+                if BG_STOP.wait(10.0):
+                    return
 
         from .ops.deltasweep import spawn_bg
 
@@ -518,6 +531,11 @@ class App:
 
     def stop(self):
         self._stopping = True
+        # unblock the calibration loop's Event.wait promptly; restarts
+        # re-arm it (BG_STOP is also set at interpreter exit)
+        from .ops.deltasweep import BG_STOP
+
+        BG_STOP.set()
         for component in (
             self.audit_manager,
             self.webhook_server,

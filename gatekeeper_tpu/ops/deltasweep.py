@@ -44,6 +44,13 @@ import weakref as _weakref
 
 _BG_THREADS = _weakref.WeakSet()
 
+# Set at interpreter exit (and by App.stop): long-lived cooperative
+# workers (the routing-calibration loop) wait on this instead of
+# sleeping, so a process that exits without App.stop() — signal, short
+# CLI run — does not stall shutdown for a full sleep interval per live
+# thread (advisor r4).
+BG_STOP = _threading.Event()
+
 
 @_atexit.register
 def _join_bg_threads():
@@ -52,6 +59,7 @@ def _join_bg_threads():
     # terminate in the multi-host lane).  atexit hooks run LIFO, so this
     # one (registered after jax's import-time hooks) runs before jax
     # tears down.
+    BG_STOP.set()
     for t in list(_BG_THREADS):
         t.join(timeout=120.0)
 

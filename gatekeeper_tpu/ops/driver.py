@@ -356,9 +356,13 @@ class TpuDriver(InterpDriver):
         # the compiled executable.  Pad rows pack as None (valid=False:
         # the match kernel keeps them all-False, so whatever a group's
         # padded program rows compute is ANDed away).
-        ordered2: List[Tuple[str, str, dict]] = []
+        # crow[i] = the padded-layout mask row of sorted constraint i, so
+        # every host-side gather (masks, counts, topk) lands in sorted
+        # (kind, name) order — per-review violation ordering is then
+        # identical across the device, interp, memo-replay, and traced
+        # paths (advisor r4).
         padded_cs: List[Optional[dict]] = []
-        crow: List[int] = []
+        crow: List[int] = [0] * len(ordered)
         groups = []
         for _sk, (prog, idxs) in sorted(by_struct.items()):
             for spec in prog.column_specs:
@@ -367,19 +371,17 @@ class TpuDriver(InterpDriver):
             B = _bucket_pow2(len(kcs))
             start = len(padded_cs)
             for i in idxs:
-                crow.append(len(padded_cs))
-                ordered2.append(ordered[i])
+                crow[i] = len(padded_cs)
                 padded_cs.append(ordered[i][2])
             padded_cs.extend([None] * (B - len(kcs)))
             packed = pack_params(kcs, prog, self.interner, self.pred_cache, B)
             groups.append((prog, start, B, packed))
         for i in ungrouped:
-            crow.append(len(padded_cs))
-            ordered2.append(ordered[i])
+            crow[i] = len(padded_cs)
             padded_cs.append(ordered[i][2])
         cp = pack_constraints(padded_cs, self.interner)
         side = (
-            ordered2, cp, groups, list(specs.values()),
+            ordered, cp, groups, list(specs.values()),
             np.asarray(crow, np.int64),
         )
         # key uses the vocab size BEFORE param packing interned new strings;

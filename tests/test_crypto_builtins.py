@@ -350,20 +350,24 @@ class TestRegistryHygiene:
         assert not missing, f"builtins without declared arity: {missing}"
 
     def test_remaining_stubs_are_truthful(self):
-        """Only http.send (no egress: true) and regex.globs_match may stub."""
+        """Only http.send (no egress: true) may stub."""
         stubs = []
         for path, fn in REGISTRY.items():
             if fn.__name__ == "stub":
                 stubs.append(".".join(path))
-        assert sorted(stubs) == ["http.send", "regex.globs_match"]
+        assert sorted(stubs) == ["http.send"]
 
     def test_shift_guards(self):
+        # Negative counts: builtin error -> undefined (matches OPA).
         with pytest.raises(BuiltinError):
             run_bi("bits.lsh", 1, -1)
         with pytest.raises(BuiltinError):
-            run_bi("bits.lsh", 1, 10**9)
-        with pytest.raises(BuiltinError):
             run_bi("bits.rsh", 1, -1)
+        # Over-cap counts fail CLOSED, like net.cidr_expand's cap.
+        with pytest.raises(BuiltinLimitError):
+            run_bi("bits.lsh", 1, 10**9)
+        with pytest.raises(BuiltinLimitError):
+            run_bi("bits.rsh", 1, 10**9)
 
     def test_cidr_expand_fails_closed(self):
         assert len(run_bi("net.cidr_expand", "10.0.0.0/30")) == 4
