@@ -82,21 +82,79 @@ def test_non_default_values_take_effect():
     vals.update(logDenies=False, emitAuditEvents=True, auditFromCache=True,
                 tpuResource="cloud-tpus.google.com/v2", tpuCount=4,
                 exemptNamespaces=["a", "b"], webhookPort=9443,
-                driver="interp", prometheusPort=9999)
+                driver="interp", prometheusPort=9999,
+                logLevel="DEBUG", auditChunkSize=500,
+                image={"repository": "gatekeeper-tpu", "tag": "latest",
+                       "pullPolicy": "Always"},
+                nodeSelector={"pool": "tpu"},
+                affinity={"nodeAffinity": {"weight": 1}},
+                tolerations=[{"key": "tpu", "operator": "Exists"}],
+                podAnnotations={"a/b": "c"},
+                resources={"limits": {"cpu": "2000m", "memory": "1Gi"},
+                           "requests": {"cpu": "500m", "memory": "512Mi"}})
     text = helmify.render_chart(vals)
     docs = {(d["kind"], d["metadata"]["name"]): d
             for d in yaml.safe_load_all(text) if d}
     cm = docs[("Deployment", "gatekeeper-controller-manager")]
-    spec = cm["spec"]["template"]["spec"]["containers"][0]
+    tspec = cm["spec"]["template"]["spec"]
+    spec = tspec["containers"][0]
     assert "--log-denies" not in spec["args"]
     assert "--exempt-namespace=a" in spec["args"]
     assert "--exempt-namespace=b" in spec["args"]
     assert "--driver=interp" in spec["args"]
     assert "--port=9443" in spec["args"]
+    assert "--log-level=DEBUG" in spec["args"]
+    assert spec["imagePullPolicy"] == "Always"
     ports = {p.get("name"): p["containerPort"] for p in spec["ports"]}
     assert ports["webhook"] == 9443 and ports["metrics"] == 9999
+    assert tspec["nodeSelector"] == {"pool": "tpu"}
+    assert tspec["affinity"] == {"nodeAffinity": {"weight": 1}}
+    assert tspec["tolerations"] == [{"key": "tpu", "operator": "Exists"}]
+    annotations = cm["spec"]["template"]["metadata"]["annotations"]
+    assert annotations == {"a/b": "c"}
     aud = docs[("Deployment", "gatekeeper-audit")]
     aspec = aud["spec"]["template"]["spec"]["containers"][0]
     assert "--audit-from-cache" in aspec["args"]
     assert "--emit-audit-events" in aspec["args"]
-    assert aspec["resources"]["limits"] == {"cloud-tpus.google.com/v2": "4"}
+    assert "--audit-chunk-size=500" in aspec["args"]
+    assert aspec["resources"]["limits"] == {
+        "cpu": "2000m", "memory": "1Gi", "cloud-tpus.google.com/v2": "4"}
+    assert aspec["resources"]["requests"] == {
+        "cpu": "500m", "memory": "512Mi"}
+
+
+def test_disable_validating_webhook_removes_registration():
+    vals = dict(helmify.VALUES_DEFAULTS, disableValidatingWebhook=True)
+    docs = {(d["kind"], d["metadata"]["name"])
+            for d in yaml.safe_load_all(helmify.render_chart(vals)) if d}
+    assert not any(k == "ValidatingWebhookConfiguration" for k, _ in docs)
+    # and present at defaults
+    docs0 = {(d["kind"], d["metadata"]["name"]) for d in yaml.safe_load_all(
+        helmify.render_chart(helmify.VALUES_DEFAULTS)) if d}
+    assert any(k == "ValidatingWebhookConfiguration" for k, _ in docs0)
+
+
+def test_reference_values_surface_is_covered():
+    """Every key of the reference chart's values.yaml
+    (/root/reference/charts/gatekeeper/values.yaml:1-25) must exist in
+    this chart's values with the same default semantics (image.release
+    is named image.tag here), and be documented in the chart README."""
+    ref_keys = {
+        "replicas", "auditInterval", "constraintViolationsLimit",
+        "auditFromCache", "disableValidatingWebhook", "auditChunkSize",
+        "logLevel", "emitAdmissionEvents", "emitAuditEvents",
+        "nodeSelector", "affinity", "tolerations", "podAnnotations",
+        "resources",
+    }
+    missing = ref_keys - set(helmify.VALUES_DEFAULTS)
+    assert not missing, f"reference values keys not exposed: {missing}"
+    for sub in ("repository", "pullPolicy", "tag"):  # image.release -> tag
+        assert sub in helmify.VALUES_DEFAULTS["image"]
+    readme = os.path.join(helmify.CHART, "README.md")
+    with open(readme) as f:
+        text = f.read()
+    documented = {k for k, _, _ in helmify.README_PARAMS}
+    undocumented = (ref_keys | {"image.pullPolicy"}) - documented
+    assert not undocumented, f"README missing params: {undocumented}"
+    for k, _, _ in helmify.README_PARAMS:
+        assert k in text

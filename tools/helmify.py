@@ -49,8 +49,53 @@ heritage: {{ .Release.Service }}
 REPLACEMENTS = [
     ("image: gatekeeper-tpu:latest",
      "image", "image: {{ .Values.image.repository }}:{{ .Values.image.tag }}"),
+    ("imagePullPolicy: IfNotPresent",
+     "image", "imagePullPolicy: {{ .Values.image.pullPolicy }}"),
     ("replicas: 3",
      "replicas", "replicas: {{ .Values.replicas }}"),
+    ("- --log-level=INFO",
+     "logLevel", "- --log-level={{ .Values.logLevel }}"),
+    ("- --audit-chunk-size=0",
+     "auditChunkSize",
+     "- --audit-chunk-size={{ .Values.auditChunkSize }}"),
+    # pod scheduling knobs (reference charts/gatekeeper/values.yaml:14-16):
+    # the nodeSelector literal anchors the affinity/tolerations
+    # conditionals, which are absent from the manifest (empty defaults)
+    ("      nodeSelector:\n        kubernetes.io/os: linux",
+     "nodeSelector",
+     "      nodeSelector:\n"
+     "        {{- toYaml .Values.nodeSelector | nindent 8 }}\n"
+     "      {{- if .Values.affinity }}\n"
+     "      affinity:\n"
+     "        {{- toYaml .Values.affinity | nindent 8 }}\n"
+     "      {{- end }}\n"
+     "      {{- if .Values.tolerations }}\n"
+     "      tolerations:\n"
+     "        {{- toYaml .Values.tolerations | nindent 8 }}\n"
+     "      {{- end }}"),
+    ("      annotations:\n"
+     "        container.seccomp.security.alpha.kubernetes.io/manager: "
+     "runtime/default",
+     "podAnnotations",
+     "      annotations:\n"
+     "        {{- toYaml .Values.podAnnotations | nindent 8 }}"),
+    ("          resources:\n"
+     "            limits:\n"
+     "              cpu: 1000m\n"
+     "              memory: 512Mi\n"
+     '              google.com/tpu: "1"\n'
+     "            requests:\n"
+     "              cpu: 100m\n"
+     "              memory: 256Mi",
+     "resources",
+     "          resources:\n"
+     "            limits:\n"
+     "              cpu: {{ .Values.resources.limits.cpu }}\n"
+     "              memory: {{ .Values.resources.limits.memory }}\n"
+     '              {{ .Values.tpuResource }}: "{{ .Values.tpuCount }}"\n'
+     "            requests:\n"
+     "              cpu: {{ .Values.resources.requests.cpu }}\n"
+     "              memory: {{ .Values.resources.requests.memory }}"),
     ("- --audit-interval=60",
      "auditInterval", "- --audit-interval={{ .Values.auditInterval }}"),
     ("- --constraint-violations-limit=20",
@@ -63,9 +108,6 @@ REPLACEMENTS = [
     ("port: 8443", "webhookPort", "port: {{ .Values.webhookPort }}"),
     ("containerPort: 8888",
      "prometheusPort", "containerPort: {{ .Values.prometheusPort }}"),
-    ('google.com/tpu: "1"',
-     "tpuResource",
-     '{{ .Values.tpuResource }}: "{{ .Values.tpuCount }}"'),
     # boolean flag present in the manifest -> gated on a value (default
     # matches the manifest: enabled)
     ("- --log-denies",
@@ -104,10 +146,18 @@ REPLACEMENTS = [
 # every key here is referenced by a template expression in REPLACEMENTS —
 # a knob with no template reference would be silently discarded at install
 VALUES_DEFAULTS = {
-    "image": {"repository": "gatekeeper-tpu", "tag": "latest"},
+    "image": {
+        "repository": "gatekeeper-tpu",
+        "tag": "latest",
+        "pullPolicy": "IfNotPresent",
+    },
     "replicas": 3,
     "auditInterval": 60,
     "constraintViolationsLimit": 20,
+    "auditFromCache": False,
+    "auditChunkSize": 0,
+    "disableValidatingWebhook": False,
+    "logLevel": "INFO",
     "driver": "tpu",
     "webhookPort": 8443,
     "prometheusPort": 8888,
@@ -116,9 +166,97 @@ VALUES_DEFAULTS = {
     "logDenies": True,  # the deploy manifest enables it
     "exemptNamespaces": ["gatekeeper-system"],
     "emitAdmissionEvents": False,
-    "auditFromCache": False,
     "emitAuditEvents": False,
+    "nodeSelector": {"kubernetes.io/os": "linux"},
+    "affinity": {},
+    "tolerations": [],
+    "podAnnotations": {
+        "container.seccomp.security.alpha.kubernetes.io/manager":
+            "runtime/default",
+    },
+    "resources": {
+        "limits": {"cpu": "1000m", "memory": "512Mi"},
+        "requests": {"cpu": "100m", "memory": "256Mi"},
+    },
 }
+
+# README parameter table: every key of the reference chart's values
+# surface (/root/reference/charts/gatekeeper/values.yaml:1-25) plus the
+# TPU-specific knobs.  tests/test_helmify.py asserts the reference key
+# set is covered.
+README_PARAMS = [
+    ("auditInterval", "The frequency with which audit is run", "`60`"),
+    ("constraintViolationsLimit",
+     "The maximum # of audit violations reported on a constraint", "`20`"),
+    ("auditFromCache",
+     "Take the roster of resources to audit from the inventory cache",
+     "`false`"),
+    ("auditChunkSize",
+     "Chunk size for listing cluster resources for audit", "`0`"),
+    ("disableValidatingWebhook", "Disable ValidatingWebhook", "`false`"),
+    ("emitAdmissionEvents",
+     "Emit K8s events in gatekeeper namespace for admission violations",
+     "`false`"),
+    ("emitAuditEvents",
+     "Emit K8s events in gatekeeper namespace for audit violations",
+     "`false`"),
+    ("logLevel", "Minimum log level", "`INFO`"),
+    ("logDenies", "Log all denies (reference --log-denies flag)", "`true`"),
+    ("image.pullPolicy", "The image pull policy", "`IfNotPresent`"),
+    ("image.repository", "Image repository", "`gatekeeper-tpu`"),
+    ("image.tag", "The image tag to use", "`latest`"),
+    ("resources", "The resource request/limits for the container image",
+     "limits: 1 CPU, 512Mi, requests: 100m CPU, 256Mi"),
+    ("nodeSelector", "The node selector to use for pod scheduling",
+     "`kubernetes.io/os: linux`"),
+    ("affinity", "The node affinity to use for pod scheduling", "`{}`"),
+    ("tolerations", "The tolerations to use for pod scheduling", "`[]`"),
+    ("replicas", "The number of webhook replicas to deploy", "`3`"),
+    ("podAnnotations", "The annotations to add to the pods",
+     "`container.seccomp.security.alpha.kubernetes.io/manager: "
+     "runtime/default`"),
+    ("exemptNamespaces", "Namespaces exempted from admission",
+     "`[gatekeeper-system]`"),
+    ("driver", "Evaluation backend (`tpu` or `interp`)", "`tpu`"),
+    ("webhookPort", "Webhook HTTPS port", "`8443`"),
+    ("prometheusPort", "Prometheus metrics port", "`8888`"),
+    ("tpuResource", "Accelerator resource name requested by the pods",
+     "`google.com/tpu`"),
+    ("tpuCount", "Accelerators per pod", "`1`"),
+]
+
+
+def render_readme() -> str:
+    rows = "\n".join(
+        f"| {k} | {d} | {v} |" for k, d, v in README_PARAMS
+    )
+    return f"""\
+# gatekeeper-tpu Helm Chart
+
+TPU-native Gatekeeper-class policy controller: validating admission
+webhook plus audit, evaluating constraints on a vectorized JAX/TPU
+backend.
+
+## Install
+
+```bash
+helm install gatekeeper-tpu ./charts/gatekeeper-tpu
+```
+
+## Parameters
+
+| Parameter | Description | Default |
+|:----------|:------------|:--------|
+{rows}
+
+## Contributing Changes
+
+This chart is autogenerated from the static manifest
+`deploy/gatekeeper.yaml` by `tools/helmify.py` (the analogue of the
+reference's `cmd/build/helmify`).  Edit the manifest and/or the
+generator and run `python tools/helmify.py`; `tests/test_helmify.py`
+fails if the committed chart drifts from the generator output.
+"""
 
 _KIND_RE = re.compile(r"^kind:\s+(\S+)\s*$", re.MULTILINE)
 # exactly two spaces: metadata.name (helmify main.go:26-27)
@@ -158,10 +296,11 @@ def render_values(values: dict, indent: int = 0) -> str:
     lines = []
     pad = "  " * indent
     for k, v in values.items():
-        if isinstance(v, dict):
+        if isinstance(v, dict) and v:
             lines.append(f"{pad}{k}:")
             lines.append(render_values(v, indent + 1))
         else:
+            # empty dicts inline as {} — a dangling "key:" parses as null
             lines.append(f"{pad}{k}: {json.dumps(v)}")
     return "\n".join(lines)
 
@@ -173,6 +312,7 @@ def generate() -> dict:
     out = {
         "Chart.yaml": CHART_YAML,
         "values.yaml": render_values(VALUES_DEFAULTS) + "\n",
+        "README.md": render_readme(),
         "templates/_helpers.tpl": HELPERS_TPL,
     }
     for doc in split_docs(manifest):
@@ -184,6 +324,14 @@ def generate() -> dict:
         else:
             rel = f"templates/{fname}"
             content = template_doc(doc)
+            if kind == "ValidatingWebhookConfiguration":
+                # reference chart knob: the whole webhook registration
+                # is omitted when disableValidatingWebhook=true
+                content = (
+                    "{{- if not .Values.disableValidatingWebhook }}\n"
+                    + content.rstrip("\n")
+                    + "\n{{- end }}"
+                )
         out[rel] = content.rstrip("\n") + "\n"
     for rel, content in out.items():
         path = os.path.join(CHART, rel)
@@ -193,18 +341,54 @@ def generate() -> dict:
     return out
 
 
+def _to_yaml(v, indent: int) -> str:
+    """Tiny toYaml: dicts/lists of scalars and nested dicts, at the
+    given absolute indent (first line unindented; callers place it)."""
+    import json
+
+    pad = " " * indent
+    if isinstance(v, dict):
+        lines = []
+        for k, val in v.items():
+            if isinstance(val, (dict, list)) and val:
+                lines.append(f"{pad}{k}:")
+                lines.append(_to_yaml(val, indent + 2))
+            else:
+                lines.append(f"{pad}{k}: {json.dumps(val)}")
+        return "\n".join(lines)
+    if isinstance(v, list):
+        lines = []
+        for item in v:
+            body = _to_yaml(item, indent + 2)
+            lines.append(f"{pad}- {body[indent + 2:]}" if isinstance(
+                item, (dict, list)) else f"{pad}- {json.dumps(item)}")
+        return "\n".join(lines)
+    return f"{pad}{json.dumps(v)}"
+
+
 def _render_blocks(text: str, values: dict) -> str:
-    """Evaluate the {{- if .Values.x }} / {{- range .Values.x }} line
-    blocks this generator emits (non-nested)."""
+    """Evaluate the {{- if [not] .Values.x }} / {{- range .Values.x }} /
+    {{- toYaml .Values.x | nindent N }} line blocks this generator emits
+    (non-nested)."""
     out = []
     lines = text.splitlines()
     i = 0
     end_re = re.compile(r"\s*\{\{- end \}\}\s*$")
-    if_re = re.compile(r"\s*\{\{- if \.Values\.(\w+) \}\}\s*$")
+    if_re = re.compile(r"\s*\{\{- if (not )?\.Values\.(\w+) \}\}\s*$")
     range_re = re.compile(r"\s*\{\{- range \.Values\.(\w+) \}\}\s*$")
+    toyaml_re = re.compile(
+        r"\s*\{\{- toYaml \.Values\.(\w+) \| nindent (\d+) \}\}\s*$"
+    )
     while i < len(lines):
         m_if = if_re.match(lines[i])
         m_rg = range_re.match(lines[i])
+        m_ty = toyaml_re.match(lines[i])
+        if m_ty:
+            v = values.get(m_ty.group(1))
+            if v:
+                out.append(_to_yaml(v, int(m_ty.group(2))))
+            i += 1
+            continue
         if m_if or m_rg:
             body = []
             i += 1
@@ -213,8 +397,12 @@ def _render_blocks(text: str, values: dict) -> str:
                 i += 1
             i += 1  # the {{- end }} line
             if m_if:
-                if values.get(m_if.group(1)):
-                    out.extend(body)
+                truthy = bool(values.get(m_if.group(2)))
+                if truthy != bool(m_if.group(1)):  # group(1): "not "
+                    # recurse: toYaml lines may sit inside an if body
+                    out.extend(
+                        _render_blocks("\n".join(body), values).splitlines()
+                    )
             else:
                 for item in values.get(m_rg.group(1), ()):
                     out.extend(b.replace("{{ . }}", str(item)) for b in body)
