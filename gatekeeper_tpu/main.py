@@ -512,11 +512,7 @@ class App:
                         log.info(
                             "routing calibrated",
                             extra={"kv": {
-                                "rtt_ms": round(cal["rtt_ms"], 3),
-                                "device_cells_per_ms": round(
-                                    cal["device_cells_per_ms"], 1),
-                                "interp_cells_per_ms": round(
-                                    cal["interp_cells_per_ms"], 1),
+                                k: round(v, 3) for k, v in cal.items()
                             }},
                         )
                         return

@@ -109,7 +109,19 @@ class AsyncCompiler:
 
     # -- compile loop --------------------------------------------------------
 
+    # Debounce: wait for the epoch to hold still this long before tracing.
+    # During a template-ingest storm every mutation bumps the epoch; eagerly
+    # compiling each one keeps this thread perpetually TRACING — pure-Python
+    # work that holds the GIL and measurably taxes concurrent admission
+    # serving (the numpy serving path needs no executable, so there is
+    # nothing to gain from compiling mid-storm).  Bounded so sustained
+    # churn still compiles at least every DEBOUNCE_MAX_S.
+    DEBOUNCE_S = 0.25
+    DEBOUNCE_MAX_S = 10.0
+
     def _run(self):
+        import time as _time
+
         d = self._driver
         while True:
             with self._cond:
@@ -117,6 +129,15 @@ class AsyncCompiler:
                     self._cond.wait()
                 if self._stopped:
                     return
+            t0 = _time.monotonic()
+            while _time.monotonic() - t0 < self.DEBOUNCE_MAX_S:
+                epoch = d._cs_epoch
+                with self._cond:
+                    if self._stopped:
+                        return
+                    self._cond.wait(self.DEBOUNCE_S)
+                if d._cs_epoch == epoch:
+                    break  # settled
             epoch = d._cs_epoch
             try:
                 self._compile_epoch(epoch)

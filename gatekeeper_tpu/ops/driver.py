@@ -142,12 +142,17 @@ class TpuDriver(InterpDriver):
         self._request_memo: Dict[Tuple, tuple] = {}
         self._request_memo_epoch = -1
         self._request_memo_ok = None
+        # (kind, name) of constraints whose cells are NOT content-
+        # determined; maintained incrementally by the mutators
+        self._memoable_false: set = set()
         self._cs_change_log: List[Tuple[int, str, Optional[str]]] = []
         self._cs_log_floor = 0  # entries with epoch > floor are complete
         # constraint-side packing is invalidated on any template/constraint
         # mutation and on vocabulary growth (str-pred tables are vocab-sized)
         self._cs_epoch = 0
         self._cs_cache = None
+        self._ordered_cache = None  # (epoch, sorted constraint list)
+        self._gvk_cache = None  # (epoch, {(group, kind): entries}, nssel)
         # bumped only when the fused executable is actually rebuilt (its
         # structure signature changed); dependent jits key on this, so
         # shape-stable constraint churn preserves every warm executable
@@ -182,6 +187,14 @@ class TpuDriver(InterpDriver):
         # measured routing cost model (calibrate_routing); None -> the
         # static DEVICE_MIN_CELLS prior decides interp-vs-device
         self._route_cal: Optional[Dict[str, float]] = None
+        # incremental host-serving constraint side (ops/npside.py):
+        # admission-sized batches evaluate the same VExpr IR in numpy —
+        # no dispatch RTT, no compile, O(1) maintenance per mutation.
+        # GK_NP_SERVE=0 disables (reviews then interp-walk as before).
+        from .npside import NpSide
+
+        self.np_serve_enabled = os.environ.get("GK_NP_SERVE", "1") != "0"
+        self._np_side = NpSide()
         # async ingestion (SURVEY §7 hard-part 3): template/constraint
         # mutations hand the XLA re-compile to a background thread and
         # reviews serve from the interpreter until the new fused
@@ -252,6 +265,45 @@ class TpuDriver(InterpDriver):
             self._cs_log_floor = self._cs_change_log[drop - 1][0]
             del self._cs_change_log[:drop]
 
+    def _memoable_update(self, kind: str, name: Optional[str]):
+        """Incrementally maintain the set of constraints whose cells are
+        NOT content-determined — _request_memoable is then O(1) instead
+        of an O(installed constraints) all() per epoch bump, which
+        measurably taxed every mid-storm admission (caller holds lock)."""
+        tmpl = self.templates.get(kind)
+        names = (
+            [name] if name is not None
+            else list(self.constraints.get(kind, {}))
+        )
+        for n in names:
+            c = self.constraints.get(kind, {}).get(n)
+            key = (kind, n)
+            if c is not None and not self._cell_memoable(tmpl, c):
+                self._memoable_false.add(key)
+            else:
+                self._memoable_false.discard(key)
+
+    def _ordered_update(self, kind: str, name: str):
+        """Incrementally maintain the sorted constraint list (bisect):
+        template churn must not re-sort 500 constraints per admission."""
+        cached = self._ordered_cache
+        if cached is None:
+            return
+        lst = cached[1]
+        from bisect import bisect_left
+
+        cur = self.constraints.get(kind, {}).get(name)
+        i = bisect_left(lst, (kind, name), key=lambda e: (e[0], e[1]))
+        present = i < len(lst) and lst[i][:2] == (kind, name)
+        if cur is None:
+            if present:
+                del lst[i]
+        elif present:
+            lst[i] = (kind, name, cur)
+        else:
+            lst.insert(i, (kind, name, cur))
+        self._ordered_cache = (self._cs_epoch, lst)
+
     def put_template(self, kind: str, artifact: CompiledTemplate):
         # all mutators hold the driver lock for their FULL body (the async
         # compiler snapshots under this lock) and bump the epoch last, so a
@@ -260,6 +312,9 @@ class TpuDriver(InterpDriver):
             super().put_template(kind, artifact)
             self.programs[kind] = vectorize(artifact.policy)
             self._cs_epoch += 1
+            self._memoable_update(kind, None)
+            if self._ordered_cache is not None:
+                self._ordered_cache = (self._cs_epoch, self._ordered_cache[1])
             self._log_cs_change(kind, None)
         self._epoch_bumped()
 
@@ -268,6 +323,14 @@ class TpuDriver(InterpDriver):
             self.programs.pop(kind, None)
             out = super().delete_template(kind)
             self._cs_epoch += 1
+            # the base delete cascades the kind's constraints away, so the
+            # incremental caches must drop them too (not just re-stamp):
+            # stale entries would keep evaluating deleted constraints and
+            # permanently disable the request memo (advisor r5)
+            self._memoable_false = {
+                key for key in self._memoable_false if key[0] != kind
+            }
+            self._ordered_cache = None
             self._log_cs_change(kind, None)
         self._epoch_bumped()
         return out
@@ -276,6 +339,8 @@ class TpuDriver(InterpDriver):
         with self._lock:
             super().put_constraint(kind, name, constraint)
             self._cs_epoch += 1
+            self._memoable_update(kind, name)
+            self._ordered_update(kind, name)
             self._log_cs_change(kind, name)
         self._epoch_bumped()
 
@@ -283,6 +348,8 @@ class TpuDriver(InterpDriver):
         with self._lock:
             out = super().delete_constraint(kind, name)
             self._cs_epoch += 1
+            self._memoable_update(kind, name)
+            self._ordered_update(kind, name)
             self._log_cs_change(kind, name)
         self._epoch_bumped()
         return out
@@ -311,6 +378,8 @@ class TpuDriver(InterpDriver):
             self._cs_epoch += 1
             # wholesale wipe: the change log cannot describe a reset
             self._request_memo.clear()
+            self._memoable_false.clear()
+            self._ordered_cache = None
             self._cs_change_log.clear()
             self._cs_log_floor = self._cs_epoch
         self._epoch_bumped()
@@ -318,10 +387,14 @@ class TpuDriver(InterpDriver):
     # ---- device evaluation ------------------------------------------------
 
     def _ordered_constraints(self) -> List[Tuple[str, str, dict]]:
+        cached = self._ordered_cache
+        if cached is not None and cached[0] == self._cs_epoch:
+            return cached[1]
         out = []
         for kind in sorted(self.constraints):
             for name in sorted(self.constraints[kind]):
                 out.append((kind, name, self.constraints[kind][name]))
+        self._ordered_cache = (self._cs_epoch, out)
         return out
 
     def _constraint_side(self):
@@ -703,18 +776,70 @@ class TpuDriver(InterpDriver):
         C-constraint walk can be served from one dict hit, which is what
         keeps p50 flat for replica/retry storms at large constraint counts
         (the reference re-runs the full Rego scan per request,
-        target_template_source.go:27-44)."""
-        flag = self._request_memo_ok
-        if flag is None:
-            flag = all(
-                self._cell_memoable(self.templates.get(kind), constraint)
-                for kind, by_name in self.constraints.items()
-                for constraint in by_name.values()
-            )
-            self._request_memo_ok = flag
+        target_template_source.go:27-44).  O(1): the mutators maintain
+        _memoable_false incrementally (_memoable_update)."""
+        flag = not self._memoable_false
+        self._request_memo_ok = flag
         return flag
 
-    def _interp_review_memo(self, review: dict):
+    def _gvk_walk_list(self, review: dict) -> List[Tuple[str, str, dict]]:
+        """The sorted constraint subset an interp walk must visit for this
+        review: constraints whose match.kinds could possibly hit the
+        review's (group, kind) — exact pairs plus wildcard buckets — and
+        every namespaceSelector-carrying constraint (autoreject is kind-
+        independent, target_template_source.go:12-25).  The index mirrors
+        pack_constraints' kind-pair semantics (an entry with empty
+        apiGroups or kinds contributes no pairs and never matches).
+        This is the reference's matching_constraints linear scan replaced
+        by a GVK index so a 500-template install does not tax reviews of
+        unrelated kinds (audit already kind-pre-filters)."""
+        idx = self._gvk_cache
+        if idx is None or idx[0] != self._cs_epoch:
+            by_pair: Dict[Tuple[str, str], list] = {}
+            nssel: list = []
+            for entry in self._ordered_constraints():
+                _kind, _name, c = entry
+                match = (c.get("spec") or {}).get("match") or {}
+                if not isinstance(match, dict):
+                    match = {}
+                if "namespaceSelector" in match:
+                    nssel.append(entry)
+                kinds = match.get("kinds")
+                if kinds is None:
+                    # missing OR explicit null both mean wildcard — the
+                    # oracle's _get and pack.py:298 treat them identically
+                    kinds = [{"apiGroups": ["*"], "kinds": ["*"]}]
+                if isinstance(kinds, list):
+                    for ks in kinds:
+                        if not isinstance(ks, dict):
+                            continue
+                        for g in ks.get("apiGroups") or []:
+                            for k in ks.get("kinds") or []:
+                                by_pair.setdefault(
+                                    (str(g), str(k)), []
+                                ).append(entry)
+            idx = (self._cs_epoch, by_pair, nssel)
+            self._gvk_cache = idx
+        _epoch, by_pair, nssel = idx
+        rk = review.get("kind")
+        g = rk.get("group") if isinstance(rk, dict) else None
+        k = rk.get("kind") if isinstance(rk, dict) else None
+        probes = [("*", "*")]
+        if isinstance(k, str):
+            probes.append(("*", k))
+        if isinstance(g, str):
+            probes.append((g, "*"))
+            if isinstance(k, str):
+                probes.append((g, k))
+        out: Dict[Tuple[str, str], Tuple[str, str, dict]] = {}
+        for p in probes:
+            for entry in by_pair.get(p, ()):
+                out[entry[:2]] = entry
+        for entry in nssel:
+            out[entry[:2]] = entry
+        return [out[key] for key in sorted(out)]
+
+    def _interp_review_memo(self, review: dict, memo_key=None):
         """InterpDriver.review semantics served through the content-keyed
         render memos: the hybrid small-batch path and the async-compile
         fallback — i.e. ordinary single admission requests — skip
@@ -737,46 +862,50 @@ class TpuDriver(InterpDriver):
             }
             inventory = self.store.frozen()
             cached_ns = self.store.cached_namespace
-            frozen_review = freeze(review)
-            memo_review = _strip_request_meta(frozen_review)
+            if memo_key is not None:
+                frozen_review, memo_review = memo_key
+            else:
+                frozen_review = freeze(review)
+                memo_review = _strip_request_meta(frozen_review)
             # synced under THIS lock hold: the store below must never run
             # on a memoable verdict from a pre-epoch-bump constraint side
             memoable = self._memoable_synced()
             results: List[Result] = []
-            for kind in sorted(self.constraints):
-                for name in sorted(self.constraints[kind]):
-                    constraint = self.constraints[kind][name]
-                    if needs_autoreject(constraint, review, cached_ns):
-                        results.append(
-                            Result(
-                                msg="Namespace is not cached in OPA.",
-                                metadata={"details": {}},
-                                constraint=constraint,
-                                review=review,
-                                enforcement_action=self._enforcement_action(
-                                    constraint
-                                ),
-                            )
+            for kind, name, constraint in self._gvk_walk_list(review):
+                if needs_autoreject(constraint, review, cached_ns):
+                    results.append(
+                        Result(
+                            msg="Namespace is not cached in OPA.",
+                            metadata={"details": {}},
+                            constraint=constraint,
+                            review=review,
+                            enforcement_action=self._enforcement_action(
+                                constraint
+                            ),
                         )
-                    # _render_cell re-checks the match and returns nothing
-                    # for non-matching constraints or missing templates —
-                    # identical semantics to the oracle's walk
-                    self._render_cell(
-                        results, constraint, kind, review, frozen_review,
-                        inventory, None, memo_review=memo_review,
                     )
+                # _render_cell re-checks the match and returns nothing
+                # for non-matching constraints or missing templates —
+                # identical semantics to the oracle's walk
+                self._render_cell(
+                    results, constraint, kind, review, frozen_review,
+                    inventory, None, memo_review=memo_review,
+                )
             if memoable:
-                self._store_request_memo(review, results)
+                self._store_request_memo(review, results, memo_review)
             self.last_review_stats["eval_ms"] = (
                 _time.perf_counter() - t_locked) * 1e3
             return results, None
 
-    def _request_memo_hit(self, review: dict) -> Optional[List[Result]]:
+    def _request_memo_hit(self, review: dict):
         """Serve a review wholly from the request memo — repairing a
-        stale entry through the constraint-side change log — or None on
-        miss/unmemoable.  review_batch consults this BEFORE routing, so
-        repeat-content admissions (replica/retry storms) stay at memo
-        speed regardless of which path unique content would take."""
+        stale entry through the constraint-side change log — or (None,
+        memo key) on miss, (None, None) when unmemoable.  review_batch
+        consults this BEFORE routing, so repeat-content admissions
+        (replica/retry storms) stay at memo speed regardless of which
+        path unique content would take; the (frozen review, stripped memo
+        key) pair travels to the miss path so the review is frozen
+        exactly once whichever path serves it."""
         import time as _time
 
         from ..engine.value import freeze
@@ -785,19 +914,20 @@ class TpuDriver(InterpDriver):
         with self._lock:
             t_locked = _time.perf_counter()
             if not self._memoable_synced():
-                return None
+                return None, None
             frozen_review = freeze(review)
             memo_review = _strip_request_meta(frozen_review)
+            memo_key = (frozen_review, memo_review)
             hit = self._request_memo.get(memo_review)
             if hit is None:
-                return None
+                return None, memo_key
             if hit[0] != self._cs_epoch:
                 per_key = self._repair_memo_entry(
                     hit[0], hit[1], review, frozen_review, memo_review,
                     self.store.frozen(), self.store.cached_namespace,
                 )
                 if per_key is None:
-                    return None  # change log overran: full re-eval
+                    return None, memo_key  # log overran: full re-eval
                 # flatten ONCE per repair (O(C)); every replay at this
                 # epoch is then O(violations)
                 flat = [
@@ -825,7 +955,7 @@ class TpuDriver(InterpDriver):
                 "lock_wait_ms": (t_locked - t_enter) * 1e3,
                 "eval_ms": (_time.perf_counter() - t_locked) * 1e3,
             }
-            return out
+            return out, memo_key
 
     def _eval_one_key(self, kind, name, review, frozen_review, memo_review,
                       inventory, cached_ns):
@@ -909,12 +1039,13 @@ class TpuDriver(InterpDriver):
     DEVICE_MIN_CELLS = int(os.environ.get("GK_DEVICE_MIN_CELLS", "4096"))
 
     def calibrate_routing(self, runs: int = 3) -> Optional[dict]:
-        """Measure once: an affine device-cost model (dispatch floor +
-        per-cell rate, fitted from the REAL compute_masks path at two
-        batch sizes with unique content — a synthetic ping would be served
-        from a relay's content cache and lie) and a per-cell interpreter
-        rate; review_batch then routes each request by predicted cost
-        instead of the static DEVICE_MIN_CELLS prior.  Explicit call
+        """Measure once: affine cost models for all THREE evaluation paths
+        — device (dispatch floor + per-cell rate, fitted from the REAL
+        compute_masks path at a 1-review probe — the admission shape —
+        and a large batch; a synthetic ping would be served from a relay's
+        content cache and lie), host numpy serving (floor + per-cell), and
+        the per-cell interpreter rate.  review_batch then routes each
+        request by predicted cost instead of static priors.  Explicit call
         (main.py startup / bench): never triggered implicitly, so test
         paths stay deterministic.  Returns the calibration dict, or None
         when no constraints are installed."""
@@ -954,15 +1085,36 @@ class TpuDriver(InterpDriver):
                     ts.append(_time.perf_counter() - t0)
             return float(np.median(ts[1:])) * 1e3
 
-        b_small, b_large = 8, 128
-        ms_small = device_ms(b_small)
-        ms_large = device_ms(b_large)
-        cells_small = b_small * n_constraints
-        cells_large = b_large * n_constraints
-        per_cell = max(
-            (ms_large - ms_small) / max(cells_large - cells_small, 1), 1e-9
+        def affine(ms_small, ms_large, cells_small, cells_large):
+            per_cell = max(
+                (ms_large - ms_small) / max(cells_large - cells_small, 1),
+                1e-9,
+            )
+            floor = max(ms_small - per_cell * cells_small, 1e-3)
+            return floor, per_cell
+
+        # device: 1-review probe (the admission shape the r4 routing model
+        # extrapolated to, badly) + a large batch for the slope
+        b_large = 128
+        dev_floor, dev_per_cell = affine(
+            device_ms(1), device_ms(b_large),
+            n_constraints, b_large * n_constraints,
         )
-        floor_ms = max(ms_small - per_cell * cells_small, 1e-3)
+
+        np_floor = np_per_cell = None
+        if self.np_serve_enabled:
+            def np_ms(batch):
+                ts = []
+                for _ in range(runs + 1):
+                    reviews = [cal_review() for _ in range(batch)]
+                    t0 = _time.perf_counter()
+                    self._np_review(reviews)
+                    ts.append(_time.perf_counter() - t0)
+                return float(np.median(ts[1:])) * 1e3
+
+            np_floor, np_per_cell = affine(
+                np_ms(1), np_ms(8), n_constraints, 8 * n_constraints,
+            )
 
         interp_ts = []
         for _ in range(runs):
@@ -974,26 +1126,44 @@ class TpuDriver(InterpDriver):
         interp_cells_per_ms = n_constraints / max(interp_ms, 1e-3)
 
         cal = {
-            "rtt_ms": floor_ms,  # affine intercept: dispatch+fetch floor
-            "device_cells_per_ms": 1.0 / per_cell,
+            "rtt_ms": dev_floor,  # affine intercept: dispatch+fetch floor
+            "device_cells_per_ms": 1.0 / dev_per_cell,
             "interp_cells_per_ms": interp_cells_per_ms,
         }
+        if np_floor is not None:
+            cal["np_floor_ms"] = np_floor
+            cal["np_cells_per_ms"] = 1.0 / np_per_cell
         self._route_cal = cal
         return cal
 
-    def _route_to_interp(self, cells: int) -> bool:
-        """True when the interpreter is predicted cheaper for this
-        request shape (uncalibrated: the static DEVICE_MIN_CELLS prior;
-        DEVICE_MIN_CELLS = 0 always forces the device, calibrated or
-        not — tests rely on it)."""
+    # uncalibrated prior for np-vs-interp: the numpy serve has a ~1-2ms
+    # floor (pack + mats + mask), the interpreter walks ~10-20 cells/ms —
+    # below this many cells the walk wins
+    NP_MIN_CELLS = int(os.environ.get("GK_NP_MIN_CELLS", "24"))
+
+    def _route_eval(self, cells: int) -> str:
+        """Predicted-cheapest path for a request of `cells` =
+        reviews x constraints: "device" | "np" | "interp".
+        DEVICE_MIN_CELLS = 0 always forces the device (tests rely on it);
+        uncalibrated, the static DEVICE_MIN_CELLS / NP_MIN_CELLS priors
+        decide."""
         if self.DEVICE_MIN_CELLS == 0:
-            return False
+            return "device"
         cal = self._route_cal
+        np_on = self.np_serve_enabled
         if cal is None:
-            return cells < self.DEVICE_MIN_CELLS
+            if cells >= self.DEVICE_MIN_CELLS:
+                return "device"
+            return "np" if np_on and cells >= self.NP_MIN_CELLS else "interp"
         device_ms = cal["rtt_ms"] + cells / cal["device_cells_per_ms"]
         interp_ms = cells / cal["interp_cells_per_ms"]
-        return interp_ms <= device_ms
+        costs = [(interp_ms, "interp"), (device_ms, "device")]
+        if np_on and "np_floor_ms" in cal:
+            costs.append(
+                (cal["np_floor_ms"] + cells / cal["np_cells_per_ms"], "np")
+            )
+        return min(costs)[1]
+
 
     # batches up to this size are admission traffic: they probe and feed
     # the whole-request memo; larger (streaming) chunks skip both so the
@@ -1014,28 +1184,33 @@ class TpuDriver(InterpDriver):
             return self._review_batch_eval(reviews, tracing)
         # repeat-content fast path BEFORE routing: a memoized request must
         # never pay a device dispatch (or an interp walk); misses are
-        # evaluated as one sub-batch while the hits replay as-is
-        served: List = [self._request_memo_hit(r) for r in reviews]
+        # evaluated as one sub-batch while the hits replay as-is.  The
+        # frozen memo keys computed by the probe ride along so the miss
+        # path never re-freezes the same review (freeze is ~0.5ms on a
+        # real Pod — pure waste twice per unique admission).
+        probed = [self._request_memo_hit(r) for r in reviews]
+        served: List = [p[0] for p in probed]
         misses = [i for i, s in enumerate(served) if s is None]
         if misses:
             evaled = self._review_batch_eval(
-                [reviews[i] for i in misses], tracing
+                [reviews[i] for i in misses], tracing,
+                memo_reviews=[probed[i][1] for i in misses],
             )
             for j, i in enumerate(misses):
                 served[i] = evaled[j]
         return [s if isinstance(s, tuple) else (s, None) for s in served]
 
-    def _review_batch_eval(self, reviews: List[dict], tracing: bool):
+    def _review_batch_eval(self, reviews: List[dict], tracing: bool,
+                           memo_reviews: Optional[list] = None):
         """Route and evaluate (no memo probe: review_batch already served
         the hits)."""
-        from ..engine.value import freeze
-
         with self._lock:  # concurrent ingest may resize the dicts (RLock)
             n_constraints = sum(len(v) for v in self.constraints.values())
-        if self._route_to_interp(len(reviews) * max(n_constraints, 1)) or (
+        route = self._route_eval(len(reviews) * max(n_constraints, 1))
+        if route != "device" or (
             # async ingestion: while the background XLA compile for the
             # latest template/constraint epoch is in flight, admission
-            # reviews serve from the interpreter instead of blocking
+            # reviews serve from the host paths instead of blocking
             self._compiler is not None
             and not self._compiler.ready()
         ):
@@ -1044,7 +1219,16 @@ class TpuDriver(InterpDriver):
                     InterpDriver.review(self, r, tracing=True)
                     for r in reviews
                 ]
-            return [self._interp_review_memo(r) for r in reviews]
+            if route != "interp":  # np predicted cheaper, or device busy
+                out = self._np_review(reviews, memo_reviews)
+                if out is not None:
+                    return out
+            return [
+                self._interp_review_memo(
+                    r, memo_reviews[i] if memo_reviews else None
+                )
+                for i, r in enumerate(reviews)
+            ]
         with self._lock:
             ordered, mask, autoreject = self.compute_masks(reviews)
             inventory = self.store.frozen()
@@ -1054,41 +1238,9 @@ class TpuDriver(InterpDriver):
                 return self._review_batch_traced(
                     reviews, ordered, mask_np, rej_np, inventory
                 )
-            # Sparse render: iterate only (review, constraint) cells the
-            # device marked positive, review-major so per-review result
-            # ordering matches the dense loop.  Reviews with no positive
-            # cell (the common admission case) cost zero host work — in
-            # particular no freeze(), which dominated the dense loop at
-            # 1M-review scale.
-            out: List = [([], None) for _ in reviews]
-            ris, iis = np.nonzero((mask_np | rej_np).T)
-            frozen_cache: Dict[int, tuple] = {}
-            for ri, i in zip(ris.tolist(), iis.tolist()):
-                kind, _name, constraint = ordered[i]
-                review = reviews[ri]
-                results = out[ri][0]
-                if rej_np[i, ri] and needs_autoreject(
-                    constraint, review, self.store.cached_namespace
-                ):
-                    results.append(
-                        Result(
-                            msg="Namespace is not cached in OPA.",
-                            metadata={"details": {}},
-                            constraint=constraint,
-                            review=review,
-                            enforcement_action=self._enforcement_action(constraint),
-                        )
-                    )
-                if mask_np[i, ri]:
-                    fr = frozen_cache.get(ri)
-                    if fr is None:
-                        fz = freeze(review)
-                        fr = (fz, _strip_request_meta(fz))
-                        frozen_cache[ri] = fr
-                    self._render_cell(
-                        results, constraint, kind, review, fr[0],
-                        inventory, None, memo_review=fr[1],
-                    )
+            out = self._render_masked(
+                reviews, ordered, mask_np, rej_np, inventory
+            )
             # admission-sized batches feed the request memo from the
             # device path too, so repeat content (replica/retry storms —
             # including repeat ALLOWS, the common case) replays at memo
@@ -1100,7 +1252,89 @@ class TpuDriver(InterpDriver):
                 and self._memoable_synced()
             ):
                 for ri, review in enumerate(reviews):
-                    self._store_request_memo(review, out[ri][0])
+                    mk = memo_reviews[ri] if memo_reviews else None
+                    self._store_request_memo(
+                        review, out[ri][0], mk[1] if mk else None,
+                    )
+            return out
+
+    def _render_masked(self, reviews, ordered, mask_np, rej_np, inventory):
+        """Sparse render shared by the device and host (numpy) mask paths:
+        iterate only (review, constraint) cells the mask marked positive,
+        review-major so per-review result ordering matches the dense loop.
+        Reviews with no positive cell (the common admission case) cost zero
+        host work — in particular no freeze(), which dominated the dense
+        loop at 1M-review scale.  Caller holds the lock."""
+        from ..engine.value import freeze
+
+        out: List = [([], None) for _ in reviews]
+        ris, iis = np.nonzero((mask_np | rej_np).T)
+        frozen_cache: Dict[int, tuple] = {}
+        for ri, i in zip(ris.tolist(), iis.tolist()):
+            kind, _name, constraint = ordered[i]
+            review = reviews[ri]
+            results = out[ri][0]
+            if rej_np[i, ri] and needs_autoreject(
+                constraint, review, self.store.cached_namespace
+            ):
+                results.append(
+                    Result(
+                        msg="Namespace is not cached in OPA.",
+                        metadata={"details": {}},
+                        constraint=constraint,
+                        review=review,
+                        enforcement_action=self._enforcement_action(constraint),
+                    )
+                )
+            if mask_np[i, ri]:
+                fr = frozen_cache.get(ri)
+                if fr is None:
+                    fz = freeze(review)
+                    fr = (fz, _strip_request_meta(fz))
+                    frozen_cache[ri] = fr
+                self._render_cell(
+                    results, constraint, kind, review, fr[0],
+                    inventory, None, memo_review=fr[1],
+                )
+        return out
+
+    def _np_review(self, reviews: List[dict],
+                   memo_reviews: Optional[list] = None):
+        """Serve an admission batch from the incremental host-side numpy
+        constraint side (ops/npside.py): the same over-approximating mask
+        + exact render as the device path, with no dispatch RTT and no
+        compile anywhere — in particular not during template-ingest
+        storms, where the device executable is perpetually behind.
+        Returns None when disabled or empty (caller falls back)."""
+        if not self.np_serve_enabled:
+            return None
+        import time as _time
+
+        t_enter = _time.perf_counter()
+        with self._lock:
+            t_locked = _time.perf_counter()
+            ns = self._np_side
+            ns.sync(self)
+            got = ns.serve(self, reviews)
+            if got is None:
+                return None
+            ordered, mask, rej = got
+            inventory = self.store.frozen()
+            out = self._render_masked(reviews, ordered, mask, rej, inventory)
+            if (
+                len(reviews) <= self.REQUEST_MEMO_BATCH_MAX
+                and self._memoable_synced()
+            ):
+                for ri, review in enumerate(reviews):
+                    mk = memo_reviews[ri] if memo_reviews else None
+                    self._store_request_memo(
+                        review, out[ri][0], mk[1] if mk else None,
+                    )
+            self.last_review_stats = {
+                "lock_wait_ms": (t_locked - t_enter) * 1e3,
+                "eval_ms": (_time.perf_counter() - t_locked) * 1e3,
+                "path": "np",
+            }
             return out
 
     def _memoable_synced(self) -> bool:
@@ -1116,17 +1350,20 @@ class TpuDriver(InterpDriver):
             self._request_memo_epoch = self._cs_epoch
         return self._request_memoable()
 
-    def _store_request_memo(self, review: dict, results: List[Result]):
+    def _store_request_memo(self, review: dict, results: List[Result],
+                            memo_review=None):
         """Store one review's exact results as a request-memo entry
         (caller holds the lock and has verified memoability via
         _memoable_synced).  The flat replay list is sorted by
         (kind, name) so replays order identically whichever evaluation
-        path populated or repaired the entry."""
+        path populated or repaired the entry.  memo_review: the frozen
+        uid-stripped key when a caller already computed it."""
         from ..engine.value import freeze
 
         if len(self._request_memo) >= self.REQUEST_MEMO_MAX:
             self._request_memo.clear()
-        memo_review = _strip_request_meta(freeze(review))
+        if memo_review is None:
+            memo_review = _strip_request_meta(freeze(review))
         per_key: Dict[Tuple[str, str], list] = {}
         for r in results:
             key = (r.constraint.get("kind", ""),

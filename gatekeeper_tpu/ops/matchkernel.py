@@ -24,7 +24,7 @@ from .pack import (
 )
 
 
-def _selector_match(lab_pairs, cs_ml, cs_op, cs_key, cs_vals, cs_nvals):
+def _selector_match(lab_pairs, cs_ml, cs_op, cs_key, cs_vals, cs_nvals, xp=jnp):
     """matches_label_selector over [R, L, 2] labels x [C, ...] selectors
     -> bool[C, R].
 
@@ -37,7 +37,7 @@ def _selector_match(lab_pairs, cs_ml, cs_op, cs_key, cs_vals, cs_nvals):
     L = lab_key.shape[1]
 
     def key_val_hit(k, v):  # k,v: [C, 1] -> any label slot matches both
-        acc = jnp.zeros((k.shape[0], lab_key.shape[0]), bool)
+        acc = xp.zeros((k.shape[0], lab_key.shape[0]), bool)
         for l in range(L):
             acc = acc | (
                 (lab_key[None, :, l] == k) & (lab_val[None, :, l] == v)
@@ -46,7 +46,7 @@ def _selector_match(lab_pairs, cs_ml, cs_op, cs_key, cs_vals, cs_nvals):
         return acc  # [C, R]
 
     def key_hit(k):  # [C, 1] -> any label slot has this key
-        acc = jnp.zeros((k.shape[0], lab_key.shape[0]), bool)
+        acc = xp.zeros((k.shape[0], lab_key.shape[0]), bool)
         for l in range(L):
             acc = acc | ((lab_key[None, :, l] == k) & lab_ok[None, :, l])
         return acc
@@ -55,7 +55,7 @@ def _selector_match(lab_pairs, cs_ml, cs_op, cs_key, cs_vals, cs_nvals):
     R = lab_key.shape[0]
 
     # matchLabels: every (k, v) pair (non-pad) must be satisfied.
-    ml_ok = jnp.ones((C, R), bool)
+    ml_ok = xp.ones((C, R), bool)
     for i in range(cs_ml.shape[1]):
         k = cs_ml[:, i, 0][:, None]
         v = cs_ml[:, i, 1][:, None]
@@ -63,22 +63,22 @@ def _selector_match(lab_pairs, cs_ml, cs_op, cs_key, cs_vals, cs_nvals):
         ml_ok = ml_ok & (sat | (k == PAD))
 
     # matchExpressions
-    ex_ok = jnp.ones((C, R), bool)
+    ex_ok = xp.ones((C, R), bool)
     for e in range(cs_op.shape[1]):
         op = cs_op[:, e][:, None]  # [C, 1]
         key = cs_key[:, e][:, None]
         has = key_hit(key)  # [C, R]
-        val_in = jnp.zeros((C, R), bool)
+        val_in = xp.zeros((C, R), bool)
         for v in range(cs_vals.shape[2]):
             val_in = val_in | key_val_hit(key, cs_vals[:, e, v][:, None])
         nvals = cs_nvals[:, e][:, None]
-        violated = jnp.where(
+        violated = xp.where(
             op == 0, ~has | ((nvals > 0) & ~val_in),  # In
-            jnp.where(
+            xp.where(
                 op == 1, has & (nvals > 0) & val_in,  # NotIn
-                jnp.where(
+                xp.where(
                     op == 2, ~has,  # Exists
-                    jnp.where(op == 3, has, False),  # DoesNotExist / unknown
+                    xp.where(op == 3, has, False),  # DoesNotExist / unknown
                 ),
             ),
         )
@@ -86,26 +86,37 @@ def _selector_match(lab_pairs, cs_ml, cs_op, cs_key, cs_vals, cs_nvals):
     return ml_ok & ex_ok
 
 
-def _any_labelselector_match(rv, cs_ml, cs_op, cs_key, cs_vals, cs_nvals):
+def _any_labelselector_match(rv, cs_ml, cs_op, cs_key, cs_vals, cs_nvals, xp=jnp):
     """any_labelselector_match (target_template_source.go:233-278)
     -> bool[C, R]."""
-    sm_obj = _selector_match(rv["obj_labels"], cs_ml, cs_op, cs_key, cs_vals, cs_nvals)
-    sm_old = _selector_match(rv["old_labels"], cs_ml, cs_op, cs_key, cs_vals, cs_nvals)
-    empty = jnp.full_like(rv["obj_labels"][:1], PAD)
-    sm_empty = _selector_match(empty, cs_ml, cs_op, cs_key, cs_vals, cs_nvals)  # [C, 1]
+    sm_obj = _selector_match(rv["obj_labels"], cs_ml, cs_op, cs_key, cs_vals, cs_nvals, xp)
+    sm_old = _selector_match(rv["old_labels"], cs_ml, cs_op, cs_key, cs_vals, cs_nvals, xp)
+    empty = xp.full_like(rv["obj_labels"][:1], PAD)
+    sm_empty = _selector_match(empty, cs_ml, cs_op, cs_key, cs_vals, cs_nvals, xp)  # [C, 1]
     obj_e = rv["obj_empty"][None, :]
     old_e = rv["old_empty"][None, :]
-    return jnp.where(
+    return xp.where(
         obj_e & old_e, sm_empty,
-        jnp.where(
+        xp.where(
             old_e, sm_obj,
-            jnp.where(obj_e, sm_old, sm_obj | sm_old),
+            xp.where(obj_e, sm_old, sm_obj | sm_old),
         ),
     )
 
 
-def match_kernel(rv: dict, cs: dict):
+def _no_selectors(ml, op) -> bool:
+    """True when every row's selector is empty (all-PAD matchLabels, no
+    matchExpressions) — the common cluster shape.  Host-mode fast path
+    only: under jit the reduction would trace, and the compiled kernel
+    doesn't pay the Python unroll anyway."""
+    return bool((ml[:, :, 0] == PAD).all() and (op == -1).all())
+
+
+def match_kernel(rv: dict, cs: dict, xp=jnp):
     """-> (match bool[C, R], autoreject bool[C, R])."""
+    import numpy as _np
+
+    host = xp is _np
     group = rv["group"][None, :]  # [1, R]
     kind = rv["kind"][None, :]
 
@@ -113,7 +124,7 @@ def match_kernel(rv: dict, cs: dict):
     R = group.shape[1]
 
     # kind selectors: any (group, kind) pair matches (KP unrolled)
-    kinds_ok = jnp.zeros((C, R), bool)
+    kinds_ok = xp.zeros((C, R), bool)
     for p in range(cs["kind_pairs"].shape[1]):
         kp_g = cs["kind_pairs"][:, p, 0][:, None]  # [C, 1]
         kp_k = cs["kind_pairs"][:, p, 1][:, None]
@@ -129,7 +140,7 @@ def match_kernel(rv: dict, cs: dict):
     always = rv["always"][None, :]
 
     def member(ids):
-        acc = jnp.zeros((C, R), bool)
+        acc = xp.zeros((C, R), bool)
         for i in range(ids.shape[1]):
             col = ids[:, i][:, None]
             acc = acc | ((col == ns_name) & (col != PAD))
@@ -141,33 +152,42 @@ def match_kernel(rv: dict, cs: dict):
     # scope
     scope = cs["scope"][:, None]  # [C, 1]
     ns_empty = rv["ns_empty"][None, :]
-    scope_ok = jnp.where(
+    scope_ok = xp.where(
         (scope == SCOPE_NONE) | (scope == 1), True,
-        jnp.where(
+        xp.where(
             scope == 2, ~ns_empty,
-            jnp.where(scope == 3, ns_empty, False),  # SCOPE_OTHER -> False
+            xp.where(scope == 3, ns_empty, False),  # SCOPE_OTHER -> False
         ),
     )
 
-    # labelSelector
-    ls_ok = _any_labelselector_match(
-        rv, cs["ls_ml"], cs["ls_op"], cs["ls_key"], cs["ls_vals"], cs["ls_nvals"]
-    )
+    # labelSelector (host fast path: an empty selector matches everything,
+    # and clusters overwhelmingly install constraints without selectors)
+    if host and _no_selectors(cs["ls_ml"], cs["ls_op"]):
+        ls_ok = xp.ones((C, R), bool)
+    else:
+        ls_ok = _any_labelselector_match(
+            rv, cs["ls_ml"], cs["ls_op"], cs["ls_key"], cs["ls_vals"],
+            cs["ls_nvals"], xp,
+        )
 
     # namespaceSelector by mode: 0 always-T, 1 ns labels, 2 uncached-F, 3 is_ns
-    sm_ns = _selector_match(
-        rv["ns_labels"], cs["nssel_ml"], cs["ns_op"], cs["ns_key"],
-        cs["ns_vals"], cs["ns_nvals"],
-    )
-    alm_ns = _any_labelselector_match(
-        rv, cs["nssel_ml"], cs["ns_op"], cs["ns_key"], cs["ns_vals"], cs["ns_nvals"]
-    )
-    mode = rv["ns_mode"][None, :]
-    nssel_result = jnp.where(
-        mode == 0, True,
-        jnp.where(mode == 1, sm_ns, jnp.where(mode == 3, alm_ns, False)),
-    )
-    nssel_ok = ~cs["has_nssel"][:, None] | nssel_result
+    if host and not cs["has_nssel"].any():
+        nssel_ok = xp.ones((C, R), bool)
+    else:
+        sm_ns = _selector_match(
+            rv["ns_labels"], cs["nssel_ml"], cs["ns_op"], cs["ns_key"],
+            cs["ns_vals"], cs["ns_nvals"], xp,
+        )
+        alm_ns = _any_labelselector_match(
+            rv, cs["nssel_ml"], cs["ns_op"], cs["ns_key"], cs["ns_vals"],
+            cs["ns_nvals"], xp,
+        )
+        mode = rv["ns_mode"][None, :]
+        nssel_result = xp.where(
+            mode == 0, True,
+            xp.where(mode == 1, sm_ns, xp.where(mode == 3, alm_ns, False)),
+        )
+        nssel_ok = ~cs["has_nssel"][:, None] | nssel_result
 
     valid = cs["valid"][:, None] & rv["valid"][None, :]
     match = kinds_ok & ns_ok & ex_ok & scope_ok & ls_ok & nssel_ok & valid

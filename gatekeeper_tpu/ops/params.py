@@ -3,7 +3,7 @@
 from __future__ import annotations
 
 import re
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -67,9 +67,13 @@ def pack_params(
     interner: Interner,
     pred_cache: Dict[Tuple[str, str], PredicateTable],
     rows: int,
+    meta_out: Optional[dict] = None,
 ):
     """-> (params, elems, tables) for EvalEnv.  `rows` >= len(constraints)
-    (padded rows read as undefined)."""
+    (padded rows read as undefined).  When `meta_out` is given, it receives
+    {"stacks": {pred_id: {(pred, value): table row}}} — the incremental
+    host side (ops/npside.py) needs the row identities to merge a single
+    constraint's tables into its growing group buffers."""
     pad = [(None, False)] * (rows - len(constraints))
 
     params: Dict[Tuple, Dict[str, np.ndarray]] = {}
@@ -157,5 +161,7 @@ def pack_params(
         for (pred, value), row in stack.items():
             mat[row, :vocab] = pred_cache[(pred, value)].dense()[:vocab]
         tables[node.pred_id] = (mat, idx)
+        if meta_out is not None:
+            meta_out.setdefault("stacks", {})[node.pred_id] = dict(stack)
 
     return params, elems, tables

@@ -196,7 +196,7 @@ class EvalEnv:
     keysets: colkey -> ids [R, K]
     """
 
-    def __init__(self, cols, params, elems, tables, keysets, C, R):
+    def __init__(self, cols, params, elems, tables, keysets, C, R, xp=jnp):
         self.cols = cols
         self.params = params
         self.elems = elems
@@ -204,15 +204,20 @@ class EvalEnv:
         self.keysets = keysets
         self.C = C
         self.R = R
+        # Array namespace: jnp under jit (the device path), numpy for the
+        # host-serving path (ops/npside.py) — same IR, same semantics, no
+        # trace/compile.  Everything below goes through env.xp.
+        self.xp = xp
 
 
 def _operand_arrays(op: Operand, env: EvalEnv, axes: str, pidx=None):
     """Return dict with tcode/sid/num arrays broadcast to `axes` layout
     ('CR' or 'CRS').  Inside an AnyParam unroll, `pidx` selects the current
     parameter element (ParamElemRef arrays are [C, P])."""
+    xp = env.xp
 
     def shape_col(a, slot):
-        x = jnp.asarray(a)  # [R] or [R, S]
+        x = xp.asarray(a)  # [R] or [R, S]
         if slot and not axes.endswith("S"):
             raise ValueError("slot column outside slot context")
         x = x[None]
@@ -227,7 +232,7 @@ def _operand_arrays(op: Operand, env: EvalEnv, axes: str, pidx=None):
         d = env.params[op.ppath]
         out = {}
         for k, v in d.items():
-            x = jnp.asarray(v)[..., None]  # [C, 1]
+            x = xp.asarray(v)[..., None]  # [C, 1]
             if axes.endswith("S"):
                 x = x[..., None]
             out[k] = x
@@ -240,7 +245,7 @@ def _operand_arrays(op: Operand, env: EvalEnv, axes: str, pidx=None):
         for k, v in d.items():
             if k == "mask":
                 continue
-            x = jnp.asarray(v)[:, pidx][:, None]  # [C, 1]
+            x = xp.asarray(v)[:, pidx][:, None]  # [C, 1]
             if axes.endswith("S"):
                 x = x[..., None]
             out[k] = x
@@ -252,29 +257,30 @@ def _operand_arrays(op: Operand, env: EvalEnv, axes: str, pidx=None):
             # under the pseudo-path ("__lit__", v); [1]-shaped scalars
             d = env.params[("__lit__", v)]
             return {
-                "tcode": jnp.asarray(d["tcode"])[0],
-                "sid": jnp.asarray(d["sid"])[0],
-                "num": jnp.asarray(0.0),
+                "tcode": xp.asarray(d["tcode"])[0],
+                "sid": xp.asarray(d["sid"])[0],
+                "num": xp.asarray(0.0),
             }
         if isinstance(v, bool):
             return {
-                "tcode": jnp.asarray(T_TRUE if v else T_FALSE, jnp.int8),
-                "sid": jnp.asarray(-1, jnp.int32),
-                "num": jnp.asarray(0.0),
+                "tcode": xp.asarray(T_TRUE if v else T_FALSE, xp.int8),
+                "sid": xp.asarray(-1, xp.int32),
+                "num": xp.asarray(0.0),
             }
         if isinstance(v, (int, float)):
             return {
-                "tcode": jnp.asarray(T_NUM, jnp.int8),
-                "sid": jnp.asarray(-1, jnp.int32),
-                "num": jnp.asarray(float(v)),
+                "tcode": xp.asarray(T_NUM, xp.int8),
+                "sid": xp.asarray(-1, xp.int32),
+                "num": xp.asarray(float(v)),
             }
         raise ValueError(f"unsupported literal {v!r}")
     raise TypeError(op)
 
 
 def _eval_node(node: VNode, env: EvalEnv, axes: str, pidx=None):
+    xp = env.xp
     if isinstance(node, Const):
-        return jnp.asarray(node.value)
+        return xp.asarray(node.value)
     if isinstance(node, Truthy):
         d = _operand_arrays(node.operand, env, axes, pidx)
         truthy = (d["tcode"] != T_UNDEF) & (d["tcode"] != T_FALSE)
@@ -282,12 +288,12 @@ def _eval_node(node: VNode, env: EvalEnv, axes: str, pidx=None):
     if isinstance(node, Cmp):
         a = _operand_arrays(node.lhs, env, axes, pidx)
         b = _operand_arrays(node.rhs, env, axes, pidx)
-        return _cmp_values(a, b, node.op, node.unknown_default)
+        return _cmp_values(a, b, node.op, node.unknown_default, env.xp)
     if isinstance(node, StrPred):
         return _eval_strpred(node, env, axes, pidx)
     if isinstance(node, AnyParam):
         # unroll the parameter axis: peak transient stays at [C, R(, S)]
-        mask = jnp.asarray(env.elems[(node.ppath, ())]["mask"])  # [C, P]
+        mask = xp.asarray(env.elems[(node.ppath, ())]["mask"])  # [C, P]
         P = mask.shape[1]
         acc = None
         for p in range(P):
@@ -298,7 +304,7 @@ def _eval_node(node: VNode, env: EvalEnv, axes: str, pidx=None):
             for n in node.inner:
                 part = part & _eval_node(n, env, axes, pidx=p)
             acc = part if acc is None else (acc | part)
-        return acc if acc is not None else jnp.asarray(False)
+        return acc if acc is not None else xp.asarray(False)
     if isinstance(node, SetCountCmp):
         return _eval_setcount(node, env, axes)
     if isinstance(node, BoolOp):
@@ -316,23 +322,23 @@ def _eval_node(node: VNode, env: EvalEnv, axes: str, pidx=None):
         acc = mask[None]
         for n in node.inner:
             acc = acc & _eval_node(n, env, axes + "S", pidx)
-        return jnp.any(acc, axis=-1)
+        return xp.any(acc, axis=-1)
     if isinstance(node, AnySlots):
         raise ValueError("AnySlots must be handled at clause level")
     raise TypeError(node)
 
 
-def _cmp_values(a, b, op: str, unknown_default: bool):
-    ra = _RANK_LOOKUP(a["tcode"])
-    rb = _RANK_LOOKUP(b["tcode"])
+def _cmp_values(a, b, op: str, unknown_default: bool, xp=jnp):
+    ra = _RANK_LOOKUP(a["tcode"], xp)
+    rb = _RANK_LOOKUP(b["tcode"], xp)
     defined = (a["tcode"] != T_UNDEF) & (b["tcode"] != T_UNDEF)
     both_comp = (a["tcode"] == T_COMP) & (b["tcode"] == T_COMP)
 
     same_rank = ra == rb
     # per-rank equality (composite unknown)
-    eq_val = jnp.where(
+    eq_val = xp.where(
         a["tcode"] == T_NUM, a["num"] == b["num"],
-        jnp.where(
+        xp.where(
             a["tcode"] == T_STR, a["sid"] == b["sid"],
             a["tcode"] == b["tcode"],  # null/bools: tcode equality decides
         ),
@@ -341,17 +347,17 @@ def _cmp_values(a, b, op: str, unknown_default: bool):
 
     if op in ("==", "!="):
         res = eq if op == "==" else defined & ~eq
-        return jnp.where(both_comp, unknown_default, defined & res)
+        return xp.where(both_comp, unknown_default, defined & res)
 
     # ordering: rank decides across types; within rank use value
-    lt_val = jnp.where(
+    lt_val = xp.where(
         a["tcode"] == T_NUM, a["num"] < b["num"],
-        jnp.where(
-            a["tcode"] == T_STR, jnp.asarray(False),  # string<string: unknown
+        xp.where(
+            a["tcode"] == T_STR, xp.asarray(False),  # string<string: unknown
             (a["tcode"] == T_FALSE) & (b["tcode"] == T_TRUE),
         ),
     )
-    lt = jnp.where(same_rank, lt_val, ra < rb)
+    lt = xp.where(same_rank, lt_val, ra < rb)
     unknown = both_comp | (same_rank & (a["tcode"] == T_STR))
     if op == "<":
         res = lt
@@ -361,27 +367,36 @@ def _cmp_values(a, b, op: str, unknown_default: bool):
         res = lt | eq
     else:  # >=
         res = ~lt
-    return jnp.where(unknown, unknown_default, defined & res)
+    return xp.where(unknown, unknown_default, defined & res)
 
 
-def _RANK_LOOKUP(tcode):
-    return jnp.asarray(_RANK)[jnp.clip(tcode, 0, 6)]
+def _RANK_LOOKUP(tcode, xp=jnp):
+    return xp.asarray(_RANK)[xp.clip(tcode, 0, 6)]
 
 
 def _eval_strpred(node: StrPred, env: EvalEnv, axes: str, pidx=None):
+    xp = env.xp
     table, idx = env.tables[node.pred_id]  # [U, vocab], [C] or [C, P]
     d = _operand_arrays(node.operand, env, axes, pidx)
     sid = d["sid"]
     is_str = d["tcode"] == T_STR
-    idx = jnp.asarray(idx)
+    idx = xp.asarray(idx)
     if idx.ndim == 2:  # per param element
         if pidx is None:
             raise ValueError("per-element StrPred outside AnyParam")
         idx = idx[:, pidx]
-    table = jnp.asarray(table)
+    table = xp.asarray(table)
     U = table.shape[0]
-    sidc = jnp.clip(sid, 0, table.shape[1] - 1)
-    if sid.shape[0] == 1:
+    sidc = xp.clip(sid, 0, table.shape[1] - 1)
+    if xp is np:
+        # Host (numpy) mode: the batch is admission-sized, so the naive
+        # broadcast gather is the fast form — no MXU to feed, and the
+        # einsum would pay a [C, U] one-hot materialization for nothing.
+        idx_b = idx[:, None]
+        if axes.endswith("S"):
+            idx_b = idx_b[..., None]
+        hit = table[idx_b, sidc] != 0
+    elif sid.shape[0] == 1:
         # Review-side operand ([1, R(,S)] — the hot case): two-stage
         # lookup shaped for the TPU.  Gather CONTIGUOUS U-byte rows of
         # the transposed table per string id (a sublane gather), then
@@ -389,17 +404,17 @@ def _eval_strpred(node: StrPred, env: EvalEnv, axes: str, pidx=None):
         # the MXU.  The naive per-element form table[idx[c], sid[r]] is
         # B x R x S random byte reads — measured ~3s for one 128x131k
         # group, the whole full-resweep budget.
-        rowhit = jnp.swapaxes(table, 0, 1)[sidc[0]].astype(jnp.int8)
-        onehot = (idx[:, None] == jnp.arange(U)[None, :]).astype(jnp.int8)
+        rowhit = xp.swapaxes(table, 0, 1)[sidc[0]].astype(xp.int8)
+        onehot = (idx[:, None] == xp.arange(U)[None, :]).astype(xp.int8)
         if rowhit.ndim == 3:  # [R, S, U]
-            hit = jnp.einsum(
+            hit = xp.einsum(
                 "cu,rsu->crs", onehot, rowhit,
-                preferred_element_type=jnp.int32,
+                preferred_element_type=xp.int32,
             ) > 0
         else:  # [R, U]
-            hit = jnp.einsum(
+            hit = xp.einsum(
                 "cu,ru->cr", onehot, rowhit,
-                preferred_element_type=jnp.int32,
+                preferred_element_type=xp.int32,
             ) > 0
     else:
         # constraint-side operand (tiny [C, 1(,1)]): plain gather
@@ -412,16 +427,17 @@ def _eval_strpred(node: StrPred, env: EvalEnv, axes: str, pidx=None):
 
 
 def _eval_setcount(node: SetCountCmp, env: EvalEnv, axes: str):
+    xp = env.xp
     from .interning import Interner
 
     def side(ref):
         kind, key = ref
         if kind == "keyset":
-            ids = jnp.asarray(env.keysets[key])  # [R, K]
+            ids = xp.asarray(env.keysets[key])  # [R, K]
             return ids, ids != Interner.PAD, "R"
         # key is (ppath, subpath)
-        ids = jnp.asarray(env.elems[key]["sid"])  # [C, P]
-        mask = jnp.asarray(env.elems[key]["mask"])
+        ids = xp.asarray(env.elems[key]["sid"])  # [C, P]
+        mask = xp.asarray(env.elems[key]["mask"])
         return ids, mask, "C"
 
     lids, lmask, lax = side(node.left)
@@ -433,20 +449,20 @@ def _eval_setcount(node: SetCountCmp, env: EvalEnv, axes: str):
     if lax == "C" and rax == "R":
         C, P = lids.shape
         R, K = rids.shape
-        cnt = jnp.zeros((C, R), jnp.int32)
+        cnt = xp.zeros((C, R), xp.int32)
         for p in range(P):
             lid = lids[:, p][:, None]  # [C, 1]
-            inr = jnp.zeros((C, R), bool)
+            inr = xp.zeros((C, R), bool)
             for k in range(K):
                 inr = inr | ((lid == rids[None, :, k]) & rmask[None, :, k])
             cnt = cnt + (lmask[:, p][:, None] & ~inr)
     elif lax == "R" and rax == "C":
         R, K = lids.shape
         C, P = rids.shape
-        cnt = jnp.zeros((C, R), jnp.int32)
+        cnt = xp.zeros((C, R), xp.int32)
         for k in range(K):
             lid = lids[None, :, k]  # [1, R]
-            inr = jnp.zeros((C, R), bool)
+            inr = xp.zeros((C, R), bool)
             for p in range(P):
                 inr = inr | ((lid == rids[:, p][:, None]) & rmask[:, p][:, None])
             cnt = cnt + (lmask[None, :, k] & ~inr)
@@ -461,15 +477,17 @@ def _eval_setcount(node: SetCountCmp, env: EvalEnv, axes: str):
 
 
 def _slot_mask(env: EvalEnv, iter_key: Tuple):
+    xp = env.xp
     for spec_key, arrs in env.cols.items():
         if "mask" in arrs and spec_key[1] == iter_key:
-            return jnp.asarray(arrs["mask"])
+            return xp.asarray(arrs["mask"])
     raise ValueError("no slot column for iteration group")
 
 
 def eval_program(prog: VProgram, env: EvalEnv):
     """-> bool[C, R]: OR over clauses."""
-    total = jnp.zeros((env.C, env.R), bool)
+    xp = env.xp
+    total = xp.zeros((env.C, env.R), bool)
     for clause in prog.clauses:
         r_conds: List = []
         s_conds: List = []
@@ -478,7 +496,7 @@ def eval_program(prog: VProgram, env: EvalEnv):
                 s_conds.append(cond)
             else:
                 r_conds.append(cond)
-        acc = jnp.ones((env.C, env.R), bool)
+        acc = xp.ones((env.C, env.R), bool)
         for cond in r_conds:
             acc = acc & _eval_node(cond, env, "CR")
         if clause.slot_iter is not None:
@@ -486,7 +504,7 @@ def eval_program(prog: VProgram, env: EvalEnv):
             sacc = mask[None, :, :]  # [1, R, S]
             for cond in s_conds:
                 sacc = sacc & _eval_node(cond, env, "CRS")
-            acc = acc & jnp.any(sacc, axis=2)
+            acc = acc & xp.any(sacc, axis=2)
         elif s_conds:
             raise ValueError("slot conditions without slot_iter")
         total = total | acc

