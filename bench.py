@@ -488,6 +488,88 @@ def bench_ingest() -> dict:
     }
 
 
+def bench_restart() -> dict:
+    """Warm-restart recovery (SURVEY §5.4; the reference rebuilds all
+    derived state on boot in seconds, pkg/controller/controller.go:124-126).
+
+    Two fresh subprocesses over the synthetic corpus, sharing the
+    persistent caches: the first populates the XLA-compile AND
+    serialized-executable (AOT) caches; the second is the measured warm
+    restart — process start to first full capped sweep.  The AOT cache is
+    what removes the fused programs' TRACE time, which the XLA compile
+    cache alone cannot save."""
+    import subprocess
+
+    n_t = int(os.environ.get("BENCH_RESTART_TEMPLATES",
+                             os.environ.get("BENCH_TEMPLATES", "500")))
+    n_r = int(os.environ.get("BENCH_RESTART_RESOURCES",
+                             os.environ.get("BENCH_RESOURCES", "100000")))
+    cache_dir = os.environ.get(
+        "GK_XLA_CACHE",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".xla-cache"),
+    )
+    code = f"N_T, N_R, CACHE = {n_t}, {n_r}, {cache_dir!r}\n" + r"""
+import json, sys, time
+sys.path.insert(0, ".")
+from gatekeeper_tpu.ops import aotcache, xlacache
+xlacache.enable(CACHE)
+aotcache.enable(CACHE + "/aot")
+from gatekeeper_tpu.util.synthetic import make_pods, make_templates
+from gatekeeper_tpu.client.client import Client
+from gatekeeper_tpu.ops.driver import TpuDriver
+# corpus generation is bench-harness cost, not restart cost (a real
+# restart replays existing objects from the API server); the clock
+# starts at the replay
+templates, constraints = make_templates(N_T)
+pods = make_pods(N_R, 1)
+t0 = time.time()
+client = Client(driver=TpuDriver())
+for t in templates:
+    client.add_template(t)
+for c in constraints:
+    client.add_constraint(c)
+t_tmpl = time.time()
+for p in pods:
+    client.add_data(p)
+t_built = time.time()
+res, _totals = client.audit_capped(20)
+t_ready = time.time()
+n = len(res.results())
+print(json.dumps({
+    "template_ingest_s": round(t_tmpl - t0, 3),
+    "data_replay_s": round(t_built - t_tmpl, 3),
+    "first_sweep_s": round(t_ready - t_built, 3),
+    "ready_s": round(t_ready - t0, 3),
+    "violations": n,
+}))
+"""
+    out = {}
+    for label in ("populate", "warm"):
+        t0 = time.time()
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=1200,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        if proc.returncode != 0:
+            log(f"restart[{label}] failed: {proc.stderr[-500:]}")
+            raise RuntimeError("restart bench subprocess failed")
+        line = proc.stdout.strip().splitlines()[-1]
+        out[label] = json.loads(line)
+        log(f"restart[{label}]: {out[label]} (wall {time.time()-t0:.1f}s)")
+    warm = out["warm"]
+    return {
+        "metric": f"warm-restart to first full sweep ({n_t}x{n_r})",
+        "value": warm["ready_s"],
+        "unit": "s",
+        "vs_baseline": 0,
+        "template_ingest_s": warm["template_ingest_s"],
+        "data_replay_s": warm["data_replay_s"],
+        "first_sweep_s": warm["first_sweep_s"],
+        "populate_ready_s": out["populate"]["ready_s"],
+    }
+
+
 def bench_curve() -> dict:
     """The reference's constraint-count scaling sweep
     (policy_benchmark_test.go:269: N in {5,10,50,100,200,1000,2000}):
@@ -760,8 +842,10 @@ def bench_multihost() -> dict:
     import socket
     import subprocess
 
-    n_t = int(os.environ.get("BENCH_MH_TEMPLATES", "10"))
-    n_r = int(os.environ.get("BENCH_MH_ROWS", "2000"))
+    n_t = int(os.environ.get("BENCH_MH_TEMPLATES",
+                             os.environ.get("BENCH_TEMPLATES", "500")))
+    n_r = int(os.environ.get("BENCH_MH_ROWS",
+                             os.environ.get("BENCH_RESOURCES", "100000")))
     worker = f"N_T, N_R = {n_t}, {n_r}\n" + r"""
 import os, sys, json, time
 sys.path.insert(0, ".")
@@ -787,12 +871,18 @@ for _ in range(3):  # every call re-dispatches (no result cache here)
     ordered, counts, topk = multihost_capped_sweep(driver, K=K)
     ts.append(time.perf_counter() - t0)
 
-driver2 = build_driver(N_T, N_R, seed=0).driver
-driver2.mesh_enabled = False
-driver2._mesh_cache = None
-sweep = driver2._audit_sweep(K)
-_r, _o, _m, ref_counts, ref_topk = sweep
-parity = bool((counts == ref_counts).all() and (topk == ref_topk).all())
+parity = None
+if pid == 0:  # one reference single-process sweep is enough for parity
+    driver2 = build_driver(N_T, N_R, seed=0).driver
+    driver2.mesh_enabled = False
+    driver2._mesh_cache = None
+    sweep = driver2._audit_sweep(K)
+    _r, _o, _m, ref_counts, ref_topk = sweep
+    k = min(topk.shape[1], ref_topk.shape[1])
+    parity = bool((counts == ref_counts).all()
+                  and (topk[:, :k] == ref_topk[:, :k]).all())
+# per-host DCN contribution: its own [C, 1+K] reduction (the all_gather
+# payload it sends; it receives the other hosts' equal share)
 packed_bytes = int((counts.shape[0]) * (1 + K) * 4)
 print(json.dumps({"pid": pid, "parity": parity,
                   "sweep_s": min(ts), "packed_bytes": packed_bytes}),
@@ -817,7 +907,7 @@ print(json.dumps({"pid": pid, "parity": parity,
     outs = []
     try:
         for p in procs:
-            out, err = p.communicate(timeout=600)
+            out, err = p.communicate(timeout=1800)
             if p.returncode != 0:
                 raise RuntimeError(
                     f"multihost worker rc={p.returncode}:\n{err[-2000:]}")
@@ -826,19 +916,22 @@ print(json.dumps({"pid": pid, "parity": parity,
         for p in procs:
             if p.poll() is None:
                 p.kill()
-    parity = all(o["parity"] for o in outs)
+    parity = all(o["parity"] for o in outs if o["parity"] is not None)
     sweep_s = max(o["sweep_s"] for o in outs)
     dcn_bytes = outs[0]["packed_bytes"]
-    log(f"multihost (2 procs x 4 virtual devices): parity={parity} "
-        f"warm sweep {sweep_s*1000:.0f}ms, ~{dcn_bytes/1e3:.1f}KB "
-        f"([C,1+K] reduction) crossing the host boundary per sweep")
+    log(f"multihost (2 procs x 4 virtual devices, {n_t}x{n_r}): "
+        f"parity={parity} warm sweep {sweep_s*1000:.0f}ms, "
+        f"~{dcn_bytes/1e3:.1f}KB ([C,1+K] reduction) crossing the host "
+        f"boundary per sweep")
     return {
-        "metric": "2-process multihost capped sweep (DCN lane)",
+        "metric": f"2-process multihost capped sweep (DCN lane, {n_t}x{n_r})",
         "value": round(sweep_s, 4),
         "unit": "s",
         "vs_baseline": 0,
         "parity": parity,
         "sweep_s": round(sweep_s, 4),
+        "templates": n_t,
+        "rows": n_r,
         "dcn_bytes_per_sweep": dcn_bytes,
     }
 
@@ -935,7 +1028,7 @@ def bench_synthetic() -> dict:
     import numpy as np
 
     try:
-        N_REP = int(os.environ.get("BENCH_DEVICE_REPS", "20"))
+        N_REP = int(os.environ.get("BENCH_DEVICE_REPS", "200"))
         with driver._lock:
             K = driver._audit_topk(cap)
             fn, _ord2, cp2, gp2, _crow2 = driver._audit_inputs(K)
@@ -944,19 +1037,32 @@ def bench_synthetic() -> dict:
                 cp2.arrays, gp2, None, None
             )
         raw = fn.__wrapped__
+        fused_raw = driver._fused.__wrapped__  # plain (mask, autoreject)
+        from gatekeeper_tpu.ops.matchkernel import match_kernel as _mk
 
-        def rep_n(rv, cs, cols, gp):
-            def body(carry, _):
-                rv2, cs2, cols2, gp2_ = jax.lax.optimization_barrier(
-                    (rv, cs, cols, gp))
-                packed = raw(rv2, cs2, cols2, gp2_)
-                return carry + packed[0, 0], None
+        def _chained(body_fn):
+            """Median per-iteration time of N_REP barrier-chained
+            executions whose carry depends on EVERY output element
+            (full-tensor sum — a [0,0] probe would let XLA's slice
+            pushdown dead-code the rest of the grid), RTT-subtracted."""
+            def rep_n(rv, cs, cols, gp):
+                def body(carry, _):
+                    rv2, cs2, cols2, gp2_ = jax.lax.optimization_barrier(
+                        (rv, cs, cols, gp))
+                    return carry + body_fn(rv2, cs2, cols2, gp2_), None
 
-            c, _ = jax.lax.scan(body, jnp.int32(0), None, length=N_REP)
-            return c
+                c, _ = jax.lax.scan(body, jnp.int32(0), None, length=N_REP)
+                return c
 
-        rep_jit = jax.jit(rep_n)
-        rep_jit(rv_d, cs_d, cols_d, gp_d).block_until_ready()  # compile
+            rep_jit = jax.jit(rep_n)
+            rep_jit(rv_d, cs_d, cols_d, gp_d).block_until_ready()  # compile
+            totals = []
+            for _ in range(5):
+                t0 = time.perf_counter()
+                rep_jit(rv_d, cs_d, cols_d, gp_d).block_until_ready()
+                totals.append(time.perf_counter() - t0)
+            return max(0.0, float(np.median(totals)) - rtt) / N_REP * 1e3
+
         tiny = jax.jit(lambda x: x + 1)
         xd = jax.device_put(np.int32(1))
         tiny(xd).block_until_ready()
@@ -966,13 +1072,27 @@ def bench_synthetic() -> dict:
             tiny(xd).block_until_ready()
             rtts.append(time.perf_counter() - t0)
         rtt = float(np.median(rtts))
-        rep_totals = []
-        for _ in range(5):
-            t0 = time.perf_counter()
-            rep_jit(rv_d, cs_d, cols_d, gp_d).block_until_ready()
-            rep_totals.append(time.perf_counter() - t0)
-        device_sweep_ms = max(
-            0.0, float(np.median(rep_totals)) - rtt) / N_REP * 1e3
+
+        # the breakdown the 2.25x roofline gap demands (r4 verdict #4):
+        # full kernel, mask-only (difference = reduction cost), match-only
+        # (difference = violation-program cost), and a pure input-bytes
+        # traversal (the ACHIEVABLE bandwidth for these arrays on this
+        # chip, a tighter bound than the spec-sheet roofline)
+        device_sweep_ms = _chained(
+            lambda rv, cs, c, gp: raw(rv, cs, c, gp).sum(dtype=jnp.int32))
+        mask_only_ms = _chained(
+            lambda rv, cs, c, gp:
+                fused_raw(rv, cs, c, gp)[0].sum(dtype=jnp.int32))
+        match_only_ms = _chained(
+            lambda rv, cs, c, gp: _mk(rv, cs)[0].sum(dtype=jnp.int32))
+
+        def _touch(rv, cs, c, gp):
+            tot = jnp.int32(0)
+            for leaf in jax.tree_util.tree_leaves((rv, cs, c, gp)):
+                tot = tot + leaf.sum(dtype=jnp.int32).astype(jnp.int32)
+            return tot
+
+        bytes_touch_ms = _chained(_touch)
 
         in_bytes = sum(
             a.nbytes for a in jax.tree_util.tree_leaves(
@@ -981,27 +1101,48 @@ def bench_synthetic() -> dict:
         cs_bytes = sum(
             a.nbytes for a in jax.tree_util.tree_leaves((cs_d, gp_d)))
         C = len(driver._ordered_constraints())
-        mask_bytes = C * driver._audit_pack.capacity  # bool intermediate
-        roofline_ms = (in_bytes + cs_bytes + 2 * mask_bytes) / (
-            V5E_HBM_GBPS * 1e9) * 1e3
+        ap = driver._audit_pack
+        # the [C, R] mask is an XLA-internal intermediate: the
+        # hierarchical reduction fuses into the mask producer, so no
+        # mask-sized array is ever written to (or re-read from) HBM —
+        # the bandwidth bound is the one pass over the packed inputs +
+        # the replicated constraint side
+        roofline_ms = (in_bytes + cs_bytes) / (V5E_HBM_GBPS * 1e9) * 1e3
         util = roofline_ms / device_sweep_ms if device_sweep_ms else 0.0
+        util_measured = (
+            bytes_touch_ms / device_sweep_ms if device_sweep_ms else 0.0
+        )
         device_cells_per_s = (
             cells / (device_sweep_ms / 1e3) if device_sweep_ms else 0.0
         )
         achieved_gbps = (
-            (in_bytes + cs_bytes + 2 * mask_bytes) / 1e9
+            (in_bytes + cs_bytes) / 1e9
             / (device_sweep_ms / 1e3) if device_sweep_ms else 0.0
         )
+        c_padded = len(driver._constraint_side()[1].arrays["valid"])
+        device_breakdown = {
+            "full_ms": round(device_sweep_ms, 4),
+            "mask_only_ms": round(mask_only_ms, 4),
+            "reduction_ms": round(max(0.0, device_sweep_ms - mask_only_ms), 4),
+            "match_only_ms": round(match_only_ms, 4),
+            "programs_ms": round(max(0.0, mask_only_ms - match_only_ms), 4),
+            "bytes_touch_ms": round(bytes_touch_ms, 4),
+            "pad_row_frac": round(1.0 - ap.n_rows / max(ap.capacity, 1), 4),
+            "pad_constraint_frac": round(1.0 - C / max(c_padded, 1), 4),
+        }
         log(f"on-device sweep: {device_sweep_ms:.3f}ms/sweep (median of 5 x "
             f"{N_REP}-rep chained dispatches, RTT {rtt*1e3:.1f}ms subtracted) "
             f"= {device_cells_per_s/1e9:.2f}B cell-evals/s, "
             f"{achieved_gbps:.0f}GB/s touched vs {V5E_HBM_GBPS:.0f}GB/s HBM "
-            f"-> {util*100:.1f}% of bandwidth bound "
+            f"-> {util*100:.1f}% of the spec-sheet input roofline, "
+            f"{util_measured*100:.1f}% of the measured-traversal bound "
             f"(roofline {roofline_ms:.2f}ms: inputs {in_bytes/1e6:.0f}MB + "
-            f"constraint side {cs_bytes/1e6:.0f}MB + mask 2x{mask_bytes/1e6:.0f}MB)")
+            f"constraint side {cs_bytes/1e6:.0f}MB; the [C,R] mask fuses "
+            f"away and never touches HBM); breakdown {device_breakdown}")
     except Exception as e:  # pragma: no cover
         log(f"on-device measurement failed: {e!r}")
         roofline_ms, util, device_sweep_ms, device_cells_per_s = 0.0, 0.0, 0.0, 0.0
+        util_measured, device_breakdown = 0.0, {}
 
     # ---- baseline: interpreter oracle on a slice, derated (BASELINE.md) --
     from gatekeeper_tpu.client.client import Client
@@ -1055,6 +1196,8 @@ def bench_synthetic() -> dict:
         "device_cell_evals_per_s": round(device_cells_per_s, 1),
         "hbm_roofline_ms": round(roofline_ms, 2),
         "device_util": round(util, 4),
+        "device_util_measured": round(util_measured, 4),
+        "device_breakdown": device_breakdown,
     }
 
 
@@ -1066,6 +1209,7 @@ CONFIGS = {
     "batch1m": bench_batch1m,
     "ingest": bench_ingest,
     "curve": bench_curve,
+    "restart": bench_restart,
     "mesh": bench_mesh,
     "multihost": bench_multihost,
 }
@@ -1079,6 +1223,7 @@ _FOLDED = [
     ("batch1m", "streamed_reviews_per_s"),
     ("ingest", "ingest_p50_ms"),
     ("curve", "curve_p50_ms"),
+    ("restart", "warm_restart_ready_s"),
     ("mesh", "mesh_scaling_x8"),
     ("multihost", "multihost_sweep_s"),
 ]
@@ -1097,8 +1242,10 @@ def main():
         os.path.join(os.path.dirname(os.path.abspath(__file__)), ".xla-cache"),
     )
     if cache_dir:
+        from gatekeeper_tpu.ops.aotcache import enable as enable_aot_cache
         from gatekeeper_tpu.ops.xlacache import enable as enable_xla_cache
 
+        enable_aot_cache(os.path.join(cache_dir, "aot"))
         if enable_xla_cache(cache_dir):
             try:
                 n = len(os.listdir(cache_dir))
@@ -1138,6 +1285,12 @@ def main():
             out["admission_server_p99_max_ms"] = sub.get("server_p99_max_ms")
         if name == "mesh":
             out["mesh_device_scaling"] = sub.get("device_scaling_ms")
+        if name == "restart":
+            out["warm_restart_template_ingest_s"] = sub.get(
+                "template_ingest_s")
+            out["warm_restart_data_replay_s"] = sub.get("data_replay_s")
+            out["warm_restart_first_sweep_s"] = sub.get("first_sweep_s")
+            out["restart_populate_ready_s"] = sub.get("populate_ready_s")
         if name == "ingest":
             out["ingest_p99_ms"] = sub.get("p99_ms")
             out["ingest_unique_p50_ms"] = sub.get("unique_p50_ms")

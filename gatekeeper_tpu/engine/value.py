@@ -284,17 +284,43 @@ def freeze(v: Any) -> Any:
     return _freeze_impl(v)
 
 
-def thaw(v: Any) -> Any:
-    """Frozen Rego value -> plain JSON-able Python value (sets -> sorted lists)."""
+def _thaw_py(v: Any) -> Any:
     if v is None or isinstance(v, (bool, int, float, str)):
         return v
     if isinstance(v, tuple):
-        return [thaw(x) for x in v]
+        return [_thaw_py(x) for x in v]
     if isinstance(v, FrozenDict):
-        return {thaw(k): thaw(val) for k, val in v.items()}
+        return {_thaw_py(k): _thaw_py(val) for k, val in v.items()}
     if isinstance(v, RSet):
-        return [thaw(x) for x in v.sorted_items()]
+        return [_thaw_py(x) for x in v.sorted_items()]
     raise TypeError(f"cannot thaw {type(v)!r}")
+
+
+_thaw_impl = None
+
+
+def thaw(v: Any) -> Any:
+    """Frozen Rego value -> plain JSON-able Python value (sets -> sorted
+    lists).  Native fast path (thaw_core) when available: the audit pack
+    rebuild thaws every cached object on a cold start, and pure-Python
+    recursion dominated warm-restart time.  Resolution mirrors freeze's
+    (the same freeze_init registration covers both)."""
+    global _thaw_impl
+    if _thaw_impl is None:
+        global _freeze_impl
+        if _freeze_impl is None:
+            _freeze_impl = _resolve_freeze()  # registers classes natively
+        try:
+            from ..native import load as _load_native
+
+            mod = _load_native()
+            if mod is not None and hasattr(mod, "thaw_core"):
+                _thaw_impl = mod.thaw_core
+            else:
+                _thaw_impl = _thaw_py
+        except Exception:
+            _thaw_impl = _thaw_py
+    return _thaw_impl(v)
 
 
 def is_number(v: Any) -> bool:

@@ -291,9 +291,14 @@ class App:
             level_encoder=getattr(args, "log_level_encoder", "lower"),
         )
         if getattr(args, "xla_cache_dir", ""):
+            from .ops.aotcache import enable as enable_aot_cache
             from .ops.xlacache import enable as enable_xla_cache
 
             enable_xla_cache(args.xla_cache_dir)
+            # serialized-executable cache rides in a subdir: it is what
+            # lets a warm restart skip the fused programs' TRACE time,
+            # which the XLA compile cache alone cannot save
+            enable_aot_cache(os.path.join(args.xla_cache_dir, "aot"))
         if getattr(args, "debug_use_fake_pod", False):
             # run outside Kubernetes: fixed pod identity, no owner refs on
             # status CRs (controller.go:133-142)

@@ -746,6 +746,173 @@ PyObject *freeze_rec_guarded(PyObject *v) {
   return nullptr;
 }
 
+// --------------------------------------------------------------------------
+// thaw_core: frozen Rego value -> plain JSON-able Python value
+// (engine/value.py thaw).  The audit pack rebuild thaws every cached
+// object on a cold start — pure-Python recursion was ~3s per 20k pods.
+// Iteration order matches the Python oracle exactly: FrozenDict.items()
+// and RSet.sorted_items() yield canonical (compare-sorted) order, so the
+// produced dicts/lists are byte-identical in serialization.
+// --------------------------------------------------------------------------
+
+PyObject *thaw_rec(PyObject *v);
+
+PyObject *thaw_rec_guarded(PyObject *v) {
+  if (v == Py_None || PyBool_Check(v) || PyLong_Check(v) ||
+      PyFloat_Check(v) || PyUnicode_Check(v)) {
+    Py_INCREF(v);
+    return v;
+  }
+  if (PyTuple_Check(v)) {
+    Py_ssize_t n = PyTuple_GET_SIZE(v);
+    PyObject *out = PyList_New(n);
+    if (!out) return nullptr;
+    for (Py_ssize_t i = 0; i < n; i++) {
+      PyObject *item = thaw_rec(PyTuple_GET_ITEM(v, i));
+      if (!item) {
+        Py_DECREF(out);
+        return nullptr;
+      }
+      PyList_SET_ITEM(out, i, item);
+    }
+    return out;
+  }
+  int is_fd = PyObject_IsInstance(v, g_frozendict_cls);
+  if (is_fd < 0) return nullptr;
+  if (is_fd) {
+    // all-string-key fast path (the overwhelming K8s-object shape):
+    // OPA's compare() on strings is plain lexicographic order, so a
+    // native unicode sort of _d's keys reproduces items()' canonical
+    // order without the Python sort machinery
+    PyObject *d = PyObject_GetAttrString(v, "_d");
+    if (d && PyDict_Check(d)) {
+      bool all_str = true;
+      PyObject *key, *val;
+      Py_ssize_t pos = 0;
+      while (PyDict_Next(d, &pos, &key, &val)) {
+        if (!PyUnicode_Check(key)) {
+          all_str = false;
+          break;
+        }
+      }
+      if (all_str) {
+        PyObject *keys = PyDict_Keys(d);
+        if (!keys || PyList_Sort(keys) < 0) {
+          Py_XDECREF(keys);
+          Py_DECREF(d);
+          return nullptr;
+        }
+        PyObject *out = PyDict_New();
+        if (!out) {
+          Py_DECREF(keys);
+          Py_DECREF(d);
+          return nullptr;
+        }
+        Py_ssize_t n = PyList_GET_SIZE(keys);
+        for (Py_ssize_t i = 0; i < n; i++) {
+          PyObject *k = PyList_GET_ITEM(keys, i);
+          PyObject *dv = PyDict_GetItem(d, k);  // borrowed
+          PyObject *tv = dv ? thaw_rec(dv) : nullptr;
+          int rc = tv ? PyDict_SetItem(out, k, tv) : -1;
+          Py_XDECREF(tv);
+          if (rc < 0) {
+            Py_DECREF(keys);
+            Py_DECREF(d);
+            Py_DECREF(out);
+            return nullptr;
+          }
+        }
+        Py_DECREF(keys);
+        Py_DECREF(d);
+        return out;
+      }
+    }
+    Py_XDECREF(d);
+    if (PyErr_Occurred()) return nullptr;
+    // items() yields canonical sorted order (cached on the FrozenDict)
+    PyObject *items = PyObject_CallMethod(v, "items", nullptr);
+    if (!items) return nullptr;
+    PyObject *out = PyDict_New();
+    if (!out) {
+      Py_DECREF(items);
+      return nullptr;
+    }
+    PyObject *it = PyObject_GetIter(items);
+    Py_DECREF(items);
+    if (!it) {
+      Py_DECREF(out);
+      return nullptr;
+    }
+    PyObject *pair;
+    while ((pair = PyIter_Next(it)) != nullptr) {
+      PyObject *tk = thaw_rec(PyTuple_GET_ITEM(pair, 0));
+      PyObject *tv = tk ? thaw_rec(PyTuple_GET_ITEM(pair, 1)) : nullptr;
+      int rc = (tk && tv) ? PyDict_SetItem(out, tk, tv) : -1;
+      Py_XDECREF(tk);
+      Py_XDECREF(tv);
+      Py_DECREF(pair);
+      if (rc < 0) {
+        Py_DECREF(it);
+        Py_DECREF(out);
+        return nullptr;
+      }
+    }
+    Py_DECREF(it);
+    if (PyErr_Occurred()) {
+      Py_DECREF(out);
+      return nullptr;
+    }
+    return out;
+  }
+  int is_rs = PyObject_IsInstance(v, g_rset_cls);
+  if (is_rs < 0) return nullptr;
+  if (is_rs) {
+    PyObject *sorted_items = PyObject_CallMethod(v, "sorted_items", nullptr);
+    if (!sorted_items) return nullptr;
+    Py_ssize_t n = PyList_Check(sorted_items)
+                       ? PyList_GET_SIZE(sorted_items)
+                       : -1;
+    if (n < 0) {
+      Py_DECREF(sorted_items);
+      PyErr_SetString(PyExc_TypeError, "sorted_items did not return a list");
+      return nullptr;
+    }
+    PyObject *out = PyList_New(n);
+    if (!out) {
+      Py_DECREF(sorted_items);
+      return nullptr;
+    }
+    for (Py_ssize_t i = 0; i < n; i++) {
+      PyObject *item = thaw_rec(PyList_GET_ITEM(sorted_items, i));
+      if (!item) {
+        Py_DECREF(sorted_items);
+        Py_DECREF(out);
+        return nullptr;
+      }
+      PyList_SET_ITEM(out, i, item);
+    }
+    Py_DECREF(sorted_items);
+    return out;
+  }
+  PyErr_Format(PyExc_TypeError, "cannot thaw %R", (PyObject *)Py_TYPE(v));
+  return nullptr;
+}
+
+PyObject *thaw_rec(PyObject *v) {
+  if (Py_EnterRecursiveCall(" in thaw")) return nullptr;
+  PyObject *out = thaw_rec_guarded(v);
+  Py_LeaveRecursiveCall();
+  return out;
+}
+
+PyObject *thaw_core(PyObject *, PyObject *arg) {
+  if (!g_frozendict_cls || !g_rset_cls) {
+    PyErr_SetString(PyExc_RuntimeError, "freeze_init not called");
+    return nullptr;
+  }
+  return thaw_rec(arg);
+}
+
 PyObject *freeze_init(PyObject *, PyObject *args) {
   PyObject *fd, *rs;
   if (!PyArg_ParseTuple(args, "OO", &fd, &rs)) return nullptr;
@@ -771,6 +938,8 @@ PyMethodDef methods[] = {
      "register the FrozenDict and RSet classes"},
     {"freeze_core", freeze_core, METH_O,
      "JSON-like tree -> frozen Rego value (engine/value.py freeze)"},
+    {"thaw_core", thaw_core, METH_O,
+     "frozen Rego value -> plain JSON-able value (engine/value.py thaw)"},
     {"pack_reviews_core", pack_reviews_core, METH_VARARGS,
      "fill review-side fixed buffers; returns label pair flats+counts"},
     {"extract_scalar", extract_scalar, METH_VARARGS,

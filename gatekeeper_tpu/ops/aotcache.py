@@ -1,0 +1,216 @@
+"""Ahead-of-time executable cache: serialized compiled XLA programs.
+
+SURVEY.md §5.4: all engine state is derived and rebuilt on boot; the one
+artifact worth keeping across restarts is the compiled evaluation
+program.  jax's persistent compilation cache (ops/xlacache.py) already
+skips the XLA *compile*, but a restarted process still re-TRACES every
+fused function (pure Python, seconds for a 500-template corpus) before
+the cache can even be consulted — measured as the dominant share of cold
+start.  This module serializes the whole compiled executable
+(jax.experimental.serialize_executable) keyed by the trace-equivalence
+signature + concrete input layout, so a warm restart skips trace AND
+compile: deserialize is ~ms.
+
+Scope and safety:
+- Keys include the jax version, backend kind, a fingerprint of this
+  package's kernel SOURCE (an executable serialized by an older build
+  must never serve a binary whose kernel semantics changed), and a hash
+  of the structure signature plus every input leaf's shape/dtype — any
+  mismatch is a miss and the caller falls back to the normal jit path.
+- Single-device executables only (the mesh path's device assignment
+  does not survive a process restart; it stays on the jit path).
+- A deserialized executable that rejects its args is deleted and its
+  key blacklisted, so a bad entry costs one reload, not one per call.
+- XLA:CPU AOT results are machine-feature-pinned: restoring on a
+  different host may refuse or warn — also treated as a miss.  The
+  production restart scenario is the same pod image on the same node.
+
+The wrapper (aot_jit) mimics the narrow jit surface the driver uses:
+call with concrete arrays, get outputs; no static/donated args.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import logging
+import os
+import pickle
+import threading
+from typing import Any, Callable, Optional
+
+import jax
+
+log = logging.getLogger("gatekeeper.aotcache")
+
+_dir: Optional[str] = None
+_lock = threading.Lock()
+_code_fp: Optional[str] = None
+
+
+def enable(cache_dir: str) -> bool:
+    global _dir
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+    except OSError:
+        log.exception("aot cache dir unavailable: %s", cache_dir)
+        return False
+    _dir = cache_dir
+    return True
+
+
+def enabled() -> bool:
+    return _dir is not None
+
+
+def _code_fingerprint() -> str:
+    """Digest of every source file in this package: a build whose kernel
+    code changed must never reuse an older build's executables (they
+    would silently reproduce pre-fix semantics)."""
+    global _code_fp
+    if _code_fp is None:
+        h = hashlib.sha256()
+        pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        for root, _dirs, files in sorted(os.walk(pkg)):
+            for f in sorted(files):
+                if f.endswith((".py", ".cpp")):
+                    path = os.path.join(root, f)
+                    h.update(f.encode())
+                    try:
+                        with open(path, "rb") as fh:
+                            h.update(fh.read())
+                    except OSError:
+                        pass
+        _code_fp = h.hexdigest()
+    return _code_fp
+
+
+def _leaf_sig(x) -> str:
+    if hasattr(x, "shape") and hasattr(x, "dtype"):
+        return f"{tuple(x.shape)}:{x.dtype}"
+    return f"py:{type(x).__name__}:{x!r}"
+
+
+def load(key: str):
+    """-> compiled executable or None."""
+    if _dir is None:
+        return None
+    path = os.path.join(_dir, key + ".aot")
+    try:
+        with open(path, "rb") as f:
+            payload, in_tree, out_tree = pickle.load(f)
+    except FileNotFoundError:
+        return None
+    except Exception:
+        log.exception("aot cache entry unreadable: %s", key)
+        return None
+    try:
+        from jax.experimental import serialize_executable as se
+
+        return se.deserialize_and_load(payload, in_tree, out_tree)
+    except Exception:
+        log.warning("aot cache entry failed to load (treated as miss): %s",
+                    key)
+        return None
+
+
+def save(key: str, compiled) -> bool:
+    if _dir is None:
+        return False
+    try:
+        from jax.experimental import serialize_executable as se
+
+        payload, in_tree, out_tree = se.serialize(compiled)
+        buf = io.BytesIO()
+        pickle.dump((payload, in_tree, out_tree), buf,
+                    protocol=pickle.HIGHEST_PROTOCOL)
+        path = os.path.join(_dir, key + ".aot")
+        tmp = path + f".tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(buf.getvalue())
+        os.replace(tmp, path)  # atomic: concurrent writers race benignly
+        return True
+    except Exception:
+        log.exception("aot cache save failed: %s", key)
+        return False
+
+
+def drop(key: str) -> None:
+    if _dir is None:
+        return
+    try:
+        os.remove(os.path.join(_dir, key + ".aot"))
+    except OSError:
+        pass
+
+
+class aot_jit:
+    """jit with executable persistence.
+
+    First call per input layout: try the AOT cache (deserialize, ~ms);
+    miss -> lower+compile via the normal jit machinery and persist the
+    executable.  Executables are memoized per layout key (one aot_jit
+    instance serves multiple shape buckets — admission batches and the
+    audit-capacity shape — without thrashing); a key whose executable
+    rejects its args is blacklisted and its file dropped.
+    """
+
+    def __init__(self, fn: Callable, tag: str, sig: Any = None):
+        self._fn = fn
+        self._jitted = jax.jit(fn)
+        self._tag = tag
+        # the expensive, per-instance-constant key components hash once
+        h = hashlib.sha256()
+        h.update(jax.__version__.encode())
+        h.update(_code_fingerprint().encode())
+        h.update(tag.encode())
+        h.update(repr(sig).encode())
+        self._prefix = h
+        self._compiled: dict = {}  # key -> executable
+        self._bad: set = set()
+        self._mu = threading.Lock()
+        # jax.jit attribute parity for wrappers that reach for it
+        self.__wrapped__ = fn
+
+    def _key(self, args) -> str:
+        h = self._prefix.copy()
+        h.update(jax.default_backend().encode())
+        leaves, treedef = jax.tree_util.tree_flatten(args)
+        h.update(str(treedef).encode())
+        for leaf in leaves:
+            h.update(_leaf_sig(leaf).encode())
+        return f"{self._tag}-{h.hexdigest()[:32]}"
+
+    def __call__(self, *args):
+        if not enabled():
+            return self._jitted(*args)  # tests/no-cache: plain jit
+        key = self._key(args)
+        with self._mu:
+            compiled = self._compiled.get(key)
+            bad = key in self._bad
+        if compiled is None and not bad:
+            compiled = load(key)
+            if compiled is not None:
+                log.info("aot cache hit: %s", key)
+            else:
+                # one trace+compile for this layout (the .compile()
+                # consults jax's persistent XLA cache when enabled), then
+                # persist the executable so the NEXT process skips the
+                # trace too
+                compiled = self._jitted.lower(*args).compile()
+                save(key, compiled)
+            with self._mu:
+                self._compiled[key] = compiled
+        if compiled is not None:
+            try:
+                return compiled(*args)
+            except Exception:
+                # layout drift or loader refusal: drop the entry and
+                # blacklist the key so the cost is one reload, not per call
+                log.warning("aot executable rejected args; blacklisting "
+                            "and falling back to jit: %s", key)
+                drop(key)
+                with self._mu:
+                    self._compiled.pop(key, None)
+                    self._bad.add(key)
+        return self._jitted(*args)

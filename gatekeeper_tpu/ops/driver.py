@@ -47,16 +47,71 @@ def _tree_sig(tree):
     )
 
 
+_REDUCTION_BLOCK = 64
+
+
 def _packed_reduction(mask, K: int):
     """[C] counts + first-K candidate row indices -> one [C, 1+K] int32.
     lax.top_k is stable (equal elements keep index order), so the K
     largest of the 0/1 mask are the K smallest true indices, ascending —
-    exactly the first-k walk order the host renders."""
+    exactly the first-k walk order the host renders.
+
+    A flat top_k over the full row axis is a width-R sort per constraint
+    — measured as 91% of the on-device sweep at 500x100k (r4 verdict #4,
+    the "2.25x roofline gap").  The hierarchical form runs two narrow
+    top_ks instead: block-OR the mask into R/W blocks, take the first K
+    TRUE blocks (every true block holds >= 1 candidate, so the first K
+    candidates live in the first <= K true blocks), gather just those
+    K x W segments, and resolve the exact first-K within them.  Every
+    shape is static; total traffic approaches the one-pass mask read."""
+    C, R = mask.shape
     counts = jnp.sum(mask, axis=1, dtype=jnp.int32)
-    k = min(K, mask.shape[1])
-    vals, idx = jax.lax.top_k(mask.astype(jnp.int8), k)
+    k = min(K, R)
+    W = _REDUCTION_BLOCK
+    if k * W * 2 >= R or R % W != 0:
+        # small rows (or huge K): the flat sort is already cheap/cheaper
+        vals, idx = jax.lax.top_k(mask.astype(jnp.int8), k)
+        idx = jnp.where(vals > 0, idx, -1)
+        return jnp.concatenate(
+            [counts[:, None], idx.astype(jnp.int32)], axis=1
+        )
+    B = R // W
+    blocks = mask.reshape(C, B, W)
+    blk_any = jnp.any(blocks, axis=2)
+    bvals, bidx = jax.lax.top_k(blk_any.astype(jnp.int8), k)  # first-k blocks
+    # gather the K candidate blocks' segments: [C, k, W]
+    segs = jnp.take_along_axis(blocks, bidx[:, :, None], axis=1)
+    # blocks beyond the true-block count gather arbitrary (all-false)
+    # blocks; mask them out explicitly for clarity
+    segs = segs & (bvals > 0)[:, :, None]
+    flat = segs.reshape(C, k * W)  # ascending global order (bidx sorted)
+    gcol = (bidx[:, :, None] * W
+            + jnp.arange(W, dtype=jnp.int32)[None, None, :]).reshape(C, k * W)
+    vals, pos = jax.lax.top_k(flat.astype(jnp.int8), k)
+    idx = jnp.take_along_axis(gcol, pos, axis=1)
     idx = jnp.where(vals > 0, idx, -1)
     return jnp.concatenate([counts[:, None], idx.astype(jnp.int32)], axis=1)
+
+
+def _merge_sharded_packed(packed_all: np.ndarray, K: int) -> np.ndarray:
+    """[N shards, C, 1+K'] per-shard capped reductions -> global
+    [C, 1+K].  Counts sum; candidate indices are already global rows
+    (-1 padded) and each shard's list is ascending within its contiguous
+    row slab, so shard-major concatenation preserves global ascending
+    order — the merge keeps the first K valid entries per constraint.
+    K' = min(K, rows per shard) may be smaller than K (each shard then
+    contributes its COMPLETE row slab, so the merge is still exact);
+    the output is padded back to width K for the single-device shape
+    contract."""
+    counts = packed_all[:, :, 0].sum(axis=0, dtype=np.int32)
+    cand = np.transpose(packed_all[:, :, 1:], (1, 0, 2))
+    cand = cand.reshape(cand.shape[0], -1)  # [C, N*K'], shard-major
+    if cand.shape[1] < K:
+        cand = np.pad(cand, ((0, 0), (0, K - cand.shape[1])),
+                      constant_values=-1)
+    order = np.argsort(cand == -1, axis=1, kind="stable")[:, :K]
+    merged = np.take_along_axis(cand, order, axis=1)
+    return np.concatenate([counts[:, None], merged], axis=1)
 
 
 @jax.jit
@@ -167,6 +222,8 @@ class TpuDriver(InterpDriver):
         # (full re-upload only on pack layout changes) so a steady-state
         # sweep uploads ~KBs, not the whole 100k-row pack, across the link.
         self._audit_dev = None
+        # the mesh twin: [layout_gen, mesh id, sharded (rv, cols)]
+        self._audit_dev_mesh = None
         # capped-audit fused fns: packed-only (single-device; the mask is
         # a separate lazy dispatch) and two-output (mesh)
         self._fused_audit = None
@@ -368,6 +425,7 @@ class TpuDriver(InterpDriver):
             self._render_memo.clear()
             self._audit_cache = None
             self._audit_dev = None  # layout gens restart with the new pack
+            self._audit_dev_mesh = None
             self._fused_audit = None
             self._fused_audit_key = None
             self._fused_audit_mesh = None
@@ -530,7 +588,9 @@ class TpuDriver(InterpDriver):
                 )
             return mask, autoreject
 
-        self._fused = jax.jit(fused)
+        from .aotcache import aot_jit
+
+        self._fused = aot_jit(fused, "fused", sig)
         self._fused_key = sig
         self._fused_gen += 1
         return self._fused, side
@@ -581,12 +641,18 @@ class TpuDriver(InterpDriver):
         the driver lock.  The async compile thread dispatches UNLOCKED, so
         reading self._cs_epoch here could key stale constraint arrays under
         a newer epoch (advisor r2); callers that hold the lock may omit it."""
+        from .aotcache import aot_jit
+
         mesh = self._mesh()
         cs_p, gp_p = self._constraint_device_side(
             cp_arrays, group_params, cs_key, mesh
         )
         if mesh is None:
             return fn(rv_arrays, cs_p, cols, gp_p)
+        if isinstance(fn, aot_jit):
+            # serialized executables pin a single-device layout; the mesh
+            # path must go through the jit machinery's SPMD compile
+            fn = fn._jitted
         from ..parallel.mesh import shard_review_side
 
         rv_p, cols_p, _target = shard_review_side(mesh, rows, rv_arrays, cols)
@@ -634,7 +700,11 @@ class TpuDriver(InterpDriver):
                 jnp.concatenate([mask, autoreject], axis=0), axis=1
             )
 
-        self._fused_packed = jax.jit(fused_packed)
+        from .aotcache import aot_jit
+
+        self._fused_packed = aot_jit(
+            fused_packed, "fused-packed", self._fused_key
+        )
         self._fused_packed_src = fn
         return self._fused_packed
 
@@ -839,6 +909,24 @@ class TpuDriver(InterpDriver):
             out[entry[:2]] = entry
         return [out[key] for key in sorted(out)]
 
+
+    def _inventory_for_render(self):
+        """The frozen inventory handed to render paths, or an empty
+        FrozenDict when NO installed template reads data.inventory: the
+        exact render can then never touch it, and a restart's first
+        sweep skips freezing the whole cluster tree (O(cluster), ~5s at
+        20k objects — the dominant share of warm-restart time for
+        inventory-free corpora).  Templates that do read inventory keep
+        the full (incrementally re-spined) snapshot."""
+        if any(
+            getattr(t.policy, "uses_inventory", True)
+            for t in self.templates.values()
+        ):
+            return self.store.frozen()
+        from ..engine.value import freeze
+
+        return freeze({})
+
     def _interp_review_memo(self, review: dict, memo_key=None):
         """InterpDriver.review semantics served through the content-keyed
         render memos: the hybrid small-batch path and the async-compile
@@ -860,7 +948,7 @@ class TpuDriver(InterpDriver):
             self.last_review_stats = {
                 "lock_wait_ms": (t_locked - t_enter) * 1e3,
             }
-            inventory = self.store.frozen()
+            inventory = self._inventory_for_render()
             cached_ns = self.store.cached_namespace
             if memo_key is not None:
                 frozen_review, memo_review = memo_key
@@ -924,7 +1012,8 @@ class TpuDriver(InterpDriver):
             if hit[0] != self._cs_epoch:
                 per_key = self._repair_memo_entry(
                     hit[0], hit[1], review, frozen_review, memo_review,
-                    self.store.frozen(), self.store.cached_namespace,
+                    self._inventory_for_render(),
+                    self.store.cached_namespace,
                 )
                 if per_key is None:
                     return None, memo_key  # log overran: full re-eval
@@ -1231,7 +1320,7 @@ class TpuDriver(InterpDriver):
             ]
         with self._lock:
             ordered, mask, autoreject = self.compute_masks(reviews)
-            inventory = self.store.frozen()
+            inventory = self._inventory_for_render()
             mask_np = np.asarray(mask)
             rej_np = np.asarray(autoreject)
             if tracing:
@@ -1319,7 +1408,7 @@ class TpuDriver(InterpDriver):
             if got is None:
                 return None
             ordered, mask, rej = got
-            inventory = self.store.frozen()
+            inventory = self._inventory_for_render()
             out = self._render_masked(reviews, ordered, mask, rej, inventory)
             if (
                 len(reviews) <= self.REQUEST_MEMO_BATCH_MAX
@@ -1448,30 +1537,71 @@ class TpuDriver(InterpDriver):
             mask, _autoreject = raw(rv, cs, cols, gp)
             return _packed_reduction(mask, K)
 
-        self._fused_audit = jax.jit(fused_audit)
+        from .aotcache import aot_jit
+
+        self._fused_audit = aot_jit(
+            fused_audit, "fused-audit", (self._fused_key, K)
+        )
         self._fused_audit_key = (self._fused_gen, K)
         return self._fused_audit, side
 
-    def _fused_audit_mesh_fn(self, K: int):
-        """Two-output (mask, packed) capped-audit variant for the mesh
-        path: one dispatch produces the reduction AND the device-resident
-        mask.  ICI-attached devices don't charge a co-output against the
-        small fetch the way the relay does, and a single dispatch avoids
-        a double [C, R] evaluation + duplicate review-side shard upload."""
+    def _fused_audit_mesh_fn(self, K: int, mesh=None):
+        """Two-output (mask, per-shard packed) capped-audit variant for
+        the mesh path, built with shard_map: each shard evaluates ONLY
+        its row slab and reduces it locally to [C, 1+K] (counts + first-K
+        candidates translated to GLOBAL row indices); the host merges the
+        N small per-shard reductions (_merge_sharded_packed).  Letting
+        GSPMD partition the naive jit instead all-gathers the mask for
+        the order-dependent top-k — every device then re-reduces the FULL
+        row axis, which measured as ~8x single-device time on an
+        8-virtual-device mesh (r4 verdict weak #5).  The mask output
+        stays device-resident and row-sharded."""
+        from jax.sharding import PartitionSpec as _P
+
         fused, _side = self._fused_fn()
+        key_now = self._fused_audit_mesh_key
         if (
             self._fused_audit_mesh is not None
-            and self._fused_audit_mesh_key == (self._fused_gen, K)
+            and key_now is not None
+            and key_now[0] == self._fused_gen
+            and key_now[1] == K
+            and key_now[2] is mesh  # identity-is-liveness, not id()
         ):
             return self._fused_audit_mesh
         raw = fused.__wrapped__
 
-        def fused_audit_mesh(rv, cs, cols, gp):
+        def body(rv, cs, cols, gp):
             mask, _autoreject = raw(rv, cs, cols, gp)
-            return mask, _packed_reduction(mask, K)
+            packed = _packed_reduction(mask, K)
+            shard = jax.lax.axis_index("data")
+            idx = packed[:, 1:]
+            idx = jnp.where(idx >= 0, idx + shard * mask.shape[1], -1)
+            packed = jnp.concatenate([packed[:, :1], idx], axis=1)
+            return mask, packed[None]  # leading shard axis for out_specs
 
-        self._fused_audit_mesh = jax.jit(fused_audit_mesh)
-        self._fused_audit_mesh_key = (self._fused_gen, K)
+        sharded = [None]  # built on first call: specs follow arg trees
+
+        def fused_audit_mesh(rv, cs, cols, gp):
+            if sharded[0] is None:
+                def row_spec(a):
+                    return _P("data", *([None] * (a.ndim - 1)))
+
+                repl = _P()
+                in_specs = (
+                    jax.tree_util.tree_map(row_spec, rv),
+                    jax.tree_util.tree_map(lambda a: repl, cs),
+                    jax.tree_util.tree_map(row_spec, cols),
+                    jax.tree_util.tree_map(lambda a: repl, gp),
+                )
+                out_specs = (_P(None, "data"), _P("data", None, None))
+                sharded[0] = jax.jit(jax.shard_map(
+                    body, mesh=mesh, in_specs=in_specs,
+                    out_specs=out_specs, check_vma=False,
+                ))
+            return sharded[0](rv, cs, cols, gp)
+
+        self._fused_audit_mesh = fused_audit_mesh
+        self._fused_audit_mesh_key = (self._fused_gen, K, mesh)
         return self._fused_audit_mesh
 
     def _audit_inputs(self, K: int):
@@ -1533,6 +1663,10 @@ class TpuDriver(InterpDriver):
         number of changed objects, not the inventory size."""
         ap = self._audit_pack
         dirty = ap.take_dirty()
+        if dirty:
+            # the dirty set is consumed HERE; the mesh twin can no longer
+            # patch itself and must re-place on its next use
+            self._audit_dev_mesh = None
         cache = self._audit_dev
         if cache is None or cache[0] != ap.layout_gen:
             tree = (ap.rp, ap.cols)
@@ -1561,6 +1695,46 @@ class TpuDriver(InterpDriver):
             placed = _scatter_rows(cache[1], rows, host_rows)
             self._audit_dev = [ap.layout_gen, placed]
         return self._audit_dev[1]
+
+    def _audit_device_inputs_mesh(self, mesh):
+        """Shard-resident review-side audit arrays (mesh path): the
+        padded, row-sharded placement is committed once per pack layout;
+        steady-state sweeps patch just the dirty rows with the same
+        jitted scatter the single-device path uses, so host->device
+        traffic is proportional to churn on every topology."""
+        from ..parallel.mesh import shard_review_side
+
+        ap = self._audit_pack
+        dirty = ap.take_dirty()
+        if dirty:
+            # consumed here; the single-device twin must re-place next use
+            self._audit_dev = None
+        cache = self._audit_dev_mesh
+        if cache is None or cache[0] != ap.layout_gen or cache[1] is not mesh:
+            tree = (ap.rp, ap.cols)
+            if jax.default_backend() == "cpu":
+                # CPU device_put may be zero-copy (see the single-device
+                # path): copy so later in-place row packs cannot mutate
+                # the committed base state
+                tree = jax.tree_util.tree_map(np.array, tree)
+            rv_p, cols_p, _target = shard_review_side(
+                mesh, ap.capacity, tree[0], tree[1]
+            )
+            # the mesh OBJECT rides in the cache: identity-is-liveness (a
+            # recycled id() could alias a dead mesh, advisor r5)
+            self._audit_dev_mesh = [ap.layout_gen, mesh, (rv_p, cols_p)]
+            return rv_p, cols_p
+        if dirty:
+            rows = np.fromiter(sorted(dirty), np.int32, len(dirty))
+            width = self._scatter_width(len(rows))
+            rows = np.pad(rows, (0, width - len(rows)), mode="edge")
+            host_rows = jax.tree_util.tree_map(
+                lambda a: a[rows], (ap.rp, ap.cols)
+            )
+            with mesh:
+                placed = _scatter_rows(cache[2], rows, host_rows)
+            self._audit_dev_mesh = [ap.layout_gen, mesh, placed]
+        return self._audit_dev_mesh[2]
 
     def _audit_sweep(self, K: int, reuse_any_k: bool = False):
         """One device sweep over the resident audit pack ->
@@ -1614,19 +1788,32 @@ class TpuDriver(InterpDriver):
             self._warm_delta_async(mask_src, cs_d, gp_d)
         else:
             # mesh path: ONE two-output dispatch (mask stays device-
-            # resident, only packed is fetched); resolved eagerly because
-            # ap's host arrays mutate in place on later row packs, so a
-            # deferred upload would capture a post-base state
-            mask_dev, packed_dev = self._dispatch(
-                self._fused_audit_mesh_fn(K), ap.rp, cp.arrays, ap.cols,
-                group_params, ap.capacity,
+            # resident, only packed is fetched) over SHARD-RESIDENT audit
+            # inputs: like the single-device path, the padded+sharded
+            # review side is committed once per pack layout and patched
+            # by a jitted scatter of just the dirty rows — re-placing the
+            # full row pack across N shards every sweep was the measured
+            # ~4x sharded-path overhead (r4 verdict weak #5)
+            rv_p, cols_p = self._audit_device_inputs_mesh(mesh)
+            cs_p, gp_p = self._constraint_device_side(
+                cp.arrays, group_params, None, mesh
             )
+            with mesh:
+                mask_dev, packed_dev = self._fused_audit_mesh_fn(K, mesh)(
+                    rv_p, cs_p, cols_p, gp_p
+                )
             mask_src = MaskSource.resolved(mask_dev)
         packed_dev.block_until_ready()
         t2 = _time.perf_counter()
         # the ONE small fetch per sweep; crow folds the group-major pad
         # rows out so all host-side state is per ordered constraint
-        packed = np.asarray(packed_dev)[crow]
+        if mesh is None:
+            packed = np.asarray(packed_dev)[crow]
+        else:
+            # merge to the SAME width K the single-device reduction
+            # produces (per-shard lists may be narrower when a shard's
+            # row slab is smaller than K)
+            packed = _merge_sharded_packed(np.asarray(packed_dev), K)[crow]
         t3 = _time.perf_counter()
         counts = packed[:, 0].astype(np.int64)
         sweep = (ap.reviews, ordered, mask_src, counts, packed[:, 1:])
@@ -1714,7 +1901,7 @@ class TpuDriver(InterpDriver):
             reviews, ordered, mask = self._audit_masks()
             if not reviews:
                 return [], ("" if tracing else None)
-            inventory = self.store.frozen()
+            inventory = self._inventory_for_render()
             results: List[Result] = []
             trace: List[str] = [] if tracing else None
             # resource-major order, matching InterpDriver.audit; only
@@ -1830,7 +2017,9 @@ class TpuDriver(InterpDriver):
                 [old.astype(jnp.int8), new.astype(jnp.int8)], axis=1
             )
 
-        self._delta_jit = jax.jit(delta)
+        from .aotcache import aot_jit
+
+        self._delta_jit = aot_jit(delta, "delta", self._fused_key)
         self._delta_jit_key = self._fused_gen
         return self._delta_jit
 
@@ -2038,7 +2227,7 @@ class TpuDriver(InterpDriver):
             self._render_memo_epoch = self._cs_epoch
         reuse = st.render_cache if trace is None else {}
         new_cache: Dict[Tuple, Tuple] = {}
-        inventory = self.store.frozen()
+        inventory = self._inventory_for_render()
         frozen_cache: Dict[int, object] = {}
         results: List[Result] = []
         totals: Dict[Tuple[str, str], Tuple[int, str]] = {}

@@ -94,9 +94,20 @@ def shard_rows_global(mesh: Mesh, rows: int, tree):
 
 
 def multihost_capped_sweep(driver, K: int):
-    """The full capped-audit device sweep over the multi-host mesh: fused
-    evaluation + on-device [C, 1+K] reduction, returned REPLICATED so every
-    host can render/write status.  -> (ordered, counts [C], topk [C, K])."""
+    """The full capped-audit device sweep over the multi-host mesh, built
+    with shard_map: every shard evaluates ONLY its contiguous row slab and
+    reduces it locally to [C, 1+K] (counts + first-K candidates translated
+    to global row indices); an all_gather of those KB-scale reductions —
+    the only DCN data-plane traffic — replicates them to every host, and
+    the host-side merge (ops/driver._merge_sharded_packed) produces the
+    global capped result.  Letting GSPMD partition a naive replicated-out
+    jit instead all-gathers the [C, R] mask for the order-dependent top-k,
+    making every shard re-reduce the full row axis (the r4 verdict's
+    sharded-overhead finding).  -> (ordered, counts [C], topk [C, K])."""
+    import jax.numpy as jnp
+
+    from ..ops.driver import _merge_sharded_packed
+
     fn, ordered, cp, group_params, crow = driver._audit_inputs(K)
     ap = driver._audit_pack
     if ap.n_rows == 0:
@@ -114,14 +125,37 @@ def multihost_capped_sweep(driver, K: int):
     if cached is not None and cached[0] == key:
         sharded = cached[1]
     else:
-        raw = fn.__wrapped__  # fused_audit: already packed-only
-        sharded = jax.jit(
-            lambda rv, cs, c, gp: raw(rv, cs, c, gp),
-            out_shardings=NamedSharding(mesh, P()),
+        raw = fn.__wrapped__  # fused_audit: already packed-only, local rows
+
+        def body(rv, cs, c, gp):
+            packed = raw(rv, cs, c, gp)  # [C, 1+K'], local row indices
+            rows_local = rv["valid"].shape[0]
+            shard = jax.lax.axis_index(("host", "data"))
+            idx = packed[:, 1:]
+            idx = jnp.where(idx >= 0, idx + shard * rows_local, -1)
+            packed = jnp.concatenate([packed[:, :1], idx], axis=1)
+            # [N, C, 1+K'] replicated: the KB-scale DCN crossing
+            return jax.lax.all_gather(packed, ("host", "data"))
+
+        def row_spec(a):
+            return P(("host", "data"), *([None] * (a.ndim - 1)))
+
+        repl = P()
+        in_specs = (
+            jax.tree_util.tree_map(lambda a: row_spec(a), rv_g),
+            jax.tree_util.tree_map(lambda a: repl, cs_g),
+            jax.tree_util.tree_map(lambda a: row_spec(a), cols_g),
+            jax.tree_util.tree_map(lambda a: repl, gp_g),
         )
+        sharded = jax.jit(jax.shard_map(
+            body, mesh=mesh, in_specs=in_specs, out_specs=repl,
+            check_vma=False,
+        ))
         driver._multihost_jit = (key, sharded)
     with mesh:
-        packed = sharded(rv_g, cs_g, cols_g, gp_g)
-    # crow folds group-major pad rows out (driver._constraint_side)
-    packed = np.asarray(packed.addressable_data(0))[crow]
+        allp = sharded(rv_g, cs_g, cols_g, gp_g)
+    allp = np.asarray(allp.addressable_data(0))  # replicated [N, C, 1+K']
+    # crow folds group-major pad rows out (driver._constraint_side);
+    # merge back to the single-device width K
+    packed = _merge_sharded_packed(allp, K)[crow]
     return ordered, packed[:, 0].astype(np.int64), packed[:, 1:]

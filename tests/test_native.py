@@ -342,3 +342,59 @@ class TestFreezeDifferential:
         finally:
             stop.set()
             t.join(timeout=5)
+
+
+class TestNativeThaw:
+    """thaw_core differential parity against the Python oracle
+    (engine/value.py _thaw_py), including canonical key order."""
+
+    def test_fuzz_parity(self):
+        import json
+        import random
+
+        from gatekeeper_tpu.engine.value import _thaw_py, freeze, thaw
+        from gatekeeper_tpu.native import load
+
+        if load() is None or not hasattr(load(), "thaw_core"):
+            import pytest
+
+            pytest.skip("native extension unavailable")
+
+        rng = random.Random(7)
+
+        def rnd(d=0):
+            if d > 3 or rng.random() < 0.3:
+                return rng.choice([None, True, False, 0, 1, -3, 2.5, "", "s",
+                                   "zz", "x/y:z"])
+            k = rng.random()
+            if k < 0.5:
+                return {
+                    rng.choice(["b", "a", "c", "x/y", "0z", "Z"]) + str(i):
+                        rnd(d + 1)
+                    for i in range(rng.randint(0, 4))
+                }
+            if k < 0.8:
+                return [rnd(d + 1) for _ in range(rng.randint(0, 4))]
+            return {rng.choice(["q", "w"]) + str(i)
+                    for i in range(rng.randint(0, 3))}
+
+        for _ in range(1500):
+            f = freeze(rnd())
+            a, b = thaw(f), _thaw_py(f)
+            # same values AND same canonical serialization order
+            assert a == b
+            assert json.dumps(a) == json.dumps(b)
+
+    def test_non_string_keys_fall_back_to_items_order(self):
+        from gatekeeper_tpu.engine.value import _thaw_py, freeze, thaw
+
+        f = freeze({5: "a", "b": 1, True: "t"})
+        assert thaw(f) == _thaw_py(f)
+
+    def test_typeerror_on_unthawable(self):
+        import pytest
+
+        from gatekeeper_tpu.engine.value import thaw
+
+        with pytest.raises(TypeError):
+            thaw(object())
