@@ -423,12 +423,31 @@ def bench_ingest() -> dict:
 
     c = Client(driver=TpuDriver(async_compile=True))
     # production webhook processes freeze long-lived state out of the
-    # cyclic GC (webhook/server.py); without it gen-2 collections land in
-    # the storm's p99
+    # cyclic GC and take the collector off the admission path entirely
+    # (webhook/server.py start(): freeze + disable + background sweeps);
+    # the storm mirrors that policy or collections land in its p99
     import gc
 
     gc.collect()
     gc.freeze()
+    gc.disable()
+    try:
+        return _bench_ingest_storm(
+            c, templates, constraints, req, upods, upod_req, vpods,
+            n_templates,
+        )
+    finally:
+        # a mid-storm exception must not leave the collector off for
+        # every later folded config (main() swallows and continues)
+        gc.enable()
+        gc.unfreeze()
+        c.driver._compiler.stop()
+
+
+def _bench_ingest_storm(c, templates, constraints, req, upods, upod_req,
+                        vpods, n_templates):
+    import numpy as np
+
     lat, ulat, waits, evals = [], [], [], []
     t0 = time.time()
     for i, (t, k) in enumerate(zip(templates, constraints)):
@@ -471,8 +490,6 @@ def bench_ingest() -> dict:
         f"eval p50 {e50:.2f}/p99 {e99:.2f}ms); violating-unique "
         f"p50={float(np.percentile(varr, 50)):.2f}ms "
         f"p99={float(np.percentile(varr, 99)):.2f}ms")
-    gc.unfreeze()
-    c.driver._compiler.stop()
     return {
         "metric": f"ingest-to-first-eval p50 ({n_templates}-template storm, async compile)",
         "value": round(p50, 3),
@@ -1042,16 +1059,17 @@ def bench_synthetic() -> dict:
 
         def _chained(body_fn, reps=None):
             """Median per-iteration time of `reps` barrier-chained
-            executions whose carry depends on EVERY output element
-            (full-tensor sum — a [0,0] probe would let XLA's slice
-            pushdown dead-code the rest of the grid), RTT-subtracted."""
+            executions, RTT-subtracted.  body_fn(carry, rv, cs, cols, gp)
+            -> new carry; it must depend on EVERY output element (a
+            [0,0] probe would let XLA's slice pushdown dead-code the
+            rest of the grid)."""
             reps = reps or N_REP
 
             def rep_n(rv, cs, cols, gp):
                 def body(carry, _):
                     rv2, cs2, cols2, gp2_ = jax.lax.optimization_barrier(
                         (rv, cs, cols, gp))
-                    return carry + body_fn(rv2, cs2, cols2, gp2_), None
+                    return body_fn(carry, rv2, cs2, cols2, gp2_), None
 
                 c, _ = jax.lax.scan(body, jnp.int32(0), None, length=reps)
                 return c
@@ -1081,17 +1099,28 @@ def bench_synthetic() -> dict:
         # traversal (the ACHIEVABLE bandwidth for these arrays on this
         # chip, a tighter bound than the spec-sheet roofline)
         device_sweep_ms = _chained(
-            lambda rv, cs, c, gp: raw(rv, cs, c, gp).sum(dtype=jnp.int32))
+            lambda k, rv, cs, c, gp:
+                k + raw(rv, cs, c, gp).sum(dtype=jnp.int32))
         mask_only_ms = _chained(
-            lambda rv, cs, c, gp:
-                fused_raw(rv, cs, c, gp)[0].sum(dtype=jnp.int32))
+            lambda k, rv, cs, c, gp:
+                k + fused_raw(rv, cs, c, gp)[0].sum(dtype=jnp.int32))
         match_only_ms = _chained(
-            lambda rv, cs, c, gp: _mk(rv, cs)[0].sum(dtype=jnp.int32))
+            lambda k, rv, cs, c, gp:
+                k + _mk(rv, cs)[0].sum(dtype=jnp.int32))
 
-        def _touch(rv, cs, c, gp):
-            tot = jnp.int32(0)
+        # the traversal body must be NON-FACTORABLE in the scan carry:
+        # a multiplicative weight fails (sum(x*w) == w*sum(x) exactly in
+        # int32 modular arithmetic, and XLA's simplifier performs that
+        # scalar-out-of-reduce rewrite, leaving a hoistable invariant
+        # reduce).  xor has no such identity, so the reduce must
+        # re-execute every iteration.
+        def _touch(k, rv, cs, c, gp):
+            w = (k & 1) + 1
+            tot = k
             for leaf in jax.tree_util.tree_leaves((rv, cs, c, gp)):
-                tot = tot + leaf.sum(dtype=jnp.int32).astype(jnp.int32)
+                tot = tot + (
+                    leaf.astype(jnp.int32) ^ w
+                ).sum(dtype=jnp.int32)
             return tot
 
         # the traversal kernel is ~10x cheaper than the sweep; give it
@@ -1113,8 +1142,11 @@ def bench_synthetic() -> dict:
         # the replicated constraint side
         roofline_ms = (in_bytes + cs_bytes) / (V5E_HBM_GBPS * 1e9) * 1e3
         util = roofline_ms / device_sweep_ms if device_sweep_ms else 0.0
+        # unresolved when the probe collapses below any plausible
+        # traversal time (the analytic `device_util` still stands)
         util_measured = (
-            bytes_touch_ms / device_sweep_ms if device_sweep_ms else 0.0
+            round(bytes_touch_ms / device_sweep_ms, 4)
+            if device_sweep_ms and bytes_touch_ms > 0.005 else None
         )
         device_cells_per_s = (
             cells / (device_sweep_ms / 1e3) if device_sweep_ms else 0.0
@@ -1139,7 +1171,9 @@ def bench_synthetic() -> dict:
             f"= {device_cells_per_s/1e9:.2f}B cell-evals/s, "
             f"{achieved_gbps:.0f}GB/s touched vs {V5E_HBM_GBPS:.0f}GB/s HBM "
             f"-> {util*100:.1f}% of the spec-sheet input roofline, "
-            f"{util_measured*100:.1f}% of the measured-traversal bound "
+            + (f"{util_measured*100:.1f}%" if util_measured is not None
+               else "unresolved fraction")
+            + " of the measured-traversal bound "
             f"(roofline {roofline_ms:.2f}ms: inputs {in_bytes/1e6:.0f}MB + "
             f"constraint side {cs_bytes/1e6:.0f}MB; the [C,R] mask fuses "
             f"away and never touches HBM); breakdown {device_breakdown}")
@@ -1200,7 +1234,7 @@ def bench_synthetic() -> dict:
         "device_cell_evals_per_s": round(device_cells_per_s, 1),
         "hbm_roofline_ms": round(roofline_ms, 2),
         "device_util": round(util, 4),
-        "device_util_measured": round(util_measured, 4),
+        "device_util_measured": util_measured,
         "device_breakdown": device_breakdown,
     }
 

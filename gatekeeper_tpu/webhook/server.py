@@ -361,13 +361,35 @@ class WebhookServer:
         # p99 tactic: move everything allocated so far (compiled policies,
         # packed tensors, module graph) out of the cyclic GC's generations —
         # a gen-2 collection scanning a 100k-object inventory otherwise
-        # injects multi-ms pauses into the admission path
+        # injects multi-ms pauses into the admission path — then take the
+        # collector OFF the admission path entirely: automatic collections
+        # triggered mid-request inject ms-scale pauses exactly at p99.
+        # Refcounting still frees the (acyclic) request traffic; a
+        # background sweeper collects the rare cycles every few seconds.
         import gc
 
         gc.collect()
         gc.freeze()
+        gc.disable()
+        self._gc_stop = threading.Event()
+
+        def _sweep():
+            while not self._gc_stop.wait(5.0):
+                gc.collect()
+
+        threading.Thread(target=_sweep, name="webhook-gc", daemon=True).start()
 
     def stop(self):
+        if getattr(self, "_gc_stop", None) is not None:
+            self._gc_stop.set()
+            self._gc_stop = None
+            import gc
+
+            gc.enable()
+            # unfreeze too: repeated start/stop cycles (tests, embedders)
+            # would otherwise grow the permanent generation monotonically
+            # and any cycles frozen on a later start() would leak forever
+            gc.unfreeze()
         # established keep-alive connections keep their handler threads
         # alive past shutdown(); the flag makes them 503 + close instead
         # of serving admission decisions from a stopped server
