@@ -1180,7 +1180,7 @@ def bench_synthetic() -> dict:
     except Exception as e:  # pragma: no cover
         log(f"on-device measurement failed: {e!r}")
         roofline_ms, util, device_sweep_ms, device_cells_per_s = 0.0, 0.0, 0.0, 0.0
-        util_measured, device_breakdown = 0.0, {}
+        util_measured, device_breakdown = None, {}
 
     # ---- baseline: interpreter oracle on a slice, derated (BASELINE.md) --
     from gatekeeper_tpu.client.client import Client

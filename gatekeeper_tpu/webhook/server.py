@@ -371,10 +371,14 @@ class WebhookServer:
         gc.collect()
         gc.freeze()
         gc.disable()
-        self._gc_stop = threading.Event()
+        stop_evt = threading.Event()
+        self._gc_stop = stop_evt
 
         def _sweep():
-            while not self._gc_stop.wait(5.0):
+            # closes over the Event only: capturing self would pin a
+            # dropped server forever and re-reading self._gc_stop races
+            # stop()'s None reset
+            while not stop_evt.wait(5.0):
                 gc.collect()
 
         threading.Thread(target=_sweep, name="webhook-gc", daemon=True).start()

@@ -1,0 +1,118 @@
+"""Serialized-executable (AOT) cache: ops/aotcache.py.
+
+The warm-restart artifact (SURVEY §5.4): a restarted process must load
+compiled executables from disk without re-tracing, never reuse an
+executable across kernel-source changes, and degrade to plain jit on
+any cache pathology.
+"""
+
+import numpy as np
+import pytest
+
+from gatekeeper_tpu.ops import aotcache
+
+
+@pytest.fixture
+def cache_dir(tmp_path, monkeypatch):
+    # isolate the module state: enabled dir + memoized fingerprint
+    monkeypatch.setattr(aotcache, "_dir", None)
+    assert aotcache.enable(str(tmp_path))
+    yield str(tmp_path)
+    monkeypatch.setattr(aotcache, "_dir", None)
+
+
+def _fn(x, y):
+    return (x * 2 + y).sum()
+
+
+class TestRoundTrip:
+    def test_save_then_fresh_instance_loads(self, cache_dir):
+        import os
+
+        x = np.arange(8, dtype=np.float32)
+        y = np.ones(8, dtype=np.float32)
+        a = aotcache.aot_jit(_fn, "t-roundtrip", sig="s1")
+        out1 = float(a(x, y))
+        assert any(f.endswith(".aot") for f in os.listdir(cache_dir))
+        # a fresh instance (fresh process analogue) must LOAD, not compile
+        b = aotcache.aot_jit(_fn, "t-roundtrip", sig="s1")
+        key = b._key((x, y))
+        assert aotcache.load(key) is not None
+        out2 = float(b(x, y))
+        assert out1 == out2
+
+    def test_multiple_layouts_memoized(self, cache_dir):
+        a = aotcache.aot_jit(_fn, "t-layouts", sig="s1")
+        x8 = np.arange(8, dtype=np.float32)
+        x16 = np.arange(16, dtype=np.float32)
+        a(x8, x8)
+        a(x16, x16)
+        a(x8, x8)  # back to the first layout: no thrash
+        assert len(a._compiled) == 2
+
+    def test_disabled_falls_back_to_jit(self, monkeypatch):
+        monkeypatch.setattr(aotcache, "_dir", None)
+        a = aotcache.aot_jit(_fn, "t-disabled", sig=None)
+        x = np.ones(4, dtype=np.float32)
+        assert float(a(x, x)) == float(_fn(x, x))
+        assert not a._compiled
+
+
+class TestInvalidation:
+    def test_sig_change_changes_key(self, cache_dir):
+        x = np.ones(4, dtype=np.float32)
+        a = aotcache.aot_jit(_fn, "t-sig", sig="v1")
+        b = aotcache.aot_jit(_fn, "t-sig", sig="v2")
+        assert a._key((x, x)) != b._key((x, x))
+
+    def test_layout_change_changes_key(self, cache_dir):
+        a = aotcache.aot_jit(_fn, "t-shape", sig="s")
+        x4 = np.ones(4, dtype=np.float32)
+        x8 = np.ones(8, dtype=np.float32)
+        assert a._key((x4, x4)) != a._key((x8, x8))
+
+    def test_code_fingerprint_in_key(self, cache_dir, monkeypatch):
+        x = np.ones(4, dtype=np.float32)
+        a = aotcache.aot_jit(_fn, "t-code", sig="s")
+        k1 = a._key((x, x))
+        monkeypatch.setattr(aotcache, "_code_fp", "different-build")
+        b = aotcache.aot_jit(_fn, "t-code", sig="s")
+        assert b._key((x, x)) != k1
+
+    def test_unreadable_entry_is_miss(self, cache_dir):
+        import os
+
+        x = np.ones(4, dtype=np.float32)
+        a = aotcache.aot_jit(_fn, "t-corrupt", sig="s")
+        a(x, x)
+        (entry,) = [f for f in os.listdir(cache_dir) if f.endswith(".aot")]
+        with open(os.path.join(cache_dir, entry), "wb") as f:
+            f.write(b"not a pickle")
+        fresh = aotcache.aot_jit(_fn, "t-corrupt", sig="s")
+        assert float(fresh(x, x)) == float(_fn(x, x))  # recompiles fine
+
+
+class TestBadEntryBlacklist:
+    def test_rejecting_executable_blacklisted_and_dropped(self, cache_dir):
+        import os
+
+        x = np.ones(4, dtype=np.float32)
+        a = aotcache.aot_jit(_fn, "t-bad", sig="s")
+        a(x, x)
+        key = a._key((x, x))
+
+        class Rejecting:
+            calls = 0
+
+            def __call__(self, *args):
+                Rejecting.calls += 1
+                raise RuntimeError("layout drift")
+
+        a._compiled[key] = Rejecting()
+        out = a(x, x)  # falls back to jit
+        assert float(out) == float(_fn(x, x))
+        assert key in a._bad
+        assert not os.path.exists(os.path.join(cache_dir, key + ".aot"))
+        # subsequent calls never touch the bad entry again
+        a(x, x)
+        assert Rejecting.calls == 1
