@@ -1045,7 +1045,7 @@ def bench_synthetic() -> dict:
     import numpy as np
 
     try:
-        N_REP = int(os.environ.get("BENCH_DEVICE_REPS", "200"))
+        N_REP = int(os.environ.get("BENCH_DEVICE_REPS", "2000"))
         with driver._lock:
             K = driver._audit_topk(cap)
             fn, _ord2, cp2, gp2, _crow2 = driver._audit_inputs(K)
