@@ -1045,6 +1045,7 @@ def bench_synthetic() -> dict:
     import numpy as np
 
     try:
+        N_REP_LO = int(os.environ.get("BENCH_DEVICE_REPS_LO", "200"))
         N_REP = int(os.environ.get("BENCH_DEVICE_REPS", "2000"))
         with driver._lock:
             K = driver._audit_topk(cap)
@@ -1057,14 +1058,7 @@ def bench_synthetic() -> dict:
         fused_raw = driver._fused.__wrapped__  # plain (mask, autoreject)
         from gatekeeper_tpu.ops.matchkernel import match_kernel as _mk
 
-        def _chained(body_fn, reps=None):
-            """Median per-iteration time of `reps` barrier-chained
-            executions, RTT-subtracted.  body_fn(carry, rv, cs, cols, gp)
-            -> new carry; it must depend on EVERY output element (a
-            [0,0] probe would let XLA's slice pushdown dead-code the
-            rest of the grid)."""
-            reps = reps or N_REP
-
+        def _rep_jit(body_fn, reps):
             def rep_n(rv, cs, cols, gp):
                 def body(carry, _):
                     rv2, cs2, cols2, gp2_ = jax.lax.optimization_barrier(
@@ -1074,14 +1068,50 @@ def bench_synthetic() -> dict:
                 c, _ = jax.lax.scan(body, jnp.int32(0), None, length=reps)
                 return c
 
-            rep_jit = jax.jit(rep_n)
-            rep_jit(rv_d, cs_d, cols_d, gp_d).block_until_ready()  # compile
-            totals = []
+            return jax.jit(rep_n)
+
+        def _timed(jitted):
+            ts = []
             for _ in range(5):
                 t0 = time.perf_counter()
-                rep_jit(rv_d, cs_d, cols_d, gp_d).block_until_ready()
-                totals.append(time.perf_counter() - t0)
-            return max(0.0, float(np.median(totals)) - rtt) / reps * 1e3
+                jitted(rv_d, cs_d, cols_d, gp_d).block_until_ready()
+                ts.append(time.perf_counter() - t0)
+            return float(np.median(ts))
+
+        def _chained(body_fn, reps=None):
+            """Per-iteration time of a barrier-chained scan, estimated by
+            a CASCADE: slope between two scan lengths (cancels the relay
+            RTT exactly), at two length pairs, then plain RTT subtraction.
+            XLA may legitimately hoist the loop-invariant body out of the
+            scan (observed always on XLA:CPU, intermittently on TPU, and
+            it varies with trip count) — a collapsed estimator reports
+            None rather than a fake zero, and the caller publishes null.
+            body_fn(carry, rv, cs, cols, gp) -> new carry; it must depend
+            on EVERY output element (a [0,0] probe would let XLA's slice
+            pushdown dead-code the rest of the grid)."""
+            hi = max(2, reps or N_REP)
+            lo = max(1, min(N_REP_LO, hi // 10))
+            floor_ms = 0.002  # below this, the estimator didn't resolve
+
+            def compiled(n):
+                j = _rep_jit(body_fn, n)
+                j(rv_d, cs_d, cols_d, gp_d).block_until_ready()
+                return j
+
+            jit_lo, jit_hi = compiled(lo), compiled(hi)
+            t_lo, t_hi = _timed(jit_lo), _timed(jit_hi)
+            if hi > lo:
+                per = (t_hi - t_lo) / (hi - lo) * 1e3
+                if per > floor_ms:
+                    return per
+            if lo > 1:
+                # built lazily: the common path never needs the 1-rep jit
+                t_1 = _timed(compiled(1))
+                per = (t_lo - t_1) / (lo - 1) * 1e3
+                if per > floor_ms:
+                    return per
+            per = (t_hi - rtt) / hi * 1e3
+            return per if per > floor_ms else None
 
         tiny = jax.jit(lambda x: x + 1)
         xd = jax.device_put(np.int32(1))
@@ -1108,19 +1138,10 @@ def bench_synthetic() -> dict:
             lambda k, rv, cs, c, gp:
                 k + _mk(rv, cs)[0].sum(dtype=jnp.int32))
 
-        # the traversal body must be NON-FACTORABLE in the scan carry:
-        # a multiplicative weight fails (sum(x*w) == w*sum(x) exactly in
-        # int32 modular arithmetic, and XLA's simplifier performs that
-        # scalar-out-of-reduce rewrite, leaving a hoistable invariant
-        # reduce).  xor has no such identity, so the reduce must
-        # re-execute every iteration.
         def _touch(k, rv, cs, c, gp):
-            w = (k & 1) + 1
             tot = k
             for leaf in jax.tree_util.tree_leaves((rv, cs, c, gp)):
-                tot = tot + (
-                    leaf.astype(jnp.int32) ^ w
-                ).sum(dtype=jnp.int32)
+                tot = tot + leaf.astype(jnp.int32).sum(dtype=jnp.int32)
             return tot
 
         # the traversal kernel is ~10x cheaper than the sweep; give it
@@ -1141,46 +1162,66 @@ def bench_synthetic() -> dict:
         # the bandwidth bound is the one pass over the packed inputs +
         # the replicated constraint side
         roofline_ms = (in_bytes + cs_bytes) / (V5E_HBM_GBPS * 1e9) * 1e3
-        util = roofline_ms / device_sweep_ms if device_sweep_ms else 0.0
-        # unresolved when the probe collapses below any plausible
-        # traversal time (the analytic `device_util` still stands)
+
+        def _r(x):
+            return round(x, 4) if x is not None else None
+
+        def _delta(a, b):
+            if a is None or b is None:
+                return None
+            return round(max(0.0, a - b), 4)
+
+        # every derived figure is null when its estimator didn't resolve
+        # (XLA hoisted the scan body; see _chained) — never a fake zero
+        util = (
+            round(roofline_ms / device_sweep_ms, 4)
+            if device_sweep_ms else None
+        )
         util_measured = (
             round(bytes_touch_ms / device_sweep_ms, 4)
-            if device_sweep_ms and bytes_touch_ms > 0.005 else None
+            if device_sweep_ms and bytes_touch_ms else None
         )
         device_cells_per_s = (
-            cells / (device_sweep_ms / 1e3) if device_sweep_ms else 0.0
+            cells / (device_sweep_ms / 1e3) if device_sweep_ms else None
         )
         achieved_gbps = (
-            (in_bytes + cs_bytes) / 1e9
-            / (device_sweep_ms / 1e3) if device_sweep_ms else 0.0
+            (in_bytes + cs_bytes) / 1e9 / (device_sweep_ms / 1e3)
+            if device_sweep_ms else None
         )
         c_padded = len(driver._constraint_side()[1].arrays["valid"])
         device_breakdown = {
-            "full_ms": round(device_sweep_ms, 4),
-            "mask_only_ms": round(mask_only_ms, 4),
-            "reduction_ms": round(max(0.0, device_sweep_ms - mask_only_ms), 4),
-            "match_only_ms": round(match_only_ms, 4),
-            "programs_ms": round(max(0.0, mask_only_ms - match_only_ms), 4),
-            "bytes_touch_ms": round(bytes_touch_ms, 4),
+            "full_ms": _r(device_sweep_ms),
+            "mask_only_ms": _r(mask_only_ms),
+            "reduction_ms": _delta(device_sweep_ms, mask_only_ms),
+            "match_only_ms": _r(match_only_ms),
+            "programs_ms": _delta(mask_only_ms, match_only_ms),
+            "bytes_touch_ms": _r(bytes_touch_ms),
             "pad_row_frac": round(1.0 - ap.n_rows / max(ap.capacity, 1), 4),
             "pad_constraint_frac": round(1.0 - C / max(c_padded, 1), 4),
         }
-        log(f"on-device sweep: {device_sweep_ms:.3f}ms/sweep (median of 5 x "
-            f"{N_REP}-rep chained dispatches, RTT {rtt*1e3:.1f}ms subtracted) "
-            f"= {device_cells_per_s/1e9:.2f}B cell-evals/s, "
-            f"{achieved_gbps:.0f}GB/s touched vs {V5E_HBM_GBPS:.0f}GB/s HBM "
-            f"-> {util*100:.1f}% of the spec-sheet input roofline, "
+        log("on-device sweep: "
+            + (f"{device_sweep_ms:.3f}ms/sweep" if device_sweep_ms
+               else "UNRESOLVED (estimator cascade collapsed)")
+            + f" (chained-scan slope {N_REP_LO}/{N_REP} reps; relay RTT "
+            f"~{rtt*1e3:.0f}ms cancels in the difference) = "
+            + (f"{device_cells_per_s/1e9:.2f}B cell-evals/s, "
+               if device_cells_per_s else "")
+            + (f"{achieved_gbps:.0f}GB/s" if achieved_gbps is not None
+               else "n/a GB/s")
+            + f" touched vs {V5E_HBM_GBPS:.0f}GB/s HBM -> "
+            + (f"{util*100:.1f}%" if util is not None else "n/a")
+            + " of the spec-sheet input roofline, "
             + (f"{util_measured*100:.1f}%" if util_measured is not None
                else "unresolved fraction")
             + " of the measured-traversal bound "
             f"(roofline {roofline_ms:.2f}ms: inputs {in_bytes/1e6:.0f}MB + "
             f"constraint side {cs_bytes/1e6:.0f}MB; the [C,R] mask fuses "
-            f"away and never touches HBM); breakdown {device_breakdown}")
+            f"away and never touches HBM); breakdown "
+            f"{device_breakdown}")
     except Exception as e:  # pragma: no cover
         log(f"on-device measurement failed: {e!r}")
-        roofline_ms, util, device_sweep_ms, device_cells_per_s = 0.0, 0.0, 0.0, 0.0
-        util_measured, device_breakdown = None, {}
+        roofline_ms, device_sweep_ms, device_cells_per_s = 0.0, None, None
+        util, util_measured, device_breakdown = None, None, {}
 
     # ---- baseline: interpreter oracle on a slice, derated (BASELINE.md) --
     from gatekeeper_tpu.client.client import Client
@@ -1230,10 +1271,14 @@ def bench_synthetic() -> dict:
         # clean ON-DEVICE numbers (repeat-dispatch median, RTT subtracted):
         # the fields the near-roofline claim rests on; full_sweep_device_ms
         # above stays relay-inclusive for honesty
-        "device_sweep_ms": round(device_sweep_ms, 4),
-        "device_cell_evals_per_s": round(device_cells_per_s, 1),
+        "device_sweep_ms": (
+            round(device_sweep_ms, 4) if device_sweep_ms is not None
+            else None),
+        "device_cell_evals_per_s": (
+            round(device_cells_per_s, 1) if device_cells_per_s is not None
+            else None),
         "hbm_roofline_ms": round(roofline_ms, 2),
-        "device_util": round(util, 4),
+        "device_util": util,
         "device_util_measured": util_measured,
         "device_breakdown": device_breakdown,
     }

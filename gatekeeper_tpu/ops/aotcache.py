@@ -167,6 +167,7 @@ class aot_jit:
         h.update(repr(sig).encode())
         self._prefix = h
         self._compiled: dict = {}  # key -> executable
+        self._validated: set = set()  # keys whose output was block-checked
         self._bad: set = set()
         self._mu = threading.Lock()
         # jax.jit attribute parity for wrappers that reach for it
@@ -199,18 +200,43 @@ class aot_jit:
                 # trace too
                 compiled = self._jitted.lower(*args).compile()
                 save(key, compiled)
+                with self._mu:
+                    self._validated.add(key)  # it just compiled here
             with self._mu:
                 self._compiled[key] = compiled
         if compiled is not None:
+            with self._mu:
+                validated = key in self._validated
             try:
-                return compiled(*args)
+                out = compiled(*args)
+                if not validated:
+                    # dispatch is ASYNC: a deserialized executable that
+                    # cannot run on this host (XLA:CPU AOT results are
+                    # machine-feature-pinned) fails at block time, which
+                    # would otherwise surface far from here in the
+                    # caller's fetch.  Validate loaded entries once.
+                    jax.block_until_ready(out)
+                    with self._mu:
+                        self._validated.add(key)
+                return out
             except Exception:
-                # layout drift or loader refusal: drop the entry and
-                # blacklist the key so the cost is one reload, not per call
+                # layout drift, loader refusal, or a host-incompatible
+                # executable: drop the entry and blacklist the key so the
+                # cost is one reload, not per call.  The jit fallback
+                # below re-runs the work; it is BLOCKED here so a failure
+                # that was never about this executable (e.g. a transient
+                # device OOM) still surfaces at the call site rather than
+                # asynchronously in the caller's fetch — blacklisting a
+                # healthy entry on such a failure costs one re-trace, a
+                # deliberate trade against serving a broken executable.
                 log.warning("aot executable rejected args; blacklisting "
                             "and falling back to jit: %s", key)
                 drop(key)
                 with self._mu:
                     self._compiled.pop(key, None)
+                    self._validated.discard(key)
                     self._bad.add(key)
+                out = self._jitted(*args)
+                jax.block_until_ready(out)
+                return out
         return self._jitted(*args)
