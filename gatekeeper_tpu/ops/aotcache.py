@@ -189,6 +189,7 @@ class aot_jit:
         with self._mu:
             compiled = self._compiled.get(key)
             bad = key in self._bad
+            validated = key in self._validated
         if compiled is None and not bad:
             compiled = load(key)
             if compiled is not None:
@@ -205,8 +206,6 @@ class aot_jit:
             with self._mu:
                 self._compiled[key] = compiled
         if compiled is not None:
-            with self._mu:
-                validated = key in self._validated
             try:
                 out = compiled(*args)
                 if not validated:
