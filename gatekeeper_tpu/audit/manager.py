@@ -131,6 +131,11 @@ class AuditManager:
         # uses the driver's cap-aware device reduction, whose totals are
         # exact below the cap and "violating resources" at/over it.
         self.exact_totals = exact_totals
+        # failure visibility: a silently failing audit (bare except in the
+        # loop) must be observable — last-run status + consecutive-failure
+        # streak, exported via Reporters.report_audit_status
+        self.consecutive_failures = 0
+        self.last_run_status: Optional[str] = None  # "ok" | "error"
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -151,10 +156,35 @@ class AuditManager:
 
     def _loop(self):
         while not self._stop.wait(timeout=self.interval_s):
-            try:
-                self.audit_once()
-            except Exception:
-                log.exception("audit failed")
+            self.run_once_guarded()
+
+    def run_once_guarded(self) -> bool:
+        """One audit sweep with failure accounting: the loop body.  Never
+        raises; returns True on success.  Failures keep the loop alive
+        (kube outage, device fault) but are no longer silent — the status
+        and streak land in metrics and on this object."""
+        try:
+            self.audit_once()
+        except Exception:
+            self.consecutive_failures += 1
+            self.last_run_status = "error"
+            log.exception(
+                "audit failed (%d consecutive)", self.consecutive_failures
+            )
+            self._report_status(False)
+            return False
+        self.consecutive_failures = 0
+        self.last_run_status = "ok"
+        self._report_status(True)
+        return True
+
+    def _report_status(self, ok: bool):
+        if self.reporter is None:
+            return
+        try:
+            self.reporter.report_audit_status(ok, self.consecutive_failures)
+        except Exception:
+            log.exception("could not report audit status")
 
     # ---- one sweep (manager.go:146-230) -----------------------------------
 

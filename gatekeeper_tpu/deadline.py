@@ -1,0 +1,65 @@
+"""Per-request deadline budgets.
+
+The webhook server stamps each admission request with an absolute
+monotonic deadline derived from a configured budget; everything
+downstream on the same thread (micro-batcher enqueue, driver fallback
+ladders) reads it through this module and refuses to start work it can
+no longer finish.  An exhausted budget surfaces as `DeadlineExceeded`,
+which the validation handler converts into an explicit fail-open or
+fail-closed admission decision — never a socket timeout.
+
+The deadline rides a ContextVar: each webhook handler thread carries its
+own, and code with no deadline set (audit sweeps, tests, background
+threads) sees None everywhere and pays nothing.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import time
+from contextlib import contextmanager
+from typing import Optional
+
+class DeadlineExceeded(Exception):
+    """The request's deadline budget is exhausted."""
+
+
+_ctx: contextvars.ContextVar = contextvars.ContextVar(
+    "gk_deadline", default=None
+)
+
+
+def push(budget_s: float):
+    """Set the current context's deadline to now + budget_s; returns a
+    token for `pop`."""
+    return _ctx.set(time.monotonic() + budget_s)
+
+
+def pop(token):
+    _ctx.reset(token)
+
+
+def current() -> Optional[float]:
+    """The absolute monotonic deadline, or None when no budget is set."""
+    return _ctx.get()
+
+
+def remaining() -> Optional[float]:
+    """Seconds left (may be negative), or None when no budget is set."""
+    dl = _ctx.get()
+    return None if dl is None else dl - time.monotonic()
+
+
+def expired() -> bool:
+    dl = _ctx.get()
+    return dl is not None and time.monotonic() > dl
+
+
+@contextmanager
+def budget(budget_s: float):
+    """Scope a deadline budget around a block (tests, embedders)."""
+    token = push(budget_s)
+    try:
+        yield
+    finally:
+        pop(token)

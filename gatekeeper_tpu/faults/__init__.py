@@ -1,0 +1,92 @@
+"""Process-global fault-injection plane (see `faults.plane`).
+
+Production hot paths guard injection with ONE branch:
+
+    from .. import faults
+    if faults.ENABLED:
+        faults.fire(faults.TPU_DISPATCH)
+
+`ENABLED` stays False (and `fire` a no-op) unless `install()` is called
+explicitly — by a chaos test, or by the process entry point when the
+operator sets an explicit fault spec.  Nothing here imports jax or any
+other heavyweight dependency.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .plane import ERROR, HANG, LATENCY, FaultError, FaultPlane, FaultRule
+
+# ---- named injection points -------------------------------------------------
+
+KUBE_SEND = "kube.http.send"          # kube/http_client.py request send
+KUBE_RECV = "kube.http.recv"          # kube/http_client.py response read
+WATCH_DELIVER = "watch.deliver"       # watch/manager.py pump fan-out
+TPU_COMPILE = "tpu.compile"           # ops/driver.py fused-fn (re)build
+TPU_DISPATCH = "tpu.dispatch"         # ops/driver.py device dispatch
+WEBHOOK_ENQUEUE = "webhook.enqueue"   # webhook/server.py batch queue
+
+ALL_POINTS = (
+    KUBE_SEND, KUBE_RECV, WATCH_DELIVER, TPU_COMPILE, TPU_DISPATCH,
+    WEBHOOK_ENQUEUE,
+)
+
+# ---- the process-global plane ----------------------------------------------
+
+ENABLED = False
+_plane: Optional[FaultPlane] = None
+
+
+def install(seed: int = 0, plane: Optional[FaultPlane] = None) -> FaultPlane:
+    """Enable fault injection process-wide.  Returns the active plane so
+    callers can add rules.  Idempotent only in the sense that a second
+    install replaces the first plane wholesale."""
+    global _plane, ENABLED
+    _plane = plane if plane is not None else FaultPlane(seed=seed)
+    ENABLED = True
+    return _plane
+
+
+def uninstall():
+    """Disable injection and drop the plane.  In-flight hangs are released
+    first so no thread stays parked on a dead plane."""
+    global _plane, ENABLED
+    ENABLED = False
+    p, _plane = _plane, None
+    if p is not None:
+        p.release_hangs()
+
+
+def get_plane() -> Optional[FaultPlane]:
+    return _plane
+
+
+def fire(point: str, **ctx):
+    """Hot-path entry: no-op unless a plane is installed.  Call sites gate
+    on `faults.ENABLED` first so the disabled cost is a single branch."""
+    p = _plane
+    if p is not None:
+        p.fire(point, **ctx)
+
+
+__all__ = [
+    "ALL_POINTS",
+    "ENABLED",
+    "ERROR",
+    "FaultError",
+    "FaultPlane",
+    "FaultRule",
+    "HANG",
+    "KUBE_RECV",
+    "KUBE_SEND",
+    "LATENCY",
+    "TPU_COMPILE",
+    "TPU_DISPATCH",
+    "WATCH_DELIVER",
+    "WEBHOOK_ENQUEUE",
+    "fire",
+    "get_plane",
+    "install",
+    "uninstall",
+]

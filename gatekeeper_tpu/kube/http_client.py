@@ -49,6 +49,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from .. import faults
+from ..syncutil import Backoff
 from .inmem import GVK, Conflict, NotFound, WatchEvent, gvk_of, obj_key
 
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
@@ -205,6 +207,8 @@ class HttpKube:
 
     def _request(self, method: str, path: str,
                  body: Optional[dict] = None) -> Tuple[int, dict]:
+        if faults.ENABLED:
+            faults.fire(faults.KUBE_SEND, method=method, path=path)
         headers = {"Accept": "application/json"}
         tok = self._bearer()
         if tok:
@@ -231,6 +235,8 @@ class HttpKube:
                 # caller's semantic retry (RetryKube / apply loop) decide.
                 if attempt or (sent and method != "GET"):
                     raise
+        if faults.ENABLED:
+            faults.fire(faults.KUBE_RECV, method=method, path=path)
         try:
             doc = json.loads(data) if data else {}
         except ValueError:
@@ -499,32 +505,43 @@ class HttpWatcher:
 
     # -- producer side --
 
+    # Reconnect schedule: exponential from RECONNECT_BASE_S hard-capped at
+    # RECONNECT_CAP_S, with downward jitter so a fleet of watchers whose
+    # streams all died together (apiserver restart, network partition)
+    # desynchronizes instead of relisting in lockstep.
+    RECONNECT_BASE_S = 0.05
+    RECONNECT_CAP_S = 2.0
+    RECONNECT_JITTER = 0.5
+
     def _pump(self):
 
-        backoff = 0.05
+        backoff = Backoff(
+            base=self.RECONNECT_BASE_S, cap=self.RECONNECT_CAP_S,
+            jitter=self.RECONNECT_JITTER,
+        )
         while not self._stopped:
             try:
                 self._stream_once()
-                backoff = 0.05
+                backoff.reset()
             except Gone:
                 try:
                     self._relist()
-                    backoff = 0.05
+                    backoff.reset()
                 except Exception:
                     # relist failed too (server down / auth expired):
                     # back off so the pump doesn't spin on 410s
                     if self._stopped:
                         return
-                    time.sleep(backoff)
-                    backoff = min(backoff * 2, 2.0)
+                    time.sleep(backoff.next())
             except Exception:
                 if self._stopped:
                     return
-                time.sleep(backoff)
-                backoff = min(backoff * 2, 2.0)
+                time.sleep(backoff.next())
 
     def _stream_once(self):
         """One watch connection: stream events until the server ends it."""
+        if faults.ENABLED:
+            faults.fire(faults.KUBE_SEND, method="WATCH", path=str(self.gvk))
         k = self.kube
         path = k._path(self.gvk) + (
             f"?watch=1&resourceVersion={self._rv}&allowWatchBookmarks=true")

@@ -119,6 +119,27 @@ def build_parser() -> argparse.ArgumentParser:
                         "interpreter while compiling in the background")
     p.add_argument("--webhook-batch-window-ms", type=float, default=2.0,
                    help="micro-batching window for admission reviews")
+    # graceful degradation (docs/failure-modes.md)
+    p.add_argument("--admission-deadline-budget-ms", type=float, default=0.0,
+                   help="per-request admission deadline budget in ms; work "
+                        "past the budget yields an explicit fail-open/"
+                        "closed decision instead of a socket timeout "
+                        "(0 disables)")
+    p.add_argument("--admission-fail-open", action="store_true",
+                   help="on internal error or deadline exhaustion, ALLOW "
+                        "the request with an audit annotation instead of "
+                        "denying (default: fail closed)")
+    p.add_argument("--breaker-failure-threshold", type=int, default=3,
+                   help="consecutive TPU backend failures before the "
+                        "circuit breaker trips to the interpreter tier")
+    p.add_argument("--breaker-cooldown-s", type=float, default=5.0,
+                   help="seconds the tripped breaker waits before running "
+                        "half-open recovery probes")
+    p.add_argument("--fault-plane-seed", type=int, default=None,
+                   help="EXPLICITLY enable the fault-injection plane with "
+                        "this seed (testing only; add schedules via "
+                        "gatekeeper_tpu.faults).  Leave unset in "
+                        "production: the plane then costs one branch")
     # API-server selection (rest.InClusterConfig / kubeconfig in the
     # reference's manager construction, main.go:140-151)
     p.add_argument("--api-server", default="auto",
@@ -309,6 +330,15 @@ class App:
         self.operations = ops_mod.Operations(args.operation or None)
         self.reporters = Reporters()
 
+        if getattr(args, "fault_plane_seed", None) is not None:
+            from . import faults
+
+            faults.install(seed=args.fault_plane_seed)
+            log.warning(
+                "fault-injection plane ENABLED (seed=%d) — testing only",
+                args.fault_plane_seed,
+            )
+
         # evaluation backend behind the Driver seam
         if args.driver == "tpu":
             from .ops.driver import TpuDriver
@@ -317,7 +347,10 @@ class App:
             # to a background thread; evals serve from the interpreter
             # until the fused executable is warm (SURVEY §7 hard-part 3)
             driver = TpuDriver(
-                async_compile=not getattr(args, "sync_compile", False)
+                async_compile=not getattr(args, "sync_compile", False),
+                breaker_threshold=getattr(
+                    args, "breaker_failure_threshold", None),
+                breaker_cooldown_s=getattr(args, "breaker_cooldown_s", None),
             )
         else:
             driver = InterpDriver()
@@ -391,6 +424,13 @@ class App:
         self.tracker.run(self.kube)
         self.manager.start()
 
+        # degradation visibility: breaker state (TPU driver only) for the
+        # health endpoints and /statusz
+        breaker_fn = getattr(self.client.driver, "breaker_status", None)
+        health_status = (
+            (lambda: {"tpu_breaker": breaker_fn()}) if breaker_fn else None
+        )
+
         if self.operations.is_assigned(ops_mod.WEBHOOK):
             self.micro_batcher = MicroBatcher(
                 self.client, window_s=args.webhook_batch_window_ms / 1000.0
@@ -409,7 +449,9 @@ class App:
                 event_recorder=make_event_recorder(
                     self.kube, "gatekeeper-webhook"
                 ),
+                fail_open=getattr(args, "admission_fail_open", False),
             )
+            budget_ms = getattr(args, "admission_deadline_budget_ms", 0.0)
             self.webhook_server = WebhookServer(
                 handler,
                 NamespaceLabelHandler(args.exempt_namespace),
@@ -417,6 +459,8 @@ class App:
                 certfile=certfile,
                 keyfile=keyfile,
                 readiness_check=self.tracker.satisfied,
+                deadline_budget_s=(budget_ms / 1000.0) or None,
+                health_status=health_status,
             )
             self.webhook_server.start()
         else:

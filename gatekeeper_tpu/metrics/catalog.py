@@ -68,6 +68,29 @@ INTENDED_GVK_M = Measure(
     "watch_manager_intended_watch_gvk",
     "Total number of GroupVersionKinds with a registered watch intent",
 )
+# ---- robustness additions (fault plane / breaker / audit health) -----------
+AUDIT_STATUS_M = Measure(
+    "audit_last_run_status",
+    "Whether the most recent audit run succeeded (1) or failed (0)",
+)
+AUDIT_FAILS_M = Measure(
+    "audit_consecutive_failures",
+    "Consecutive audit runs that have failed since the last success",
+)
+BREAKER_STATE_M = Measure(
+    "tpu_breaker_state",
+    "TPU circuit breaker state (0 closed, 1 half-open, 2 open)",
+)
+BREAKER_TRIPS_M = Measure(
+    "tpu_breaker_trips",
+    "Cumulative TPU circuit breaker trips (closed -> open transitions)",
+)
+BREAKER_DEGRADED_M = Measure(
+    "tpu_breaker_degraded_seconds",
+    "Cumulative seconds spent with the TPU breaker not closed "
+    "(evaluation served by the interpreter tier)",
+    unit="s",
+)
 
 # bucket boundaries copied from the reference's view.Distribution calls
 _INGEST_BUCKETS = (
@@ -117,6 +140,12 @@ def catalog_views():
         View("watch_manager_watched_gvk", WATCHED_GVK_M, AGG_LAST_VALUE),
         View("watch_manager_intended_watch_gvk", INTENDED_GVK_M,
              AGG_LAST_VALUE),
+        View("audit_last_run_status", AUDIT_STATUS_M, AGG_LAST_VALUE),
+        View("audit_consecutive_failures", AUDIT_FAILS_M, AGG_LAST_VALUE),
+        View("tpu_breaker_state", BREAKER_STATE_M, AGG_LAST_VALUE),
+        View("tpu_breaker_trips", BREAKER_TRIPS_M, AGG_LAST_VALUE),
+        View("tpu_breaker_degraded_seconds", BREAKER_DEGRADED_M,
+             AGG_LAST_VALUE),
     ]
 
 
@@ -165,6 +194,12 @@ class Reporters:
         )
 
     # -- audit ----------------------------------------------------------------
+    def report_audit_status(self, ok: bool, consecutive_failures: int):
+        """Last-run status + consecutive-failure gauge: a silently failing
+        audit loop (bare except around audit_once) becomes observable."""
+        self.registry.record(AUDIT_STATUS_M, 1.0 if ok else 0.0)
+        self.registry.record(AUDIT_FAILS_M, float(consecutive_failures))
+
     def report_total_violations(self, enforcement_action: str, count: int):
         self.registry.record(
             VIOLATIONS_M, float(count),
@@ -204,3 +239,22 @@ class Reporters:
     def report_gvk_count(self, watched: int, intended: int):
         self.registry.record(WATCHED_GVK_M, float(watched))
         self.registry.record(INTENDED_GVK_M, float(intended))
+
+    # -- TPU circuit breaker --------------------------------------------------
+    def report_breaker(self, status: dict):
+        """Record a CircuitBreaker.status() snapshot."""
+        record_breaker(status, self.registry)
+
+
+def record_breaker(status: dict, registry: Optional[Registry] = None):
+    """Record a breaker status snapshot against a registry (the global one
+    by default).  The driver calls this from its transition hook without
+    holding a Reporters instance; views are (idempotently) registered
+    first so the rows exist wherever the snapshot lands."""
+    registry = registry or global_registry()
+    register_catalog(registry)
+    registry.record(BREAKER_STATE_M, float(status.get("state_code", 0)))
+    registry.record(BREAKER_TRIPS_M, float(status.get("trips", 0)))
+    registry.record(
+        BREAKER_DEGRADED_M, float(status.get("degraded_seconds", 0.0))
+    )

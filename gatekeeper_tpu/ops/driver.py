@@ -15,6 +15,7 @@ throughput does.  Templates with no vectorized program get all-true columns
 from __future__ import annotations
 
 import copy
+import logging
 import os
 from typing import Dict, List, Optional, Tuple
 
@@ -22,6 +23,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import deadline as _deadline
+from .. import faults
 from ..client.drivers import CompiledTemplate, InterpDriver, Result
 from ..target.match import constraint_matches, needs_autoreject
 from ..target.target import K8sValidationTarget
@@ -32,6 +35,8 @@ from .pack import _bucket as _bucket_pow2, pack_constraints, pack_reviews
 from .params import pack_params
 from .vectorizer import vectorize
 from .vexpr import EvalEnv, VProgram, eval_program
+
+log = logging.getLogger("gatekeeper_tpu.driver")
 
 
 def _tree_sig(tree):
@@ -146,6 +151,8 @@ class TpuDriver(InterpDriver):
         self,
         target: Optional[K8sValidationTarget] = None,
         async_compile: Optional[bool] = None,
+        breaker_threshold: Optional[int] = None,
+        breaker_cooldown_s: Optional[float] = None,
     ):
         super().__init__(target)
         # eager native build/load: the g++ compile must happen here, not
@@ -263,12 +270,81 @@ class TpuDriver(InterpDriver):
             from .asynccompile import AsyncCompiler
 
             self._compiler = AsyncCompiler(self)
+        # circuit breaker over the device compile/dispatch seams: after N
+        # consecutive backend failures every evaluation trips to the
+        # inherited interpreter tier (semantically identical — the device
+        # mask only ever prunes the interpreter walk); a background probe
+        # re-tries a tiny real dispatch and one success returns evaluation
+        # to the device (ops/breaker.py, docs/failure-modes.md)
+        from .breaker import CircuitBreaker
+
+        if breaker_threshold is None:
+            breaker_threshold = int(os.environ.get("GK_BREAKER_THRESHOLD", "3"))
+        if breaker_cooldown_s is None:
+            breaker_cooldown_s = float(
+                os.environ.get("GK_BREAKER_COOLDOWN_S", "5.0")
+            )
+        self.breaker = CircuitBreaker(
+            failure_threshold=breaker_threshold,
+            cooldown_s=breaker_cooldown_s,
+            probe_fn=self._breaker_probe,
+            on_transition=self._on_breaker_transition,
+        )
 
     # ---- lifecycle --------------------------------------------------------
 
     def _epoch_bumped(self):
         if self._compiler is not None:
             self._compiler.kick()
+
+    # ---- circuit breaker ---------------------------------------------------
+
+    # minimal synthetic review the recovery probe dispatches: exercises the
+    # real compile + dispatch path without depending on installed templates
+    _PROBE_REVIEW = {
+        "kind": {"group": "", "version": "v1", "kind": "Pod"},
+        "name": "gk-breaker-probe", "namespace": "default",
+        "operation": "CREATE",
+        "object": {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "gk-breaker-probe",
+                         "namespace": "default", "labels": {}},
+            "spec": {"containers": [{"name": "c", "image": "probe.io/x:1"}]},
+        },
+    }
+
+    def _breaker_probe(self):
+        """One real device round trip (half-open recovery).  Runs the same
+        compile/dispatch seams production traffic does — including any
+        installed fault-plane schedule — so the breaker closes exactly when
+        the backend actually answers again."""
+        with self._lock:
+            n = sum(len(v) for v in self.constraints.values())
+            if n == 0:
+                # nothing to evaluate: the compile seam is the best probe
+                self._fused_fn()
+                return
+            self.compute_masks([copy.deepcopy(self._PROBE_REVIEW)])
+
+    def _on_breaker_transition(self, old: str, new: str):
+        # also invoked with old == new by the probe loop as a periodic
+        # metrics refresh while degraded — record always, log on change
+        if old != new:
+            log.warning(
+                "tpu circuit breaker %s -> %s%s", old, new,
+                " (serving from the interpreter tier)"
+                if new != "closed" else "",
+            )
+        try:
+            from ..metrics.catalog import record_breaker
+
+            record_breaker(self.breaker.status())
+        except Exception:
+            pass
+
+    def breaker_status(self) -> dict:
+        """Health-endpoint view of the degradation ladder."""
+        return self.breaker.status()
 
     # review-memo entry bound: each entry retains a frozen admission object
     # (~KBs); 16k entries keeps worst-case memory in the tens of MB and a
@@ -553,6 +629,8 @@ class TpuDriver(InterpDriver):
         sig = self._structure_sig(side)
         if self._fused is not None and self._fused_key == sig:
             return self._fused, side
+        if faults.ENABLED:
+            faults.fire(faults.TPU_COMPILE)
         _ordered, _cp, groups, _col_specs, _crow = side
         static = [(prog, start, B) for prog, start, B, _packed in groups]
 
@@ -641,6 +719,8 @@ class TpuDriver(InterpDriver):
         the driver lock.  The async compile thread dispatches UNLOCKED, so
         reading self._cs_epoch here could key stale constraint arrays under
         a newer epoch (advisor r2); callers that hold the lock may omit it."""
+        if faults.ENABLED:
+            faults.fire(faults.TPU_DISPATCH)
         from .aotcache import aot_jit
 
         mesh = self._mesh()
@@ -1310,6 +1390,15 @@ class TpuDriver(InterpDriver):
             # reviews serve from the host paths instead of blocking
             self._compiler is not None
             and not self._compiler.ready()
+        ) or (
+            # circuit breaker: while open, every evaluation serves from
+            # the host tiers below — the degradation ladder's middle rung
+            # (docs/failure-modes.md); the background probe brings the
+            # device back without real traffic paying failed dispatches.
+            # Checked LAST so a granted half-open trial is always followed
+            # by the device attempt below (which records its outcome) —
+            # an earlier divert would leak the trial token
+            not self.breaker.allow()
         ):
             if tracing:
                 return [
@@ -1327,33 +1416,82 @@ class TpuDriver(InterpDriver):
                 for i, r in enumerate(reviews)
             ]
         with self._lock:
-            ordered, mask, autoreject = self.compute_masks(reviews)
-            inventory = self._inventory_for_render()
-            mask_np = np.asarray(mask)
-            rej_np = np.asarray(autoreject)
-            if tracing:
-                return self._review_batch_traced(
+            try:
+                ordered, mask, autoreject = self.compute_masks(reviews)
+            except Exception as e:
+                # backend failure: feed the breaker and degrade THIS batch
+                # to the interpreter tier instead of poisoning the whole
+                # window — callers always get an answer or a deadline.
+                # Only the flagging happens under the lock; the fallback
+                # walk below runs OUTSIDE it (per-review locking, like the
+                # normal interp divert path) so concurrent ingest and the
+                # audit thread don't stall behind a failed batch's render
+                self.breaker.record_failure(e)
+                log.warning(
+                    "device evaluation failed (%s: %s); serving %d "
+                    "review(s) from the interpreter tier",
+                    type(e).__name__, e, len(reviews),
+                )
+                device_failed = True
+            else:
+                device_failed = False
+                self.breaker.record_success()
+            if not device_failed:
+                inventory = self._inventory_for_render()
+                mask_np = np.asarray(mask)
+                rej_np = np.asarray(autoreject)
+                if tracing:
+                    return self._review_batch_traced(
+                        reviews, ordered, mask_np, rej_np, inventory
+                    )
+                out = self._render_masked(
                     reviews, ordered, mask_np, rej_np, inventory
                 )
-            out = self._render_masked(
-                reviews, ordered, mask_np, rej_np, inventory
+                # admission-sized batches feed the request memo from the
+                # device path too, so repeat content (replica/retry
+                # storms — including repeat ALLOWS, the common case)
+                # replays at memo speed next time; the 1M-review
+                # streaming path (large chunks) never reaches here
+                # (review_batch routes them straight to
+                # _review_batch_eval)
+                if (
+                    len(reviews) <= self.REQUEST_MEMO_BATCH_MAX
+                    and self._memoable_synced()
+                ):
+                    for ri, review in enumerate(reviews):
+                        mk = memo_reviews[ri] if memo_reviews else None
+                        self._store_request_memo(
+                            review, out[ri][0], mk[1] if mk else None,
+                        )
+                return out
+        # device failed: interpreter-tier fallback, lock released.
+        # The budget check covers SAME-THREAD callers (embedders using
+        # deadline.budget() around client.review); webhook traffic is
+        # bounded upstream — the micro-batcher's event-wait timeout and
+        # its per-request fallback deadline checks (webhook/server.py),
+        # since the batcher thread does not carry the handler thread's
+        # deadline ContextVar
+        if _deadline.expired():
+            raise _deadline.DeadlineExceeded(
+                "deadline exhausted during device-failure fallback"
             )
-            # admission-sized batches feed the request memo from the
-            # device path too, so repeat content (replica/retry storms —
-            # including repeat ALLOWS, the common case) replays at memo
-            # speed next time; the 1M-review streaming path (large
-            # chunks) never reaches here (review_batch routes them
-            # straight to _review_batch_eval)
-            if (
-                len(reviews) <= self.REQUEST_MEMO_BATCH_MAX
-                and self._memoable_synced()
-            ):
-                for ri, review in enumerate(reviews):
-                    mk = memo_reviews[ri] if memo_reviews else None
-                    self._store_request_memo(
-                        review, out[ri][0], mk[1] if mk else None,
-                    )
+        if tracing:
+            # traced runs must still emit their trace lines
+            return [
+                InterpDriver.review(self, r, tracing=True) for r in reviews
+            ]
+        # prefer the vectorized numpy host tier (same preference order as
+        # the breaker-open divert above) — the degraded window is exactly
+        # when fallback latency matters most
+        out = self._np_review(reviews, memo_reviews)
+        if out is not None:
             return out
+        return [
+            self._interp_review_memo(
+                r, memo_reviews[i] if memo_reviews else None
+            )
+            for i, r in enumerate(reviews)
+        ]
 
     def _render_masked(self, reviews, ordered, mask_np, rej_np, inventory):
         """Sparse render shared by the device and host (numpy) mask paths:
@@ -1766,6 +1904,8 @@ class TpuDriver(InterpDriver):
                 return self._audit_cache[1]
         import time as _time
 
+        if faults.ENABLED:
+            faults.fire(faults.TPU_DISPATCH, path="audit")
         t0 = _time.perf_counter()
         fn, ordered, cp, group_params, crow = self._audit_inputs(K)
         ap = self._audit_pack
@@ -1899,6 +2039,32 @@ class TpuDriver(InterpDriver):
         return reviews, ordered, host
 
     def audit(self, tracing: bool = False):
+        if not self.breaker.allow():
+            # breaker open: the inherited interpreter sweep is slower but
+            # always answers — the audit loop must not die with the device
+            return InterpDriver.audit(self, tracing=tracing)
+        self.last_sweep_stats = {}  # stale stats must not decide `cached`
+        try:
+            out = self._audit_device(tracing)
+        except Exception as e:
+            self.breaker.record_failure(e)
+            log.warning(
+                "device audit failed (%s: %s); serving from the "
+                "interpreter tier", type(e).__name__, e,
+            )
+            return InterpDriver.audit(self, tracing=tracing)
+        # only a sweep that actually dispatched resets the breaker's
+        # failure streak: a cache-served sweep (cached=1.0) or an
+        # empty-inventory sweep (stats left empty — cleared before the
+        # call) never contacted the device, and in a quiet cluster either
+        # would otherwise keep a failing device's breaker from tripping
+        # while admission traffic pays failed dispatches
+        stats = self.last_sweep_stats
+        if stats and not stats.get("cached"):
+            self.breaker.record_success()
+        return out
+
+    def _audit_device(self, tracing: bool = False):
         from ..engine.value import freeze
 
         # audit is the throughput path: prefer waiting for the background
@@ -2178,10 +2344,30 @@ class TpuDriver(InterpDriver):
         or the cap was hit but the program is provably count-exact
         (_count_exact); "resources" when the cap cut rendering short and
         the count is device-candidate resources, an over-approximation."""
-        from .deltasweep import NeedsFullSweep
-
         if cap is None or cap <= 0:
             return InterpDriver.audit_capped(self, cap or 0, tracing=tracing)
+        if not self.breaker.allow():
+            return InterpDriver.audit_capped(self, cap, tracing=tracing)
+        self.last_sweep_stats = {}  # stale stats must not decide `cached`
+        try:
+            out = self._audit_capped_device(cap, tracing)
+        except Exception as e:
+            self.breaker.record_failure(e)
+            log.warning(
+                "device capped audit failed (%s: %s); serving from the "
+                "interpreter tier", type(e).__name__, e,
+            )
+            return InterpDriver.audit_capped(self, cap, tracing=tracing)
+        # see audit(): only a sweep that actually dispatched counts as a
+        # breaker success (cache-served and empty-inventory sweeps don't)
+        stats = self.last_sweep_stats
+        if stats and not stats.get("cached"):
+            self.breaker.record_success()
+        return out
+
+    def _audit_capped_device(self, cap: int, tracing: bool = False):
+        from .deltasweep import NeedsFullSweep
+
         self._wait_ready_for_audit()
         with self._lock:
             K = self._audit_topk(cap)

@@ -30,6 +30,49 @@ class SyncBool:
             self._value = value
 
 
+class Backoff:
+    """Capped exponential backoff with deterministic-seedable jitter.
+
+    The nominal schedule is base * factor^k hard-capped at `cap`; each
+    interval is then jittered DOWNWARD into [nominal * (1 - jitter),
+    nominal], so the cap stays a hard upper bound while a fleet of
+    reconnecting watchers desynchronizes instead of storming the API
+    server in lockstep.  Pass a seeded `random.Random` for reproducible
+    schedules (the chaos suite does)."""
+
+    def __init__(
+        self,
+        base: float = 0.05,
+        factor: float = 2.0,
+        cap: float = 2.0,
+        jitter: float = 0.5,
+        rng: Optional[random.Random] = None,
+    ):
+        if base <= 0 or factor < 1.0 or cap < base:
+            raise ValueError("backoff requires base > 0, factor >= 1, "
+                             "cap >= base")
+        if not 0.0 <= jitter < 1.0:
+            raise ValueError("jitter must be in [0, 1)")
+        self.base = base
+        self.factor = factor
+        self.cap = cap
+        self.jitter = jitter
+        self._rng = rng or random.Random()
+        self._cur = base
+
+    def next(self) -> float:
+        """The next sleep interval; advances the schedule."""
+        nominal = min(self._cur, self.cap)
+        self._cur = min(self._cur * self.factor, self.cap)
+        if self.jitter:
+            nominal -= nominal * self.jitter * self._rng.random()
+        return nominal
+
+    def reset(self):
+        """Back to the base interval (call after a successful attempt)."""
+        self._cur = self.base
+
+
 def backoff_intervals(
     initial: float = 1.0,
     factor: float = 2.0,

@@ -22,6 +22,7 @@ import queue
 import threading
 from typing import Callable, Dict, Optional, Set, Tuple
 
+from .. import faults
 from ..kube.inmem import InMemoryKube, WatchEvent
 from .set import GVKSet
 
@@ -171,6 +172,11 @@ class _Pump(threading.Thread):
                 return
             if ev is None:
                 continue
+            if faults.ENABLED:
+                try:
+                    faults.fire(faults.WATCH_DELIVER, gvk=self.gvk)
+                except Exception:
+                    continue  # injected delivery drop; the pump survives
             self.manager._fan_out(self.gvk, ev)
 
     def stop(self):

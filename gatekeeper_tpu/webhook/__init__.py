@@ -11,10 +11,11 @@ from .policy import (
     SERVICE_ACCOUNT_NAME,
 )
 from .namespacelabel import IGNORE_LABEL, NamespaceLabelHandler
-from .server import MicroBatcher, WebhookServer
+from .server import BatcherStopped, MicroBatcher, WebhookServer
 
 __all__ = [
     "AdmissionResponse",
+    "BatcherStopped",
     "IGNORE_LABEL",
     "MicroBatcher",
     "NamespaceLabelHandler",

@@ -18,10 +18,11 @@ Order of checks, matching the reference Handle:
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Callable, List, Optional
 
 from .. import logging as gklog
+from ..deadline import DeadlineExceeded
 from ..apis.config import CONFIG_NAME, GVK as CONFIG_GVK, parse_config
 from ..kube.inmem import InMemoryKube, NotFound
 from ..process.excluder import WEBHOOK, Excluder
@@ -45,6 +46,14 @@ RESPONSE_DENY = "deny"
 RESPONSE_ERROR = "error"
 RESPONSE_UNKNOWN = "unknown"
 
+# fixed messages/annotations for the explicit failure decisions so the
+# AdmissionReview JSON is exact and testable (tests/test_webhook.py)
+DEADLINE_MESSAGE = "admission deadline budget exhausted"
+DEADLINE_CODE = 504
+FAIL_OPEN_ANNOTATION = "admission.gatekeeper.sh/fail-open"
+FAIL_OPEN_DEADLINE = "deadline-exhausted"
+FAIL_OPEN_INTERNAL = "internal-error"
+
 log = gklog.get("webhook")
 
 
@@ -58,11 +67,18 @@ class AdmissionResponse:
     allowed: bool
     message: str = ""
     code: int = 200
+    # auditAnnotations: the fail-open path allows the request but stamps
+    # WHY into the audit log (admissionreview v1 auditAnnotations field),
+    # so a degraded webhook leaves a forensic trail instead of silently
+    # admitting
+    annotations: Optional[dict] = field(default=None)
 
     def to_dict(self, uid: str = "") -> dict:
         out = {"uid": uid, "allowed": self.allowed}
         if self.message or not self.allowed:
             out["status"] = {"message": self.message, "code": self.code}
+        if self.annotations:
+            out["auditAnnotations"] = dict(self.annotations)
         return out
 
 
@@ -87,6 +103,7 @@ class ValidationHandler:
         disable_enforcementaction_validation: bool = False,
         event_recorder: Optional[Callable[[dict], None]] = None,
         injected_config: Optional[dict] = None,
+        fail_open: bool = False,
     ):
         self.client = client
         self.kube = kube
@@ -100,6 +117,12 @@ class ValidationHandler:
         )
         self.event_recorder = event_recorder
         self.injected_config = injected_config
+        # failure policy for internal errors and deadline exhaustion:
+        # fail_open=True allows the request with an audit annotation
+        # (availability over enforcement); the default denies (fail
+        # closed).  Either way the decision is EXPLICIT — the caller gets
+        # a well-formed AdmissionReview, never a hung socket.
+        self.fail_open = fail_open
         self.service_account = (
             f"system:serviceaccount:{get_namespace()}:{SERVICE_ACCOUNT_NAME}"
         )
@@ -148,10 +171,21 @@ class ValidationHandler:
                 log.warning("error executing query: %s", e)
                 status = RESPONSE_ERROR
                 return _denied(str(e), 500)
+            except DeadlineExceeded:
+                # budget exhausted: explicit, policy-selected decision —
+                # the apiserver gets a well-formed AdmissionReview inside
+                # its own timeout instead of a hung socket
+                log.warning("admission deadline budget exhausted")
+                status = RESPONSE_ERROR
+                return self._failure_response(
+                    DEADLINE_MESSAGE, DEADLINE_CODE, FAIL_OPEN_DEADLINE
+                )
             except Exception as e:  # error executing query -> 500
                 log.exception("error executing query")
                 status = RESPONSE_ERROR
-                return _denied(str(e), 500)
+                return self._failure_response(
+                    str(e), 500, FAIL_OPEN_INTERNAL
+                )
             msgs = self._get_deny_messages(results, req)
             if msgs:
                 status = RESPONSE_DENY
@@ -163,6 +197,18 @@ class ValidationHandler:
                 self.reporter.report_request(status, time.monotonic() - t0)
 
     # ---- pieces ------------------------------------------------------------
+
+    def _failure_response(self, msg: str, code: int,
+                          reason: str) -> AdmissionResponse:
+        """The explicit degraded-path decision: deny (fail closed,
+        default) or allow with an audit annotation recording why
+        (fail open).  docs/failure-modes.md describes the ladder."""
+        if self.fail_open:
+            return AdmissionResponse(
+                True, msg, 200,
+                annotations={FAIL_OPEN_ANNOTATION: reason},
+            )
+        return _denied(msg, code)
 
     def _is_gk_service_account(self, req: dict) -> bool:
         user = (req.get("userInfo") or {}).get("username", "")

@@ -13,9 +13,12 @@ from __future__ import annotations
 import json
 import ssl
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import List, Optional
+from typing import Callable, List, Optional
 
+from .. import deadline as _deadline
+from .. import faults
 from .. import logging as gklog
 from .namespacelabel import NamespaceLabelHandler
 from .policy import AdmissionResponse, ValidationHandler
@@ -23,14 +26,20 @@ from .policy import AdmissionResponse, ValidationHandler
 log = gklog.get("webhook.server")
 
 
-class _Pending:
-    __slots__ = ("obj", "event", "result", "error")
+class BatcherStopped(RuntimeError):
+    """Raised to requests enqueued on (or pending across) a stopped
+    MicroBatcher — they must fail fast, not wait on an event forever."""
 
-    def __init__(self, obj):
+
+class _Pending:
+    __slots__ = ("obj", "event", "result", "error", "deadline")
+
+    def __init__(self, obj, deadline: Optional[float] = None):
         self.obj = obj
         self.event = threading.Event()
         self.result = None
         self.error: Optional[Exception] = None
+        self.deadline = deadline  # absolute monotonic, or None
 
 
 class MicroBatcher:
@@ -64,31 +73,54 @@ class MicroBatcher:
         return getattr(self._client, name)
 
     def review(self, obj, tracing: bool = False):
+        if faults.ENABLED:
+            faults.fire(faults.WEBHOOK_ENQUEUE)
         if tracing:
             # traced requests are rare and want their own trace output;
             # bypass the batch
             return self._client.review(obj, tracing=True)
+        dl = _deadline.current()
+        if dl is not None and time.monotonic() > dl:
+            # refuse to enqueue work that can no longer finish in budget
+            raise _deadline.DeadlineExceeded(
+                "admission deadline budget exhausted before evaluation"
+            )
         # idle fast path: with nothing else in flight, evaluate on the
         # caller's thread — two scheduler handoffs per request otherwise
         # put milliseconds of wakeup jitter into the sparse-traffic p99.
         # The lock bounds inline evaluation to one caller; arrivals during
         # an in-flight batch (_busy) queue instead, so they join the next
         # coalesced dispatch rather than blocking solo on the driver lock.
+        # Deadline-carrying requests always queue: an inline evaluation on
+        # the caller's thread cannot be interrupted, so a wedged backend
+        # would hold the request past any budget — the queued path's
+        # event wait is what bounds time-to-answer (docs/failure-modes.md).
         if (
-            not self._pending
+            dl is None
+            and not self._stop  # stopped batcher: fall through and reject
+            and not self._pending
             and not self._busy
             and self._inline.acquire(blocking=False)
         ):
             try:
-                if not self._pending and not self._busy:
+                if not self._pending and not self._busy and not self._stop:
                     return self._client.review(obj)
             finally:
                 self._inline.release()
-        p = _Pending(obj)
+        p = _Pending(obj, deadline=dl)
         with self._cv:
+            if self._stop:
+                # enqueues after stop() must fail fast, never wait on an
+                # event no batch loop will ever set
+                raise BatcherStopped("webhook batcher is stopped")
             self._pending.append(p)
             self._cv.notify()
-        p.event.wait()
+        if dl is None:
+            p.event.wait()
+        elif not p.event.wait(timeout=max(0.0, dl - time.monotonic())):
+            raise _deadline.DeadlineExceeded(
+                "admission deadline budget exhausted"
+            )
         if p.error is not None:
             raise p.error
         return p.result
@@ -122,15 +154,45 @@ class MicroBatcher:
                 self._pending = self._pending[self.max_batch:]
                 last_batch_size = len(batch)
                 self._busy = True
-            try:
-                responses = self._client.review_batch([p.obj for p in batch])
-                for p, resp in zip(batch, responses):
-                    p.result = resp
+            # refuse past-deadline work before paying a dispatch for it:
+            # the waiter has already (or will imminently) time out, and
+            # evaluating its review is pure wasted device time
+            now = _time.monotonic()
+            live = []
+            for p in batch:
+                if p.deadline is not None and now > p.deadline:
+                    p.error = _deadline.DeadlineExceeded(
+                        "admission deadline budget exhausted in queue"
+                    )
                     p.event.set()
+                else:
+                    live.append(p)
+            batch = live
+            try:
+                if batch:
+                    responses = self._client.review_batch(
+                        [p.obj for p in batch]
+                    )
+                    for p, resp in zip(batch, responses):
+                        p.result = resp
+                        p.event.set()
             except Exception:
                 # batched failure: fall back to per-request evaluation so one
-                # poisoned review can't fail the whole window
+                # poisoned review can't fail the whole window — but check
+                # each request's remaining budget first; a request whose
+                # deadline lapsed during the failed dispatch gets an
+                # explicit deadline error, not another evaluation
                 for p in batch:
+                    if (
+                        p.deadline is not None
+                        and _time.monotonic() > p.deadline
+                    ):
+                        p.error = _deadline.DeadlineExceeded(
+                            "admission deadline budget exhausted during "
+                            "per-request fallback"
+                        )
+                        p.event.set()
+                        continue
                     try:
                         p.result = self._client.review(p.obj)
                     except Exception as e:
@@ -141,8 +203,18 @@ class MicroBatcher:
                 last_dispatch_end = _time.monotonic()
 
     def stop(self):
+        # drain under the cv lock: a request appended concurrently either
+        # lands before the drain (gets BatcherStopped here) or after _stop
+        # is set (review() rejects it) — no pending can be left waiting on
+        # an event forever (the shutdown race this replaces)
         with self._cv:
             self._stop = True
+            drained, self._pending = self._pending, []
+            for p in drained:
+                p.error = BatcherStopped(
+                    "webhook batcher stopped before evaluation"
+                )
+                p.event.set()
             self._cv.notify_all()
         self._thread.join(timeout=2.0)
 
@@ -158,6 +230,8 @@ class WebhookServer:
         certfile: Optional[str] = None,
         keyfile: Optional[str] = None,
         readiness_check=None,  # callable -> bool (tracker.satisfied)
+        deadline_budget_s: Optional[float] = None,
+        health_status: Optional[Callable[[], dict]] = None,
     ):
         self.validation_handler = validation_handler
         self.label_handler = label_handler or NamespaceLabelHandler()
@@ -165,10 +239,29 @@ class WebhookServer:
         self.certfile = certfile
         self.keyfile = keyfile
         self.readiness_check = readiness_check
+        # per-request deadline budget: every admission request entering
+        # this server carries monotonic_now + budget as its deadline; the
+        # batching client and driver fallbacks refuse work past it, and
+        # the handler converts exhaustion into an explicit fail-open or
+        # fail-closed decision (never a socket timeout)
+        self.deadline_budget_s = deadline_budget_s
+        # degradation visibility: a callable returning a status dict
+        # (e.g. {"tpu_breaker": driver.breaker_status()}) surfaced on
+        # /healthz (degraded marker) and /statusz (full JSON)
+        self.health_status = health_status
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._ssl_context: Optional[ssl.SSLContext] = None
         self._stopping = False
+
+    def _status_snapshot(self) -> Optional[dict]:
+        if self.health_status is None:
+            return None
+        try:
+            return self.health_status()
+        except Exception:
+            log.exception("health status callable failed")
+            return None
 
     def reload_certs(self, certfile: str, keyfile: str):
         """Hot-swap the serving cert: new handshakes pick up the reloaded
@@ -219,7 +312,23 @@ class WebhookServer:
                     return
                 # healthz/readyz (reference main.go:193-196)
                 if self.path == "/healthz":
-                    self._send_text(200, "ok")
+                    body = "ok"
+                    st = outer._status_snapshot()
+                    if st and any(
+                        isinstance(v, dict)
+                        and v.get("state") not in (None, "closed")
+                        for v in st.values()
+                    ):
+                        # degraded-but-serving is still healthy: the
+                        # interpreter tier answers while the breaker is
+                        # open, so the pod must NOT be restarted — the
+                        # marker makes the state visible to probes/humans
+                        body = "ok (degraded)"
+                    self._send_text(200, body)
+                elif self.path == "/statusz":
+                    # machine-readable degradation ladder state (breaker
+                    # state machine, trip counts, time degraded)
+                    self._send_json(200, outer._status_snapshot() or {})
                 elif self.path == "/readyz":
                     ready = (
                         outer.readiness_check() if outer.readiness_check else True
@@ -325,6 +434,9 @@ class WebhookServer:
                 if self.path not in ("/v1/admit", "/v1/admitlabel"):
                     self._send_text(404, "not found")
                     return
+                token = None
+                if outer.deadline_budget_s:
+                    token = _deadline.push(outer.deadline_budget_s)
                 try:
                     review = json.loads(body or b"{}")
                     req = review.get("request") or {}
@@ -336,6 +448,9 @@ class WebhookServer:
                     log.exception("bad admission request")
                     resp = AdmissionResponse(False, str(e), 500)
                     req = {}
+                finally:
+                    if token is not None:
+                        _deadline.pop(token)
                 self._send_json(
                     200,
                     {

@@ -43,3 +43,42 @@ def reference_available() -> bool:
 from gatekeeper_tpu.ops.driver import TpuDriver  # noqa: E402
 
 TpuDriver.DELTA_MASK_WAIT_S = 300.0
+
+# ---- chaos hygiene: no test may leak live fault-plane state or threads -----
+
+import threading  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _no_fault_or_thread_leaks():
+    """Fail any test that leaves the process-global fault plane enabled or
+    leaks a non-daemon thread.  A leaked plane would inject faults into
+    every later test (order-dependent carnage); a leaked non-daemon thread
+    would hang the pytest process at exit.  The plane is force-uninstalled
+    before failing so the rest of the session stays clean."""
+    from gatekeeper_tpu import faults
+
+    baseline = {t for t in threading.enumerate() if not t.daemon}
+    yield
+    leaked_plane = faults.ENABLED
+    if leaked_plane:
+        faults.uninstall()  # contain the damage before reporting it
+    stragglers = [
+        t for t in threading.enumerate()
+        if not t.daemon and t.is_alive() and t not in baseline
+    ]
+    for t in stragglers:  # short grace: threads mid-teardown may finish
+        t.join(timeout=1.0)
+    stragglers = [t for t in stragglers if t.is_alive()]
+    if leaked_plane:
+        pytest.fail(
+            "test leaked an enabled fault plane — call faults.uninstall() "
+            "(or use the chaos suite's fault_plane fixture)"
+        )
+    if stragglers:
+        pytest.fail(
+            "test leaked non-daemon threads: "
+            + ", ".join(t.name for t in stragglers)
+        )

@@ -1,0 +1,623 @@
+"""Chaos suite: webhook + audit + watch driven under seeded fault
+schedules (gatekeeper_tpu/faults/), asserting the degradation ladder of
+docs/failure-modes.md:
+
+  - the TPU circuit breaker trips after N injected dispatch failures,
+    serves interpreter-identical verdicts while open, and returns to the
+    device after recovery probes succeed
+  - no admission request exceeds its deadline budget by more than one
+    batch window under injected hangs — exhaustion is an explicit
+    fail-open/closed decision, never a socket timeout
+  - the audit loop survives a full kube outage (every HTTP send fails)
+    and resumes, with the failure streak visible in metrics
+  - the watch pump survives injected delivery faults
+
+Everything is deterministic: fixed seeds, probability-1/count-limited
+schedules, and bounded waits (hangs are plane-released).  The suite runs
+inside the tier-1 `-m 'not slow'` selection; the conftest leak fixture
+fails any test that leaves the plane enabled.
+"""
+
+import json
+import queue
+import threading
+import time
+
+import pytest
+
+from gatekeeper_tpu import deadline, faults
+from gatekeeper_tpu.audit import AuditManager
+from gatekeeper_tpu.client.client import Client
+from gatekeeper_tpu.deadline import DeadlineExceeded
+from gatekeeper_tpu.faults import FaultError, FaultPlane, FaultRule
+from gatekeeper_tpu.kube.apiserver import KubeApiServer
+from gatekeeper_tpu.kube.http_client import HttpKube
+from gatekeeper_tpu.kube.inmem import InMemoryKube
+from gatekeeper_tpu.metrics import Reporters
+from gatekeeper_tpu.metrics.views import Registry
+from gatekeeper_tpu.ops.breaker import CLOSED, OPEN
+from gatekeeper_tpu.ops.driver import TpuDriver
+from gatekeeper_tpu.target.target import AugmentedReview
+from gatekeeper_tpu.watch.manager import WatchManager
+from gatekeeper_tpu.webhook import BatcherStopped, MicroBatcher
+
+from .test_controllers import CONSTRAINT, TEMPLATE
+
+pytestmark = pytest.mark.chaos
+
+SEED = 1234
+PROBE_NAME = "gk-breaker-probe"
+
+
+@pytest.fixture()
+def fault_plane():
+    plane = faults.install(seed=SEED)
+    yield plane
+    faults.uninstall()
+
+
+def ns_review(name, labels=None):
+    return {
+        "uid": f"uid-{name}",
+        "kind": {"group": "", "version": "v1", "kind": "Namespace"},
+        "name": name,
+        "namespace": "",
+        "operation": "CREATE",
+        "userInfo": {"username": "alice"},
+        "object": {
+            "apiVersion": "v1",
+            "kind": "Namespace",
+            "metadata": {"name": name, "labels": labels or {}},
+        },
+    }
+
+
+def review_sig(responses):
+    return sorted((r.msg, r.enforcement_action) for r in responses.results())
+
+
+def tpu_client(threshold=3, cooldown=0.05):
+    driver = TpuDriver(
+        breaker_threshold=threshold, breaker_cooldown_s=cooldown
+    )
+    driver.DEVICE_MIN_CELLS = 0  # force the device path for unique content
+    client = Client(driver=driver)
+    client.add_template(TEMPLATE)
+    client.add_constraint(CONSTRAINT)
+    return client, driver
+
+
+def interp_client():
+    client = Client()
+    client.add_template(TEMPLATE)
+    client.add_constraint(CONSTRAINT)
+    return client
+
+
+def wait_until(cond, timeout_s=5.0, step_s=0.01):
+    end = time.monotonic() + timeout_s
+    while time.monotonic() < end:
+        if cond():
+            return True
+        time.sleep(step_s)
+    return cond()
+
+
+class TestCircuitBreaker:
+    def test_trips_serves_interp_identical_and_recovers(self, fault_plane):
+        client, driver = tpu_client(threshold=3, cooldown=0.05)
+        oracle = interp_client()
+
+        dispatched = []  # review names per compute_masks call
+        orig = driver.compute_masks
+
+        def counting(reviews):
+            dispatched.extend(r.get("name", "?") for r in reviews)
+            return orig(reviews)
+
+        driver.compute_masks = counting
+
+        def traffic_dispatches():
+            return [n for n in dispatched if n != PROBE_NAME]
+
+        # healthy: device path serves and the breaker stays closed
+        req = ns_review("warm")
+        got = client.review(AugmentedReview(admission_request=req))
+        want = oracle.review(AugmentedReview(admission_request=req))
+        assert review_sig(got) == review_sig(want)
+        assert driver.breaker.state == CLOSED
+        assert traffic_dispatches(), "healthy review must hit the device"
+
+        fault_plane.add(faults.TPU_DISPATCH, FaultRule(mode="error"))
+
+        # N consecutive injected dispatch failures trip the breaker; each
+        # failed batch STILL answers correctly (interpreter fallback)
+        for i in range(3):
+            req = ns_review(f"fail-{i}")
+            got = client.review(AugmentedReview(admission_request=req))
+            want = oracle.review(AugmentedReview(admission_request=req))
+            assert review_sig(got) == review_sig(want)
+        st = driver.breaker.status()
+        assert st["state"] != "closed"
+        assert st["trips"] >= 1
+
+        # while degraded: traffic never reaches the device (background
+        # probes may; they carry the probe review name) and every verdict
+        # is interpreter-identical — deny and allow cases both
+        n_before = len(traffic_dispatches())
+        for i in range(4):
+            labels = {"gatekeeper": "on"} if i % 2 else None
+            req = ns_review(f"degraded-{i}", labels=labels)
+            got = client.review(AugmentedReview(admission_request=req))
+            want = oracle.review(AugmentedReview(admission_request=req))
+            assert review_sig(got) == review_sig(want)
+            if labels:
+                assert review_sig(got) == []
+            else:
+                assert len(review_sig(got)) == 1
+        assert len(traffic_dispatches()) == n_before, (
+            "open breaker must keep admission traffic off the device"
+        )
+
+        # recovery: clear the schedule; the background half-open probe
+        # closes the breaker without any real traffic
+        fault_plane.clear(faults.TPU_DISPATCH)
+        assert wait_until(lambda: driver.breaker.state == CLOSED), (
+            f"breaker did not recover: {driver.breaker.status()}"
+        )
+        assert dispatched.count(PROBE_NAME) >= 1, "recovery must be probe-driven"
+
+        # traffic returns to the TPU
+        req = ns_review("recovered")
+        got = client.review(AugmentedReview(admission_request=req))
+        assert review_sig(got) == review_sig(
+            oracle.review(AugmentedReview(admission_request=req))
+        )
+        assert len(traffic_dispatches()) > n_before, (
+            "closed breaker must route traffic back to the device"
+        )
+        assert driver.breaker_status()["consecutive_failures"] == 0
+
+    def test_breaker_transitions_land_in_metrics(self, fault_plane):
+        from gatekeeper_tpu.metrics.views import global_registry
+
+        client, driver = tpu_client(threshold=2, cooldown=30.0)
+        fault_plane.add(faults.TPU_DISPATCH, FaultRule(mode="error"))
+        for i in range(2):
+            client.review(
+                AugmentedReview(admission_request=ns_review(f"m-{i}"))
+            )
+        assert driver.breaker.state == OPEN
+        rows = global_registry().view_rows("tpu_breaker_state")
+        assert rows.get(()) == 2.0  # open
+        trips = global_registry().view_rows("tpu_breaker_trips")
+        assert trips.get(()) >= 1.0
+        fault_plane.clear(faults.TPU_DISPATCH)
+        driver.breaker.probe_now()
+        assert driver.breaker.state == CLOSED
+        rows = global_registry().view_rows("tpu_breaker_state")
+        assert rows.get(()) == 0.0  # closed again
+
+    def test_degraded_seconds_span_failed_trials(self):
+        """A failed half-open trial restarts the cooldown clock but must
+        NOT zero the degraded-time metric: degraded_seconds spans the
+        whole outage, not just the last cooldown interval."""
+        from gatekeeper_tpu.ops.breaker import CircuitBreaker
+
+        t = [0.0]
+        cb = CircuitBreaker(
+            failure_threshold=1, cooldown_s=5.0, clock=lambda: t[0]
+        )
+        cb.record_failure()  # trips at t=0
+        t[0] = 10.0
+        assert cb.allow()  # lazy half-open trial
+        cb.record_failure()  # failed trial: re-open
+        t[0] = 20.0
+        assert cb.status()["degraded_seconds"] == 20.0
+        assert cb.allow()
+        cb.record_success()
+        assert cb.state == CLOSED
+        assert cb.status()["degraded_seconds"] == 20.0  # frozen on close
+
+    def test_breaker_state_visible_on_health_endpoints(self):
+        import urllib.request
+
+        from gatekeeper_tpu.webhook import ValidationHandler, WebhookServer
+
+        client, driver = tpu_client()
+        handler = ValidationHandler(client, kube=InMemoryKube())
+        srv = WebhookServer(
+            handler, port=0,
+            health_status=lambda: {"tpu_breaker": driver.breaker_status()},
+        )
+        srv.start()
+        try:
+            def get(path):
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}{path}", timeout=5
+                ) as r:
+                    return r.status, r.read()
+
+            code, body = get("/healthz")
+            assert (code, body) == (200, b"ok")
+            driver.breaker.trip()
+            code, body = get("/healthz")
+            # degraded-but-serving: still 200 (no restart), marker visible
+            assert (code, body) == (200, b"ok (degraded)")
+            code, body = get("/statusz")
+            st = json.loads(body)["tpu_breaker"]
+            assert code == 200
+            assert st["state"] == "open" and st["trips"] == 1
+            driver.breaker.record_success()
+            code, body = get("/healthz")
+            assert (code, body) == (200, b"ok")
+        finally:
+            srv.stop()
+
+    def test_degraded_audit_matches_interpreter(self):
+        client, driver = tpu_client()
+        oracle = interp_client()
+        for c in (client, oracle):
+            for i in range(3):
+                c.add_data({"apiVersion": "v1", "kind": "Namespace",
+                            "metadata": {"name": f"bad-{i}", "labels": {}}})
+            c.add_data({"apiVersion": "v1", "kind": "Namespace",
+                        "metadata": {"name": "good",
+                                     "labels": {"gatekeeper": "on"}}})
+        want_resp, want_totals = oracle.audit_capped(20)
+
+        driver.breaker.trip()
+
+        def no_device(*a, **k):
+            raise AssertionError("device sweep ran while the breaker is open")
+
+        driver._audit_sweep = no_device
+        got_resp, got_totals = client.audit_capped(20)
+        assert got_totals == want_totals
+        assert sorted(r.msg for r in got_resp.results()) == sorted(
+            r.msg for r in want_resp.results()
+        )
+        driver.breaker.record_success()  # close it again
+
+
+class TestDeadlineBudget:
+    def test_no_request_overshoots_budget_under_injected_hangs(
+        self, fault_plane
+    ):
+        client, driver = tpu_client()
+        window = 0.01
+        budget = 0.15
+        mb = MicroBatcher(client, window_s=window)
+        fault_plane.add(
+            faults.TPU_DISPATCH,
+            FaultRule(mode="hang", hang_s=2.0),
+        )
+        try:
+            for i in range(3):
+                with deadline.budget(budget):
+                    t0 = time.monotonic()
+                    with pytest.raises(DeadlineExceeded):
+                        mb.review(AugmentedReview(
+                            admission_request=ns_review(f"hang-{i}")
+                        ))
+                    dur = time.monotonic() - t0
+                # acceptance bound: budget + one batch window (plus
+                # scheduler slack far below the 2s injected hang)
+                assert dur <= budget + window + 0.1, (
+                    f"request {i} took {dur:.3f}s against a "
+                    f"{budget:.3f}s budget"
+                )
+        finally:
+            fault_plane.release_hangs()
+            mb.stop()
+
+    def test_expired_budget_refused_before_enqueue(self):
+        client, driver = tpu_client()
+        mb = MicroBatcher(client, window_s=0.01)
+        try:
+            token = deadline.push(-1.0)  # already expired
+            try:
+                with pytest.raises(DeadlineExceeded):
+                    mb.review(AugmentedReview(
+                        admission_request=ns_review("expired")
+                    ))
+            finally:
+                deadline.pop(token)
+        finally:
+            mb.stop()
+
+    def test_server_answers_within_budget_not_socket_timeout(
+        self, fault_plane
+    ):
+        """End-to-end: a hung dispatch yields a well-formed 504 deny
+        AdmissionReview inside budget + window, not a hung socket."""
+        import urllib.request
+
+        from gatekeeper_tpu.webhook import ValidationHandler, WebhookServer
+
+        client, driver = tpu_client()
+        mb = MicroBatcher(client, window_s=0.01)
+        handler = ValidationHandler(mb, kube=InMemoryKube())
+        srv = WebhookServer(handler, port=0, deadline_budget_s=0.15)
+        srv.start()
+        fault_plane.add(
+            faults.TPU_DISPATCH, FaultRule(mode="hang", hang_s=2.0)
+        )
+        try:
+            body = json.dumps({
+                "apiVersion": "admission.k8s.io/v1beta1",
+                "kind": "AdmissionReview",
+                "request": ns_review("e2e-hang"),
+            }).encode()
+            r = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/v1/admit", data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            t0 = time.monotonic()
+            with urllib.request.urlopen(r, timeout=5) as resp:
+                out = json.loads(resp.read())
+            dur = time.monotonic() - t0
+            assert dur < 1.0, f"response took {dur:.3f}s (hang leaked)"
+            assert out["response"]["allowed"] is False
+            assert out["response"]["status"]["code"] == 504
+            assert out["response"]["status"]["message"] == (
+                "admission deadline budget exhausted"
+            )
+        finally:
+            fault_plane.release_hangs()
+            srv.stop()
+            mb.stop()
+
+
+class TestAuditOutage:
+    def test_audit_survives_full_kube_outage_and_resumes(self, fault_plane):
+        srv = KubeApiServer()
+        srv.start()
+        try:
+            kube = HttpKube(srv.url, discovery_retry_s=1.0)
+            client = interp_client()
+            # register the synthesized constraint CRD so the status-write
+            # path can list (finding no constraint objects is fine)
+            kube.create(client.add_template(TEMPLATE))
+            for i in range(2):
+                kube.create({"apiVersion": "v1", "kind": "Namespace",
+                             "metadata": {"name": f"bad-{i}", "labels": {}}})
+            reporter = Reporters(Registry())
+            am = AuditManager(kube, client, reporter=reporter,
+                              interval_s=3600.0)
+
+            assert am.run_once_guarded() is True
+            assert am.last_run_status == "ok"
+            assert am.consecutive_failures == 0
+
+            # full outage: every kube HTTP send fails
+            fault_plane.add(faults.KUBE_SEND, FaultRule(mode="error"))
+            assert am.run_once_guarded() is False
+            assert am.run_once_guarded() is False
+            assert am.consecutive_failures == 2
+            assert am.last_run_status == "error"
+            rows = reporter.registry.view_rows("audit_consecutive_failures")
+            assert rows.get(()) == 2.0
+            assert reporter.registry.view_rows(
+                "audit_last_run_status"
+            ).get(()) == 0.0
+
+            # recovery: the very next sweep succeeds and finds violations
+            fault_plane.clear(faults.KUBE_SEND)
+            assert am.run_once_guarded() is True
+            assert am.consecutive_failures == 0
+            assert am.last_run_status == "ok"
+            assert reporter.registry.view_rows(
+                "audit_last_run_status"
+            ).get(()) == 1.0
+            update_lists = am.audit_once()
+            assert update_lists, "post-outage sweep must find violations"
+            (violations,) = update_lists.values()
+            assert {v.name for v in violations} == {"bad-0", "bad-1"}
+        finally:
+            srv.stop()
+
+
+class TestWatchFaults:
+    def test_pump_survives_injected_delivery_drops(self, fault_plane):
+        kube = InMemoryKube()
+        wm = WatchManager(kube)
+        reg = wm.new_registrar("chaos")
+        ns_gvk = ("", "v1", "Namespace")
+        reg.add_watch(ns_gvk)
+        assert wait_until(lambda: wm.replays_active() == 0)
+        try:
+            # exactly the first two deliveries drop; the pump survives
+            fault_plane.add(
+                faults.WATCH_DELIVER, FaultRule(mode="error", count=2)
+            )
+            for i in range(5):
+                kube.create({"apiVersion": "v1", "kind": "Namespace",
+                             "metadata": {"name": f"ns-{i}"}})
+            got = []
+            end = time.monotonic() + 5.0
+            while len(got) < 3 and time.monotonic() < end:
+                try:
+                    got.append(reg.events.get(timeout=0.2))
+                except queue.Empty:
+                    pass
+            names = [ev.object["metadata"]["name"] for _gvk, ev in got]
+            assert names == ["ns-2", "ns-3", "ns-4"]
+            # schedule spent: later events flow normally
+            kube.create({"apiVersion": "v1", "kind": "Namespace",
+                         "metadata": {"name": "ns-after"}})
+            _gvk, ev = reg.events.get(timeout=2.0)
+            assert ev.object["metadata"]["name"] == "ns-after"
+        finally:
+            wm.stop()
+
+
+class TestBatcherShutdown:
+    def test_stop_drains_pending_and_rejects_new_enqueues(self):
+        client = interp_client()
+        entered = threading.Event()
+        gate = threading.Event()
+        orig_batch = client.review_batch
+
+        def blocking_batch(objs, tracing=False):
+            entered.set()
+            gate.wait(5.0)
+            return orig_batch(objs, tracing=tracing)
+
+        client.review_batch = blocking_batch
+        mb = MicroBatcher(client, window_s=0.01)
+        results = {}
+
+        def call(key, name):
+            try:
+                results[key] = mb.review(
+                    AugmentedReview(admission_request=ns_review(name))
+                )
+            except Exception as e:
+                results[key] = e
+
+        # occupy the batch loop with a genuinely in-flight batch
+        mb._busy = True  # steer the first request into the queue
+        t1 = threading.Thread(target=call, args=("t1", "first"))
+        t1.start()
+        assert entered.wait(5.0), "batch loop never picked up the request"
+        # now enqueue a second request behind the in-flight batch
+        t2 = threading.Thread(target=call, args=("t2", "second"))
+        t2.start()
+        assert wait_until(lambda: len(mb._pending) == 1)
+
+        # stop() while a request is pending: it must get a shutdown error
+        # (the old code left it waiting on its event forever)
+        stopper = threading.Thread(target=mb.stop)
+        stopper.start()
+        t2.join(timeout=5.0)
+        assert not t2.is_alive()
+        assert isinstance(results["t2"], BatcherStopped)
+
+        # enqueues after stop() fail fast
+        with pytest.raises(BatcherStopped):
+            mb.review(AugmentedReview(admission_request=ns_review("third")))
+
+        # release the in-flight batch: its caller still gets its answer
+        gate.set()
+        t1.join(timeout=5.0)
+        stopper.join(timeout=5.0)
+        assert not t1.is_alive() and not stopper.is_alive()
+        assert not isinstance(results["t1"], Exception)
+        assert len(results["t1"].results()) == 1
+
+
+class TestReconnectBackoff:
+    """Bounds of the watch reconnect schedule (syncutil.Backoff, used by
+    HttpWatcher._pump): capped exponential with downward jitter — the cap
+    is HARD (no interval ever exceeds it, jittered or not) and the jitter
+    desynchronizes a fleet of reconnecting watchers without shrinking any
+    interval below half its nominal value.  (Lives here rather than
+    test_http_kube.py because that module needs `cryptography` to
+    collect.)"""
+
+    def test_schedule_bounds_and_hard_cap(self):
+        import random as _random
+
+        from gatekeeper_tpu.syncutil import Backoff
+
+        b = Backoff(base=0.05, factor=2.0, cap=2.0, jitter=0.5,
+                    rng=_random.Random(7))
+        nominal = 0.05
+        for _ in range(16):
+            v = b.next()
+            hi = min(nominal, 2.0)
+            assert hi * 0.5 - 1e-9 <= v <= hi + 1e-9
+            assert v <= 2.0  # hard cap survives jitter
+            nominal = min(nominal * 2.0, 2.0)
+
+    def test_no_jitter_is_the_exact_ladder(self):
+        from gatekeeper_tpu.syncutil import Backoff
+
+        b = Backoff(base=0.05, factor=2.0, cap=2.0, jitter=0.0)
+        got = [round(b.next(), 4) for _ in range(8)]
+        assert got == [0.05, 0.1, 0.2, 0.4, 0.8, 1.6, 2.0, 2.0]
+        b.reset()
+        assert b.next() == 0.05
+
+    def test_seeded_schedules_deterministic_and_desynchronized(self):
+        import random as _random
+
+        from gatekeeper_tpu.syncutil import Backoff
+
+        def schedule(seed):
+            b = Backoff(rng=_random.Random(seed))
+            return [b.next() for _ in range(10)]
+
+        assert schedule(1) == schedule(1)
+        assert schedule(1) != schedule(2)  # the anti-storm property
+
+    def test_watcher_pump_uses_jittered_capped_schedule(self):
+        from gatekeeper_tpu.kube.http_client import HttpWatcher
+
+        assert HttpWatcher.RECONNECT_BASE_S == 0.05
+        assert HttpWatcher.RECONNECT_CAP_S == 2.0
+        assert 0.0 < HttpWatcher.RECONNECT_JITTER < 1.0
+
+
+class TestFaultPlane:
+    def test_inert_by_default(self):
+        assert faults.ENABLED is False
+        faults.fire(faults.TPU_DISPATCH)  # no plane installed: a no-op
+        # call sites gated on the flag inject nothing anywhere
+        client, driver = tpu_client()
+        got = client.review(
+            AugmentedReview(admission_request=ns_review("inert"))
+        )
+        assert len(got.results()) == 1
+        assert driver.breaker.state == CLOSED
+
+    def test_seeded_schedules_are_deterministic(self):
+        def decisions(seed):
+            plane = FaultPlane(seed=seed)
+            plane.add("pt", FaultRule(mode="error", probability=0.5))
+            out = []
+            for _ in range(64):
+                try:
+                    plane.fire("pt")
+                    out.append(0)
+                except FaultError:
+                    out.append(1)
+            return out
+
+        a, b, c = decisions(5), decisions(5), decisions(6)
+        assert a == b
+        assert a != c
+        assert 10 < sum(a) < 54  # probability actually applied
+
+    def test_count_after_and_latency_semantics(self):
+        plane = FaultPlane(seed=0)
+        rule = plane.add("pt", FaultRule(mode="error", count=2, after=1))
+        outcomes = []
+        for _ in range(5):
+            try:
+                plane.fire("pt")
+                outcomes.append("ok")
+            except FaultError:
+                outcomes.append("err")
+        assert outcomes == ["ok", "err", "err", "ok", "ok"]
+        assert rule.fires == 2
+        lat = plane.add("lat", FaultRule(mode="latency", latency_s=0.05))
+        t0 = time.monotonic()
+        plane.fire("lat")
+        assert time.monotonic() - t0 >= 0.04
+
+    def test_hang_is_bounded_and_releasable(self):
+        plane = FaultPlane(seed=0)
+        plane.add("h", FaultRule(mode="hang", hang_s=10.0))
+        done = threading.Event()
+
+        def hang_call():
+            plane.fire("h")
+            done.set()
+
+        t = threading.Thread(target=hang_call, daemon=True)
+        t.start()
+        assert not done.wait(0.1), "hang returned immediately"
+        plane.release_hangs()
+        assert done.wait(2.0), "release did not unblock the hang"

@@ -555,6 +555,107 @@ class TestKeepAliveFraming:
             pass  # the connection dropping outright is also a valid outcome
 
 
+class TestFailurePolicyExactJSON:
+    """Exact AdmissionReview JSON for internal errors and deadline
+    exhaustion under fail-closed (default) and fail-open: the degraded
+    webhook's wire contract is pinned byte-for-byte (ISSUE satellite;
+    docs/failure-modes.md)."""
+
+    class _BoomClient:
+        def __init__(self, exc):
+            self.exc = exc
+
+        def review(self, obj, tracing=False):
+            raise self.exc
+
+    def _admit(self, exc, fail_open):
+        from gatekeeper_tpu.kube.inmem import InMemoryKube as _Kube
+
+        handler = ValidationHandler(
+            self._BoomClient(exc), kube=_Kube(), fail_open=fail_open
+        )
+        srv = WebhookServer(handler, port=0)
+        srv.start()
+        try:
+            body = json.dumps({
+                "apiVersion": "admission.k8s.io/v1beta1",
+                "kind": "AdmissionReview",
+                "request": ns_request(),
+            }).encode()
+            r = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/v1/admit", data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(r, timeout=10) as resp:
+                return json.loads(resp.read())
+        finally:
+            srv.stop()
+
+    def test_internal_error_fail_closed(self):
+        out = self._admit(RuntimeError("boom"), fail_open=False)
+        assert out == {
+            "apiVersion": "admission.k8s.io/v1beta1",
+            "kind": "AdmissionReview",
+            "response": {
+                "uid": "uid-1",
+                "allowed": False,
+                "status": {"message": "boom", "code": 500},
+            },
+        }
+
+    def test_internal_error_fail_open(self):
+        out = self._admit(RuntimeError("boom"), fail_open=True)
+        assert out == {
+            "apiVersion": "admission.k8s.io/v1beta1",
+            "kind": "AdmissionReview",
+            "response": {
+                "uid": "uid-1",
+                "allowed": True,
+                "status": {"message": "boom", "code": 200},
+                "auditAnnotations": {
+                    "admission.gatekeeper.sh/fail-open": "internal-error"
+                },
+            },
+        }
+
+    def test_deadline_exhaustion_fail_closed(self):
+        from gatekeeper_tpu.deadline import DeadlineExceeded
+
+        out = self._admit(DeadlineExceeded("late"), fail_open=False)
+        assert out == {
+            "apiVersion": "admission.k8s.io/v1beta1",
+            "kind": "AdmissionReview",
+            "response": {
+                "uid": "uid-1",
+                "allowed": False,
+                "status": {
+                    "message": "admission deadline budget exhausted",
+                    "code": 504,
+                },
+            },
+        }
+
+    def test_deadline_exhaustion_fail_open(self):
+        from gatekeeper_tpu.deadline import DeadlineExceeded
+
+        out = self._admit(DeadlineExceeded("late"), fail_open=True)
+        assert out == {
+            "apiVersion": "admission.k8s.io/v1beta1",
+            "kind": "AdmissionReview",
+            "response": {
+                "uid": "uid-1",
+                "allowed": True,
+                "status": {
+                    "message": "admission deadline budget exhausted",
+                    "code": 200,
+                },
+                "auditAnnotations": {
+                    "admission.gatekeeper.sh/fail-open": "deadline-exhausted"
+                },
+            },
+        }
+
+
 def test_missing_namespace_logged_without_traceback():
     """Namespace-not-synced is an expected operational condition: the 500
     verdict stands, logged as a WARNING with no exception traceback (at
