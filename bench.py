@@ -240,7 +240,10 @@ def bench_latency() -> dict:
     log(f"admission SERVER latency ms (TLS+batcher, {len(srv_runs)} runs): "
         f"p50 median={srv_p50:.2f} p99 median={srv_p99:.2f} "
         f"p99 max={max(r[1] for r in srv_runs):.2f}")
+    stage_p50 = _stage_breakdown(handler, req)
+    log(f"admission per-stage p50 ms: {stage_p50}")
     return {
+        "stage_p50_ms": stage_p50,
         "metric": "admission handler p99 latency (demo/basic, deny path)",
         "value": round(p99, 3),
         "unit": "ms",
@@ -252,6 +255,31 @@ def bench_latency() -> dict:
         "server_p50_ms": round(srv_p50, 3),
         "server_p99_runs_ms": [round(r[1], 3) for r in srv_runs],
         "server_p99_max_ms": round(max(r[1] for r in srv_runs), 3),
+    }
+
+
+def _stage_breakdown(handler, req, iters=50):
+    """Per-stage p50s of the admission path from the always-on tracer
+    (obs/trace.py): each request runs under a root span; the stage spans
+    (cache_lookup / pack / dispatch / render) are aggregated so future
+    perf PRs can claim stage-level wins from the BENCH artifact."""
+    import numpy as np
+
+    from gatekeeper_tpu.obs import trace as obstrace
+
+    tracer = obstrace.get_tracer()
+    tracer.clear()
+    for _ in range(iters):
+        with obstrace.root_span("admission"):
+            handler.handle(req)
+    samples = {}
+    for t in tracer.traces(limit=iters):
+        for stage, ms in obstrace.stage_breakdown(t).items():
+            samples.setdefault(stage, []).append(ms)
+    tracer.clear()
+    return {
+        stage: round(float(np.percentile(v, 50)), 4)
+        for stage, v in sorted(samples.items())
     }
 
 
@@ -1416,6 +1444,7 @@ def main():
         else:
             out[key] = sub["value"]
         if name == "latency":
+            out["admission_stage_p50_ms"] = sub.get("stage_p50_ms")
             out["admission_p50_ms"] = sub.get("p50_ms")
             out["admission_p99_runs_ms"] = sub.get("p99_runs_ms")
             out["admission_p99_max_ms"] = sub.get("p99_max_ms")

@@ -31,6 +31,7 @@ from datetime import datetime, timezone
 
 from .. import logging as gklog
 from ..kube.inmem import GVK, InMemoryKube, NotFound
+from ..obs import trace as obstrace
 from ..process.excluder import AUDIT, Excluder
 from ..target.target import AugmentedUnstructured
 from ..util import KNOWN_ENFORCEMENT_ACTIONS, get_enforcement_action
@@ -191,12 +192,24 @@ class AuditManager:
     def audit_once(self) -> Dict[str, List[StatusViolation]]:
         t0 = time.monotonic()
         timestamp = dt_rfc3339()
-        gklog.log_event(log, "auditing constraints and violations",
-                        **{gklog.EVENT_TYPE: "audit_started",
-                           gklog.AUDIT_ID: timestamp})
-        if self.reporter:
-            self.reporter.report_audit_last_run(time.time())
+        # root span of the audit trace: the driver's sweep stages (pack /
+        # per-shard dispatch / fetch / render) parent to it via the
+        # context var since the whole sweep runs on this thread.  Manual
+        # enter/exit (instead of re-indenting the body): __enter__ is
+        # immediately followed by the try whose finally __exit__s with
+        # the live exc_info, so the span can neither leak on this
+        # long-lived thread nor lose error attribution
+        _span_ctx = obstrace.root_span(
+            "audit", audit_id=timestamp,
+            mode="from-cache" if self.from_cache else "discovery",
+        )
+        _span_ctx.__enter__()
         try:
+            gklog.log_event(log, "auditing constraints and violations",
+                            **{gklog.EVENT_TYPE: "audit_started",
+                               gklog.AUDIT_ID: timestamp})
+            if self.reporter:
+                self.reporter.report_audit_last_run(time.time())  # wall-clock: ok (epoch gauge)
             if self.require_crd and not self._crd_exists():
                 log.info("audit exits, required crd has not been deployed")
                 return {}
@@ -273,10 +286,13 @@ class AuditManager:
                 for action, n in totals_per_action.items():
                     self.reporter.report_total_violations(action, n)
 
-            self._write_audit_results(
-                constraint_kinds, update_lists, timestamp,
-                totals_per_constraint, totals_exact,
-            )
+            with obstrace.span("audit.status_write",
+                               stage=obstrace.STATUS_WRITE,
+                               constraints=len(update_lists)):
+                self._write_audit_results(
+                    constraint_kinds, update_lists, timestamp,
+                    totals_per_constraint, totals_exact,
+                )
             return update_lists
         finally:
             dur = time.monotonic() - t0
@@ -285,6 +301,9 @@ class AuditManager:
             gklog.log_event(log, "auditing is complete",
                             **{gklog.EVENT_TYPE: "audit_finished",
                                gklog.AUDIT_ID: timestamp})
+            import sys as _sys
+
+            _span_ctx.__exit__(*_sys.exc_info())
 
     # ---- helpers -----------------------------------------------------------
 
@@ -338,7 +357,11 @@ class AuditManager:
         self, update_lists, totals_per_constraint, totals_per_action,
         timestamp,
     ):
-        """Discovery-mode sweep with batched device dispatches."""
+        """Discovery-mode sweep with batched device dispatches.  The
+        inventory span covers the whole list+review walk (the listing
+        interleaves with dispatch flushes, so the driver's pack/dispatch
+        spans nest inside it — audit stages overlap by design, unlike the
+        webhook's disjoint stages; docs/tracing.md)."""
         constraint_kinds = self._constraint_kinds()
         matched = self._matched_kinds(constraint_kinds)
         ns_cache: Dict[str, Optional[dict]] = {}
@@ -363,38 +386,40 @@ class AuditManager:
                 )
             pending.clear()
 
-        for gvk in self.kube.list_gvks():
-            if gvk[0] in _SKIP_GROUPS:
-                continue
-            if "*" not in matched and gvk[2] not in matched:
-                continue
-            # STREAMED paging (--audit-chunk-size): each page arrives via
-            # the kube surface's limit+continue chunking, so host memory is
-            # bounded by the chunk size, not the cluster size (reference
-            # manager.go:342-396); each page then fills device-width review
-            # batches.  Kube clients without list_pages fall back to one
-            # full-list page.
-            if self.chunk_size and hasattr(self.kube, "list_pages"):
-                pages = self.kube.list_pages(gvk, limit=self.chunk_size)
-            else:
-                pages = iter([self.kube.list(gvk)])
-            for page in pages:
-                for obj in page:
-                    ns = (obj.get("metadata") or {}).get("namespace") or ""
-                    # a Namespace object is excluded by its own name — an
-                    # excluded namespace shouldn't surface via its Namespace
-                    # object either (deliberate tightening of manager.go:362)
-                    if not ns and gvk == ("", "v1", "Namespace"):
-                        ns = (obj.get("metadata") or {}).get("name") or ""
-                    if self.excluder.is_namespace_excluded(AUDIT, ns):
-                        continue
-                    ns_obj = lookup_ns(ns) if ns else None
-                    pending.append(
-                        AugmentedUnstructured(object=obj, namespace=ns_obj)
-                    )
-                    if len(pending) >= self.review_batch:
-                        flush()
-        flush()
+        with obstrace.span("audit.inventory", stage=obstrace.INVENTORY):
+            for gvk in self.kube.list_gvks():
+                if gvk[0] in _SKIP_GROUPS:
+                    continue
+                if "*" not in matched and gvk[2] not in matched:
+                    continue
+                # STREAMED paging (--audit-chunk-size): each page arrives
+                # via the kube surface's limit+continue chunking, so host
+                # memory is bounded by the chunk size, not the cluster size
+                # (reference manager.go:342-396); each page then fills
+                # device-width review batches.  Kube clients without
+                # list_pages fall back to one full-list page.
+                if self.chunk_size and hasattr(self.kube, "list_pages"):
+                    pages = self.kube.list_pages(gvk, limit=self.chunk_size)
+                else:
+                    pages = iter([self.kube.list(gvk)])
+                for page in pages:
+                    for obj in page:
+                        ns = (obj.get("metadata") or {}).get("namespace") or ""
+                        # a Namespace object is excluded by its own name —
+                        # an excluded namespace shouldn't surface via its
+                        # Namespace object either (deliberate tightening of
+                        # manager.go:362)
+                        if not ns and gvk == ("", "v1", "Namespace"):
+                            ns = (obj.get("metadata") or {}).get("name") or ""
+                        if self.excluder.is_namespace_excluded(AUDIT, ns):
+                            continue
+                        ns_obj = lookup_ns(ns) if ns else None
+                        pending.append(
+                            AugmentedUnstructured(object=obj, namespace=ns_obj)
+                        )
+                        if len(pending) >= self.review_batch:
+                            flush()
+            flush()
 
     def _add_results(
         self, results, update_lists, totals_per_constraint,

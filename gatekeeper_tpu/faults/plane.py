@@ -35,6 +35,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Union
 
+from ..obs import trace as obstrace
+
 ERROR = "error"
 LATENCY = "latency"
 HANG = "hang"
@@ -148,6 +150,16 @@ class FaultPlane:
                 break
         if act is None:
             return
+        # trace visibility: a chaos-test failure should show WHERE the
+        # injected fault landed inside the trace, not just that latency
+        # (or an error) appeared somewhere
+        obstrace.add_event(
+            "fault_injected", point=point, mode=act.mode,
+            delay_s=(
+                act.latency_s if act.mode == LATENCY
+                else act.hang_s if act.mode == HANG else 0.0
+            ),
+        )
         if act.mode == LATENCY:
             time.sleep(act.latency_s)
             return

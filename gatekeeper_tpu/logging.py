@@ -32,6 +32,10 @@ RESOURCE_KIND = "resource_kind"
 RESOURCE_NAMESPACE = "resource_namespace"
 RESOURCE_NAME = "resource_name"
 REQUEST_USERNAME = "request_username"
+# observability addition: every structured event carries the active trace
+# id when one exists, so a deny log line correlates with its
+# /debug/traces entry (and the upstream traceparent)
+TRACE_ID = "trace_id"
 
 
 # level encoders, matching zapcore's set (reference main.go:74-79)
@@ -54,7 +58,7 @@ class JsonFormatter(logging.Formatter):
     def format(self, record: logging.LogRecord) -> str:
         out = {
             self.level_key: self.level_encoder(record.levelname),
-            "ts": time.time(),
+            "ts": time.time(),  # wall-clock: ok (log record timestamp)
             "logger": record.name,
             "msg": record.getMessage(),
         }
@@ -97,5 +101,16 @@ def get(name: str) -> logging.Logger:
 
 def log_event(logger: logging.Logger, msg: str, level: int = logging.INFO, **kv):
     """Structured log line with stable keys (e.g. violation_audited,
-    admission deny — reference policy.go:241-257, audit/manager.go:732-750)."""
+    admission deny — reference policy.go:241-257, audit/manager.go:732-750).
+    The active trace id (obs.trace context) is injected automatically so
+    violation/deny lines correlate with their trace."""
+    if TRACE_ID not in kv:
+        tid = _current_trace_id()
+        if tid is not None:
+            kv[TRACE_ID] = tid
     logger.log(level, msg, extra={"kv": kv})
+
+
+# imported last: obs.trace depends only on the stdlib, so this cannot
+# cycle back into this module
+from .obs.trace import current_trace_id as _current_trace_id  # noqa: E402

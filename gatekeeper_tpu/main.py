@@ -135,6 +135,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--breaker-cooldown-s", type=float, default=5.0,
                    help="seconds the tripped breaker waits before running "
                         "half-open recovery probes")
+    # observability (docs/tracing.md): always-on tracing knobs
+    p.add_argument("--trace-buffer-size", type=int, default=256,
+                   help="completed traces retained for /debug/traces")
+    p.add_argument("--slow-trace-threshold-ms", type=float, default=250.0,
+                   help="log any trace slower than this with its full "
+                        "stage breakdown (0 disables the slow sampler)")
+    p.add_argument("--trace-sample-rate", type=float, default=1.0,
+                   help="fraction of completed traces retained in the "
+                        "/debug/traces ring (slow traces always retained)")
     p.add_argument("--fault-plane-seed", type=int, default=None,
                    help="EXPLICITLY enable the fault-injection plane with "
                         "this seed (testing only; add schedules via "
@@ -329,6 +338,15 @@ class App:
             getattr(args, "api_server", "inmem"))
         self.operations = ops_mod.Operations(args.operation or None)
         self.reporters = Reporters()
+        from .obs import trace as obstrace
+
+        obstrace.configure(
+            buffer_size=getattr(args, "trace_buffer_size", 256),
+            slow_threshold_s=(
+                getattr(args, "slow_trace_threshold_ms", 250.0) / 1000.0
+            ),
+            sample_rate=getattr(args, "trace_sample_rate", 1.0),
+        )
 
         if getattr(args, "fault_plane_seed", None) is not None:
             from . import faults

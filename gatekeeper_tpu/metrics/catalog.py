@@ -91,6 +91,37 @@ BREAKER_DEGRADED_M = Measure(
     "(evaluation served by the interpreter tier)",
     unit="s",
 )
+# ---- observability additions (per-stage hot-path telemetry, ISSUE 2) --------
+WEBHOOK_QUEUE_M = Measure(
+    "webhook_batch_queue_seconds",
+    "Time an admission review waited in the micro-batch queue before its "
+    "batch dispatched",
+    unit="s",
+)
+BATCH_SIZE_M = Measure(
+    "webhook_batch_size",
+    "Admission reviews coalesced into one batched evaluation",
+)
+PACK_M = Measure(
+    "tpu_pack_seconds",
+    "Host-side tensor packing time per evaluation (reviews + columns)",
+    unit="s",
+)
+COMPILE_M = Measure(
+    "tpu_compile_seconds",
+    "XLA trace+compile time per fused-executable build (cache misses only)",
+    unit="s",
+)
+DISPATCH_M = Measure(
+    "tpu_dispatch_seconds",
+    "Device dispatch + result fetch time per evaluation",
+    unit="s",
+)
+CACHE_M = Measure(
+    "cache_requests",
+    "Evaluation-cache lookups by cache (request_memo, aotcache, xlacache) "
+    "and outcome (hit, miss)",
+)
 
 # bucket boundaries copied from the reference's view.Distribution calls
 _INGEST_BUCKETS = (
@@ -106,6 +137,12 @@ _SYNC_BUCKETS = (
     0.0001, 0.0002, 0.0003, 0.0004, 0.0005, 0.0006, 0.0007, 0.0008, 0.0009,
     0.001, 0.002, 0.003, 0.004, 0.005, 0.01, 0.02, 0.03, 0.04, 0.05,
 )
+# stage timings span ~50us (warm host pack) to seconds (cold XLA compile)
+_STAGE_BUCKETS = (
+    0.00005, 0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+)
+_BATCH_SIZE_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
 
 
 def catalog_views():
@@ -146,6 +183,18 @@ def catalog_views():
         View("tpu_breaker_trips", BREAKER_TRIPS_M, AGG_LAST_VALUE),
         View("tpu_breaker_degraded_seconds", BREAKER_DEGRADED_M,
              AGG_LAST_VALUE),
+        View("webhook_batch_queue_seconds", WEBHOOK_QUEUE_M,
+             AGG_DISTRIBUTION, buckets=_STAGE_BUCKETS),
+        View("webhook_batch_size", BATCH_SIZE_M, AGG_DISTRIBUTION,
+             buckets=_BATCH_SIZE_BUCKETS),
+        View("tpu_pack_seconds", PACK_M, AGG_DISTRIBUTION,
+             tag_keys=("path",), buckets=_STAGE_BUCKETS),
+        View("tpu_compile_seconds", COMPILE_M, AGG_DISTRIBUTION,
+             buckets=_STAGE_BUCKETS),
+        View("tpu_dispatch_seconds", DISPATCH_M, AGG_DISTRIBUTION,
+             tag_keys=("path", "tier"), buckets=_STAGE_BUCKETS),
+        View("cache_requests_total", CACHE_M, AGG_COUNT,
+             tag_keys=("cache", "outcome")),
     ]
 
 
@@ -210,7 +259,9 @@ class Reporters:
         self.registry.record(AUDIT_DURATION_M, duration_s)
 
     def report_audit_last_run(self, ts: Optional[float] = None):
-        self.registry.record(AUDIT_LAST_RUN_M, ts if ts is not None else time.time())
+        self.registry.record(AUDIT_LAST_RUN_M,
+                             ts if ts is not None
+                             else time.time())  # wall-clock: ok (epoch gauge)
 
     # -- sync controller ------------------------------------------------------
     def report_sync(self, counts: Dict[object, int],
@@ -233,7 +284,7 @@ class Reporters:
         self._sync_kinds = kinds
         if duration_s is not None:
             self.registry.record(SYNC_DURATION_M, duration_s)
-        self.registry.record(SYNC_LAST_RUN_M, time.time())
+        self.registry.record(SYNC_LAST_RUN_M, time.time())  # wall-clock: ok (epoch gauge)
 
     # -- watch manager --------------------------------------------------------
     def report_gvk_count(self, watched: int, intended: int):
@@ -258,3 +309,54 @@ def record_breaker(status: dict, registry: Optional[Registry] = None):
     registry.record(
         BREAKER_DEGRADED_M, float(status.get("degraded_seconds", 0.0))
     )
+
+
+# ---- hot-path stage/cache recording (ISSUE 2) -------------------------------
+# The driver, micro-batcher, and AOT cache record without a Reporters
+# handle.  The global registry's catalog registration is memoized behind
+# one boolean so the steady-state cost is the registry's indexed record.
+
+_GLOBAL_READY = False
+
+
+def _global() -> Registry:
+    global _GLOBAL_READY
+    registry = global_registry()
+    if not _GLOBAL_READY:
+        register_catalog(registry)
+        _GLOBAL_READY = True
+    return registry
+
+
+def record_stage(measure: Measure, seconds: float,
+                 tags: Optional[Dict[str, str]] = None):
+    """One stage-duration sample into the new per-stage histograms
+    (tpu_pack_seconds / tpu_dispatch_seconds / tpu_compile_seconds /
+    webhook_batch_queue_seconds).  Guarded: a metrics-layer defect must
+    never fail the admission/audit evaluation that is being measured."""
+    try:
+        _global().record(measure, seconds, tags)
+    except Exception:  # pragma: no cover - telemetry never blocks eval
+        pass
+
+
+def record_batch_size(n: int):
+    try:
+        _global().record(BATCH_SIZE_M, float(n))
+    except Exception:  # pragma: no cover - telemetry never blocks eval
+        pass
+
+
+def record_cache(cache: str, hit: bool, n: int = 1):
+    """n hit/miss outcomes for one named cache (request_memo, aotcache,
+    xlacache) in one lock hold.  Guarded like record_stage."""
+    if n <= 0:
+        return
+    try:
+        _global().record(
+            CACHE_M, float(n),
+            {"cache": cache, "outcome": "hit" if hit else "miss"},
+            count=n,
+        )
+    except Exception:  # pragma: no cover - telemetry never blocks eval
+        pass

@@ -67,7 +67,7 @@ def render_prometheus(registry: Optional[Registry] = None) -> str:
                     lines.append(f"{full}_bucket{le} {cumulative}")
                 le = _labels(view.tag_keys + ("le",), tag_values + ("+Inf",))
                 lines.append(f"{full}_bucket{le} {val.count}")
-                lines.append(f"{full}_sum{label_str} {repr(val.sum)}")
+                lines.append(f"{full}_sum{label_str} {_fmt(val.sum)}")
                 lines.append(f"{full}_count{label_str} {val.count}")
             else:
                 lines.append(f"{full}{label_str} {_fmt(float(val))}")

@@ -63,6 +63,10 @@ class Registry:
 
     def __init__(self):
         self._views: Dict[str, _ViewState] = {}
+        # measure name -> view states: record() is on the admission hot
+        # path (stage histograms record per request), so the per-record
+        # cost must be O(views of this measure), not O(all views)
+        self._by_measure: Dict[str, List[_ViewState]] = {}
         self._lock = threading.Lock()
 
     def register(self, *views: View) -> None:
@@ -75,24 +79,28 @@ class Registry:
                     if existing.view != v:
                         raise ValueError(f"view {v.name} already registered")
                     continue
-                self._views[v.name] = _ViewState(view=v)
+                state = _ViewState(view=v)
+                self._views[v.name] = state
+                self._by_measure.setdefault(v.measure.name, []).append(state)
 
     def record(
         self,
         measure: Measure,
         value: float,
         tags: Optional[Dict[str, str]] = None,
+        count: int = 1,
     ) -> None:
-        """Record one measurement against every view of this measure."""
+        """Record one measurement against every view of this measure.
+        ``count`` batches AGG_COUNT increments (N cache hits recorded in
+        one lock hold); the other aggregations treat the call as a single
+        sample regardless."""
         tags = tags or {}
         with self._lock:
-            for state in self._views.values():
+            for state in self._by_measure.get(measure.name, ()):
                 v = state.view
-                if v.measure.name != measure.name:
-                    continue
                 key = tuple(tags.get(k, "") for k in v.tag_keys)
                 if v.aggregation == AGG_COUNT:
-                    state.rows[key] = int(state.rows.get(key, 0)) + 1
+                    state.rows[key] = int(state.rows.get(key, 0)) + count
                 elif v.aggregation == AGG_SUM:
                     state.rows[key] = float(state.rows.get(key, 0.0)) + value
                 elif v.aggregation == AGG_LAST_VALUE:

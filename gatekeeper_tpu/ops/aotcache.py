@@ -48,6 +48,26 @@ _lock = threading.Lock()
 _code_fp: Optional[str] = None
 
 
+def _record_cache(cache: str, hit: bool):
+    """Observability counters, isolated so a metrics problem can never
+    break the compile path."""
+    try:
+        from ..metrics.catalog import record_cache
+
+        record_cache(cache, hit)
+    except Exception:  # pragma: no cover - metrics must never block eval
+        pass
+
+
+def _record_compile(seconds: float):
+    try:
+        from ..metrics.catalog import COMPILE_M, record_stage
+
+        record_stage(COMPILE_M, seconds)
+    except Exception:  # pragma: no cover
+        pass
+
+
 def enable(cache_dir: str) -> bool:
     global _dir
     try:
@@ -194,12 +214,25 @@ class aot_jit:
             compiled = load(key)
             if compiled is not None:
                 log.info("aot cache hit: %s", key)
+                _record_cache("aotcache", True)
             else:
+                _record_cache("aotcache", False)
                 # one trace+compile for this layout (the .compile()
                 # consults jax's persistent XLA cache when enabled), then
                 # persist the executable so the NEXT process skips the
                 # trace too
+                import time as _time
+
+                from ..obs import trace as obstrace
+
+                t0 = _time.perf_counter()
                 compiled = self._jitted.lower(*args).compile()
+                t1 = _time.perf_counter()
+                obstrace.record_span(
+                    "xla.compile", t0, t1, stage=obstrace.COMPILE,
+                    tag=self._tag,
+                )
+                _record_compile(t1 - t0)
                 save(key, compiled)
                 with self._mu:
                     self._validated.add(key)  # it just compiled here

@@ -25,6 +25,13 @@ import numpy as np
 
 from .. import deadline as _deadline
 from .. import faults
+from ..metrics.catalog import (
+    DISPATCH_M,
+    PACK_M,
+    record_cache,
+    record_stage,
+)
+from ..obs import trace as obstrace
 from ..client.drivers import CompiledTemplate, InterpDriver, Result
 from ..target.match import constraint_matches, needs_autoreject
 from ..target.target import K8sValidationTarget
@@ -796,15 +803,30 @@ class TpuDriver(InterpDriver):
         mesh multiple and committed sharded (input placement drives the
         SPMD compile of the SAME fused jit); results come back trimmed so
         callers see identical shapes on 1 or N devices."""
+        import time as _time
+
+        t0 = _time.perf_counter()
         fn, ordered, rp, cp, cols, group_params, crow = self._device_inputs(
             reviews
         )
         rows = len(rp.arrays["valid"])
+        t1 = _time.perf_counter()
         packed = self._dispatch(
             self._packed_variant(fn), rp.arrays, cp.arrays, cols,
             group_params, rows,
         )
         both = np.unpackbits(np.asarray(packed), axis=1)
+        t2 = _time.perf_counter()
+        # stage telemetry: spans mirror into every request trace this
+        # batch serves; the histograms double-record the same intervals
+        obstrace.record_span("tpu.pack", t0, t1, stage=obstrace.PACK,
+                             reviews=len(reviews))
+        obstrace.record_span(
+            "tpu.dispatch", t1, t2, stage=obstrace.DISPATCH,
+            tier="tpu", breaker=self.breaker.state, rows=rows,
+        )
+        record_stage(PACK_M, t1 - t0, {"path": "review"})
+        record_stage(DISPATCH_M, t2 - t1, {"path": "review", "tier": "tpu"})
         c = both.shape[0] // 2
         # crow maps each ordered constraint to its group-major mask row
         # (pad block rows drop out here)
@@ -1365,9 +1387,19 @@ class TpuDriver(InterpDriver):
         # frozen memo keys computed by the probe ride along so the miss
         # path never re-freezes the same review (freeze is ~0.5ms on a
         # real Pod — pure waste twice per unique admission).
+        import time as _time
+
+        t0 = _time.perf_counter()
         probed = [self._request_memo_hit(r) for r in reviews]
         served: List = [p[0] for p in probed]
         misses = [i for i, s in enumerate(served) if s is None]
+        obstrace.record_span(
+            "memo.lookup", t0, _time.perf_counter(),
+            stage=obstrace.CACHE_LOOKUP,
+            hits=len(reviews) - len(misses), misses=len(misses),
+        )
+        record_cache("request_memo", True, len(reviews) - len(misses))
+        record_cache("request_memo", False, len(misses))
         if misses:
             evaled = self._review_batch_eval(
                 [reviews[i] for i in misses], tracing,
@@ -1409,12 +1441,7 @@ class TpuDriver(InterpDriver):
                 out = self._np_review(reviews, memo_reviews)
                 if out is not None:
                     return out
-            return [
-                self._interp_review_memo(
-                    r, memo_reviews[i] if memo_reviews else None
-                )
-                for i, r in enumerate(reviews)
-            ]
+            return self._interp_serve(reviews, memo_reviews)
         with self._lock:
             try:
                 ordered, mask, autoreject = self.compute_masks(reviews)
@@ -1444,9 +1471,11 @@ class TpuDriver(InterpDriver):
                     return self._review_batch_traced(
                         reviews, ordered, mask_np, rej_np, inventory
                     )
-                out = self._render_masked(
-                    reviews, ordered, mask_np, rej_np, inventory
-                )
+                with obstrace.span("render", stage=obstrace.RENDER,
+                                   tier="tpu"):
+                    out = self._render_masked(
+                        reviews, ordered, mask_np, rej_np, inventory
+                    )
                 # admission-sized batches feed the request memo from the
                 # device path too, so repeat content (replica/retry
                 # storms — including repeat ALLOWS, the common case)
@@ -1486,12 +1515,21 @@ class TpuDriver(InterpDriver):
         out = self._np_review(reviews, memo_reviews)
         if out is not None:
             return out
-        return [
-            self._interp_review_memo(
-                r, memo_reviews[i] if memo_reviews else None
-            )
-            for i, r in enumerate(reviews)
-        ]
+        return self._interp_serve(reviews, memo_reviews)
+
+    def _interp_serve(self, reviews: List[dict],
+                      memo_reviews: Optional[list] = None):
+        """Interpreter-tier serving with the stage span every evaluation
+        path emits: tier + breaker state make degraded traffic (breaker
+        open, compile in flight) attributable in the trace."""
+        with obstrace.span("eval.interp", stage=obstrace.RENDER,
+                           tier="interp", breaker=self.breaker.state):
+            return [
+                self._interp_review_memo(
+                    r, memo_reviews[i] if memo_reviews else None
+                )
+                for i, r in enumerate(reviews)
+            ]
 
     def _render_masked(self, reviews, ordered, mask_np, rej_np, inventory):
         """Sparse render shared by the device and host (numpy) mask paths:
@@ -1550,12 +1588,29 @@ class TpuDriver(InterpDriver):
             t_locked = _time.perf_counter()
             ns = self._np_side
             ns.sync(self)
+            t_synced = _time.perf_counter()
             got = ns.serve(self, reviews)
             if got is None:
                 return None
+            t_served = _time.perf_counter()
+            obstrace.record_span("np.pack", t_locked, t_synced,
+                                 stage=obstrace.PACK)
+            obstrace.record_span(
+                "np.eval", t_synced, t_served, stage=obstrace.DISPATCH,
+                tier="numpy", breaker=self.breaker.state,
+            )
+            record_stage(PACK_M, t_synced - t_locked, {"path": "review"})
+            record_stage(
+                DISPATCH_M, t_served - t_synced,
+                {"path": "review", "tier": "numpy"},
+            )
             ordered, mask, rej = got
             inventory = self._inventory_for_render()
-            out = self._render_masked(reviews, ordered, mask, rej, inventory)
+            with obstrace.span("render", stage=obstrace.RENDER,
+                               tier="numpy"):
+                out = self._render_masked(
+                    reviews, ordered, mask, rej, inventory
+                )
             if (
                 len(reviews) <= self.REQUEST_MEMO_BATCH_MAX
                 and self._memoable_synced()
@@ -1986,6 +2041,17 @@ class TpuDriver(InterpDriver):
             "rows": float(ap.n_rows),
             "cells": float(len(ordered) * ap.n_rows),
         }
+        obstrace.record_span("audit.pack", t0, t1, stage=obstrace.PACK,
+                             rows=ap.n_rows)
+        obstrace.record_span(
+            "audit.dispatch", t1, t2, stage=obstrace.DISPATCH,
+            tier="tpu", breaker=self.breaker.state,
+            shards=(1 if mesh is None else int(mesh.devices.size)),
+        )
+        obstrace.record_span("audit.fetch", t2, t3, stage=obstrace.FETCH,
+                             fetch_bytes=float(packed.nbytes))
+        record_stage(PACK_M, t1 - t0, {"path": "audit"})
+        record_stage(DISPATCH_M, t2 - t1, {"path": "audit", "tier": "tpu"})
         return sweep
 
     def _audit_masks(self):
@@ -2519,6 +2585,11 @@ class TpuDriver(InterpDriver):
                 new_cache[ckey] = (sig, tuple(results[start:]), totals[ckey])
         if trace is None:
             st.render_cache = new_cache
+        obstrace.record_span(
+            "audit.render", t0, _time.perf_counter(),
+            stage=obstrace.RENDER, tier="tpu",
+            rendered_cells=rendered_cells,
+        )
         self.last_sweep_stats.update(
             render_ms=(_time.perf_counter() - t0) * 1e3,
             rendered_cells=float(rendered_cells),

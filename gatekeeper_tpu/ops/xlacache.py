@@ -15,6 +15,32 @@ import logging
 log = logging.getLogger("gatekeeper.xlacache")
 
 _enabled_dir = None
+_listener_installed = False
+
+
+def _install_cache_listener():
+    """Best-effort hit/miss counters for jax's persistent compile cache:
+    jax emits monitoring events on every cache consult; mirror them into
+    the metrics catalog's cache_requests_total counter.  Silently absent
+    on jax builds without the monitoring events."""
+    global _listener_installed
+    if _listener_installed:
+        return
+    try:
+        from jax._src import monitoring
+
+        from ..metrics.catalog import record_cache
+
+        def _on_event(event, **_kw):
+            if event == "/jax/compilation_cache/cache_hits":
+                record_cache("xlacache", True)
+            elif event == "/jax/compilation_cache/cache_misses":
+                record_cache("xlacache", False)
+
+        monitoring.register_event_listener(_on_event)
+        _listener_installed = True
+    except Exception:
+        log.debug("xla cache hit/miss listener unavailable", exc_info=True)
 
 
 def enable(cache_dir: str) -> bool:
@@ -31,6 +57,7 @@ def enable(cache_dir: str) -> bool:
         log.exception("persistent XLA cache unavailable")
         return False
     _enabled_dir = cache_dir
+    _install_cache_listener()
     # best-effort: cache every executable (the fused policy programs are
     # small by XLA standards but expensive to rebuild behind a network
     # relay); absent knobs on older jax leave the dir active with defaults

@@ -23,6 +23,7 @@ from typing import Callable, List, Optional
 
 from .. import logging as gklog
 from ..deadline import DeadlineExceeded
+from ..obs import trace as obstrace
 from ..apis.config import CONFIG_NAME, GVK as CONFIG_GVK, parse_config
 from ..kube.inmem import InMemoryKube, NotFound
 from ..process.excluder import WEBHOOK, Excluder
@@ -193,6 +194,7 @@ class ValidationHandler:
             status = RESPONSE_ALLOW
             return _allowed()
         finally:
+            obstrace.set_attrs(admission_status=status)
             if self.reporter is not None:
                 self.reporter.report_request(status, time.monotonic() - t0)
 

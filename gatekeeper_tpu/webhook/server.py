@@ -20,10 +20,22 @@ from typing import Callable, List, Optional
 from .. import deadline as _deadline
 from .. import faults
 from .. import logging as gklog
+from ..metrics.catalog import (
+    WEBHOOK_QUEUE_M,
+    record_batch_size,
+    record_stage,
+)
+from ..obs import trace as obstrace
 from .namespacelabel import NamespaceLabelHandler
 from .policy import AdmissionResponse, ValidationHandler
 
 log = gklog.get("webhook.server")
+
+# paths that never produce an access log line (scrape/probe traffic —
+# the /metrics convention extended to the debug surface)
+QUIET_PATHS = ("/healthz", "/readyz", "/statusz", "/metrics")
+DEBUG_PREFIX = "/debug/"
+DEBUG_ENDPOINTS = ("/debug/traces", "/debug/stacks")
 
 
 class BatcherStopped(RuntimeError):
@@ -32,7 +44,9 @@ class BatcherStopped(RuntimeError):
 
 
 class _Pending:
-    __slots__ = ("obj", "event", "result", "error", "deadline")
+    __slots__ = (
+        "obj", "event", "result", "error", "deadline", "span", "queue_span",
+    )
 
     def __init__(self, obj, deadline: Optional[float] = None):
         self.obj = obj
@@ -40,6 +54,17 @@ class _Pending:
         self.result = None
         self.error: Optional[Exception] = None
         self.deadline = deadline  # absolute monotonic, or None
+        # explicit cross-thread context passing: the request's active span
+        # (linked by the batch span) and its open queue-wait span (ended
+        # by the batch thread when the batch is drained)
+        self.span = obstrace.current_span()
+        self.queue_span = (
+            obstrace.detached_span(
+                "webhook.queue_wait", parent=self.span,
+                stage=obstrace.QUEUE_WAIT,
+            )
+            if self.span is not None else None
+        )
 
 
 class MicroBatcher:
@@ -154,6 +179,15 @@ class MicroBatcher:
                 self._pending = self._pending[self.max_batch:]
                 last_batch_size = len(batch)
                 self._busy = True
+            # the batch is drained: queue-wait ends here for every member
+            # (deadline-refused ones included — their wait was real)
+            for p in batch:
+                if p.queue_span is not None:
+                    p.queue_span.end()
+                    record_stage(
+                        WEBHOOK_QUEUE_M,
+                        p.queue_span.stop - p.queue_span.start,
+                    )
             # refuse past-deadline work before paying a dispatch for it:
             # the waiter has already (or will imminently) time out, and
             # evaluating its review is pure wasted device time
@@ -168,11 +202,30 @@ class MicroBatcher:
                 else:
                     live.append(p)
             batch = live
+            # one batch span serving N request spans: linked to each, and
+            # every span of the batch trace (this one + the driver's stage
+            # spans) mirrors into each request trace, so request traces
+            # stay self-contained (obs/trace.py batch_span)
+            bsp = None
+            btoken = None
+            if batch:
+                record_batch_size(len(batch))
+                req_spans = [p.span for p in batch if p.span is not None]
+                if req_spans:  # un-traced batches skip span work entirely
+                    bsp = obstrace.batch_span(
+                        "webhook.batch", req_spans, batch_size=len(batch),
+                    )
+                    btoken = obstrace.CURRENT.set(bsp)
             try:
                 if batch:
                     responses = self._client.review_batch(
                         [p.obj for p in batch]
                     )
+                    if bsp is not None:
+                        obstrace.CURRENT.reset(btoken)
+                        btoken = None
+                        bsp.end()
+                        bsp = None
                     for p, resp in zip(batch, responses):
                         p.result = resp
                         p.event.set()
@@ -181,7 +234,17 @@ class MicroBatcher:
                 # poisoned review can't fail the whole window — but check
                 # each request's remaining budget first; a request whose
                 # deadline lapsed during the failed dispatch gets an
-                # explicit deadline error, not another evaluation
+                # explicit deadline error, not another evaluation.
+                # The batch span ends FIRST: fallback evaluations run under
+                # each request's OWN span, not the batch span — otherwise
+                # every fallback's stage spans would mirror into all N
+                # request traces (and keep appending after their waiters
+                # were released)
+                if bsp is not None:
+                    obstrace.CURRENT.reset(btoken)
+                    btoken = None
+                    bsp.end()
+                    bsp = None
                 for p in batch:
                     if (
                         p.deadline is not None
@@ -194,11 +257,19 @@ class MicroBatcher:
                         p.event.set()
                         continue
                     try:
-                        p.result = self._client.review(p.obj)
+                        if p.span is not None:
+                            with obstrace.use_span(p.span):
+                                p.result = self._client.review(p.obj)
+                        else:
+                            p.result = self._client.review(p.obj)
                     except Exception as e:
                         p.error = e
                     p.event.set()
             finally:
+                if btoken is not None:
+                    obstrace.CURRENT.reset(btoken)
+                if bsp is not None:
+                    bsp.end()  # idempotent on the success path
                 self._busy = False
                 last_dispatch_end = _time.monotonic()
 
@@ -283,8 +354,16 @@ class WebhookServer:
             # on, the body write stalls ~40ms behind the peer's delayed ACK
             disable_nagle_algorithm = True
 
-            def log_message(self, *args):
-                pass
+            def log_message(self, fmt, *args):
+                # access logging at DEBUG only, and never for probe/scrape
+                # paths (/healthz-style and the /debug/* surface): a
+                # misconfigured prober polling /debug/traces must not spam
+                # stderr at admission rates
+                path = (getattr(self, "path", "") or "").split("?", 1)[0]
+                if path in QUIET_PATHS or path.startswith(DEBUG_PREFIX):
+                    return
+                if log.isEnabledFor(10):  # logging.DEBUG
+                    log.debug("%s - %s", self.address_string(), fmt % args)
 
             def _send_json(self, code: int, payload: dict):
                 self._send_bytes(code, "application/json",
@@ -335,8 +414,45 @@ class WebhookServer:
                     )
                     self._send_text(200 if ready else 500,
                                     "ok" if ready else "not ready")
+                elif self.path.split("?", 1)[0].startswith(DEBUG_PREFIX):
+                    self._debug_get()
                 else:
                     self._send_text(404, "not found")
+
+            def _debug_get(self):
+                """Debug introspection surface (docs/tracing.md):
+                /debug/traces?min_ms=&limit=  recent completed traces
+                /debug/stacks                 live thread-stack dump
+                Unknown /debug paths get a JSON 404 naming the surface
+                (probes must not be mistaken for admission 404s)."""
+                from urllib.parse import parse_qs, urlsplit
+
+                parts = urlsplit(self.path)
+                if parts.path == "/debug/traces":
+                    q = parse_qs(parts.query)
+                    try:
+                        min_ms = float(q.get("min_ms", ["0"])[0])
+                        limit_s = q.get("limit", [None])[0]
+                        limit = int(limit_s) if limit_s is not None else None
+                    except ValueError:
+                        self._send_json(
+                            400, {"error": "min_ms/limit must be numeric"}
+                        )
+                        return
+                    self._send_bytes(
+                        200, "application/json",
+                        obstrace.traces_json(
+                            min_ms=min_ms, limit=limit
+                        ).encode(),
+                    )
+                elif parts.path == "/debug/stacks":
+                    self._send_json(200, obstrace.dump_stacks())
+                else:
+                    self._send_json(404, {
+                        "error": "unknown debug path",
+                        "path": parts.path,
+                        "available": list(DEBUG_ENDPOINTS),
+                    })
 
             # Admission payloads are small; a body this large is abuse or
             # corruption, never a legitimate AdmissionReview.
@@ -440,10 +556,20 @@ class WebhookServer:
                 try:
                     review = json.loads(body or b"{}")
                     req = review.get("request") or {}
-                    if self.path == "/v1/admit":
-                        resp = outer.validation_handler.handle(req)
-                    else:
-                        resp = outer.label_handler.handle(req)
+                    # W3C trace context: adopt the apiserver's trace id so
+                    # the deny log line and /debug/traces entry correlate
+                    # with the upstream request
+                    with obstrace.root_span(
+                        "admission",
+                        traceparent=self.headers.get("traceparent"),
+                        path=self.path,
+                        uid=str(req.get("uid", "")),
+                    ) as rsp:
+                        if self.path == "/v1/admit":
+                            resp = outer.validation_handler.handle(req)
+                        else:
+                            resp = outer.label_handler.handle(req)
+                        rsp.set_attrs(allowed=resp.allowed, code=resp.code)
                 except Exception as e:  # malformed envelope
                     log.exception("bad admission request")
                     resp = AdmissionResponse(False, str(e), 500)

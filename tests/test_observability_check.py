@@ -1,0 +1,56 @@
+"""Tier-1 wiring for tools/check_observability.py: the static
+observability conformance check (measures bound to views, exported
+metrics documented, monotonic span timing in hot-path modules) runs on
+every test invocation, plus unit coverage for each detector."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+import check_observability as chk  # noqa: E402
+
+
+def test_repo_is_conformant():
+    problems = chk.run_checks()
+    assert problems == []
+
+
+def test_time_time_detector_flags_unannotated_use(tmp_path, monkeypatch):
+    mod = tmp_path / "hot.py"
+    mod.write_text(
+        "import time\n"
+        "a = time.time()\n"
+        "b = time.time()  # wall-clock: ok (epoch gauge)\n"
+        "c = time.monotonic()\n"
+    )
+    monkeypatch.setattr(chk, "REPO", str(tmp_path))
+    monkeypatch.setattr(chk, "HOT_PATH_MODULES", ("hot.py",))
+    problems = chk.check_monotonic_span_timing()
+    assert len(problems) == 1
+    assert "hot.py:2" in problems[0]
+
+
+def test_undocumented_metric_detected(monkeypatch, tmp_path):
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "metrics.md").write_text("# Metrics\n\nnothing documented\n")
+    monkeypatch.setattr(chk, "REPO", str(tmp_path))
+    problems = chk.check_metrics_documented()
+    # every catalog view is now undocumented
+    from gatekeeper_tpu.metrics.catalog import catalog_views
+
+    assert len(problems) == len(catalog_views())
+
+
+def test_unbound_measure_detected(monkeypatch):
+    from gatekeeper_tpu.metrics import catalog
+    from gatekeeper_tpu.metrics.views import Measure
+
+    monkeypatch.setattr(
+        catalog, "ORPHAN_M",
+        Measure("orphan_metric", "bound to no view"),
+        raising=False,
+    )
+    problems = chk.check_measures_bound()
+    assert any("orphan_metric" in p for p in problems)

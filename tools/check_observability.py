@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Static observability conformance check (wired as a tier-1 test via
+tests/test_observability_check.py; also runnable standalone):
+
+1. Every Measure defined in gatekeeper_tpu/metrics/catalog.py is bound to
+   at least one View in catalog_views() — an unbound measure records into
+   the void and its call sites silently export nothing.
+2. Every exported metric name (view name) appears in docs/metrics.md —
+   the doc is the operator contract; an undocumented metric is either
+   missing docs or a leftover.
+3. No hot-path module times spans with the wall clock: ``time.time()`` is
+   forbidden in the listed modules unless the line carries a
+   ``wall-clock: ok`` annotation (legitimate uses are epoch timestamps
+   for export, never durations — wall time steps under NTP and would
+   corrupt span/stage math).
+
+Run: python tools/check_observability.py   (exit 0 clean, 1 with findings)
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# modules on (or adjacent to) the admission/audit hot paths where span
+# or stage timing happens; extend when instrumenting new modules
+HOT_PATH_MODULES = (
+    "gatekeeper_tpu/obs/trace.py",
+    "gatekeeper_tpu/obs/__init__.py",
+    "gatekeeper_tpu/webhook/server.py",
+    "gatekeeper_tpu/webhook/policy.py",
+    "gatekeeper_tpu/ops/driver.py",
+    "gatekeeper_tpu/ops/npside.py",
+    "gatekeeper_tpu/ops/aotcache.py",
+    "gatekeeper_tpu/ops/deltasweep.py",
+    "gatekeeper_tpu/faults/plane.py",
+    "gatekeeper_tpu/audit/manager.py",
+    "gatekeeper_tpu/metrics/catalog.py",
+    "gatekeeper_tpu/logging.py",
+)
+
+_WALL_OK = "wall-clock: ok"
+_TIME_CALL = re.compile(r"\btime\.time\(\)|\b_time\.time\(\)")
+
+
+def check_measures_bound() -> list:
+    from gatekeeper_tpu.metrics import catalog
+    from gatekeeper_tpu.metrics.views import Measure
+
+    views = catalog.catalog_views()
+    bound = {v.measure.name for v in views}
+    problems = []
+    for attr in dir(catalog):
+        m = getattr(catalog, attr)
+        if isinstance(m, Measure) and m.name not in bound:
+            problems.append(
+                f"measure {m.name!r} ({attr}) is not bound to any View in "
+                "catalog_views() — recordings against it export nothing"
+            )
+    return problems
+
+
+def check_metrics_documented() -> list:
+    from gatekeeper_tpu.metrics import catalog
+
+    doc_path = os.path.join(REPO, "docs", "metrics.md")
+    try:
+        with open(doc_path) as f:
+            doc = f.read()
+    except OSError as e:
+        return [f"docs/metrics.md unreadable: {e}"]
+    problems = []
+    for v in catalog.catalog_views():
+        if f"`{v.name}`" not in doc and v.name not in doc:
+            problems.append(
+                f"exported metric {v.name!r} is not documented in "
+                "docs/metrics.md"
+            )
+    return problems
+
+
+def check_monotonic_span_timing() -> list:
+    problems = []
+    for rel in HOT_PATH_MODULES:
+        path = os.path.join(REPO, rel)
+        try:
+            with open(path) as f:
+                lines = f.readlines()
+        except OSError as e:
+            problems.append(f"hot-path module {rel} unreadable: {e}")
+            continue
+        for i, line in enumerate(lines, 1):
+            if _TIME_CALL.search(line) and _WALL_OK not in line:
+                problems.append(
+                    f"{rel}:{i}: time.time() in a hot-path module — span/"
+                    "stage timing must use a monotonic clock "
+                    "(perf_counter/monotonic); annotate genuine epoch "
+                    f"timestamps with '# {_WALL_OK}'"
+                )
+    return problems
+
+
+def run_checks() -> list:
+    sys.path.insert(0, REPO)
+    return (
+        check_measures_bound()
+        + check_metrics_documented()
+        + check_monotonic_span_timing()
+    )
+
+
+def main() -> int:
+    problems = run_checks()
+    for p in problems:
+        print(f"check_observability: {p}", file=sys.stderr)
+    if problems:
+        print(f"check_observability: {len(problems)} problem(s)",
+              file=sys.stderr)
+        return 1
+    print("check_observability: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
