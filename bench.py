@@ -615,6 +615,174 @@ print(json.dumps({
     }
 
 
+def bench_warm_resume() -> dict:
+    """Warm resume via the state snapshot subsystem (docs/snapshots.md,
+    ISSUE 3): restart-to-first-completed-capped-sweep with a snapshot
+    (restore + RV delta resync) vs the cold rebuild (relist + intern +
+    pack), both in fresh subprocesses sharing warm XLA/AOT caches so the
+    delta is exactly what the snapshot saves.  The warm phase re-packs
+    only the churned rows — `warm_repacked_rows` in the artifact proves
+    the delta-resync-only claim."""
+    import shutil
+    import subprocess
+
+    n_t = int(os.environ.get("BENCH_WARM_TEMPLATES",
+                             os.environ.get("BENCH_TEMPLATES", "500")))
+    n_r = int(os.environ.get("BENCH_WARM_RESOURCES",
+                             os.environ.get("BENCH_RESOURCES", "100000")))
+    # churn while "down" defaults to 0.2% of the corpus, capped at the
+    # driver's delta-sweep row bound so the restored basis serves the
+    # first sweep (a pod reschedule is seconds; beyond the bound the
+    # restore still works, the first sweep is just a full dispatch)
+    churn = int(os.environ.get(
+        "BENCH_WARM_CHURN", str(max(1, min(200, n_r // 500)))))
+    cache_dir = os.environ.get(
+        "GK_XLA_CACHE",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".xla-cache"),
+    )
+    snap_dir = os.environ.get(
+        "GK_SNAPSHOT_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".snapshots-bench"),
+    )
+    shutil.rmtree(snap_dir, ignore_errors=True)
+    code = (
+        f"N_T, N_R, CHURN = {n_t}, {n_r}, {churn}\n"
+        f"CACHE, SNAP = {cache_dir!r}, {snap_dir!r}\n"
+        + r"""
+import json, os, sys, time
+sys.path.insert(0, ".")
+MODE = os.environ["BENCH_WARM_MODE"]  # populate | cold | warm
+from gatekeeper_tpu.ops import aotcache, xlacache
+xlacache.enable(CACHE)
+aotcache.enable(CACHE + "/aot")
+from gatekeeper_tpu.util.synthetic import make_pods, make_templates
+from gatekeeper_tpu.client.client import Client
+from gatekeeper_tpu.kube.inmem import InMemoryKube
+from gatekeeper_tpu.ops.driver import TpuDriver
+
+# the cluster: deterministic corpus + creation order, so every phase's
+# kube assigns identical resourceVersions (corpus build is harness cost)
+templates, constraints = make_templates(N_T)
+kube = InMemoryKube()
+for p in make_pods(N_R, 1):
+    kube.create(p)
+if MODE == "warm":
+    # churn while "down": CHURN pods move their RV past the snapshot's
+    # (an image retag — content change without widening any padded dim)
+    gvk = ("", "v1", "Pod")
+    for obj in kube.list(gvk)[:CHURN]:
+        ctrs = obj.get("spec", {}).get("containers") or [{}]
+        ctrs[0]["image"] = str(ctrs[0].get("image", "")) + "-churned"
+        kube.update(obj)
+
+out = {"mode": MODE}
+t0 = time.time()
+client = Client(driver=TpuDriver())
+# the delta path (and the basis the snapshot restores) is single-device;
+# pin it OFF the mesh so multi-device hosts measure the same thing
+client.driver.mesh_enabled = False
+if MODE in ("populate", "cold"):
+    for t in templates:
+        client.add_template(t)
+    for c in constraints:
+        client.add_constraint(c)
+    t_tmpl = time.time()
+    for gvk in kube.list_gvks():
+        for obj in kube.list(gvk):
+            client.add_data(obj)
+    t_built = time.time()
+    res, _totals = client.audit_capped(20)
+    t_ready = time.time()
+    out.update({
+        "template_ingest_s": round(t_tmpl - t0, 3),
+        "data_replay_s": round(t_built - t_tmpl, 3),
+        "first_sweep_s": round(t_ready - t_built, 3),
+        "ready_s": round(t_ready - t0, 3),
+        "violations": len(res.results()),
+    })
+    if MODE == "populate":
+        from gatekeeper_tpu.snapshot import Snapshotter
+        path = Snapshotter(client, SNAP).write_once()
+        if path is None:
+            raise RuntimeError("snapshot write failed")
+        out["snapshot_bytes"] = sum(
+            os.path.getsize(os.path.join(path, f)) for f in os.listdir(path))
+else:
+    from gatekeeper_tpu.ops.auditpack import AuditPackCache
+    from gatekeeper_tpu.snapshot import SnapshotLoader
+    packs = {"n": 0}
+    orig = AuditPackCache._pack_row
+    def counting(self, *a, **k):
+        packs["n"] += 1
+        return orig(self, *a, **k)
+    AuditPackCache._pack_row = counting
+    loader = SnapshotLoader(SNAP)
+    outcome = loader.restore(client, kube)
+    t_restored = time.time()
+    res, _totals = client.audit_capped(20)
+    t_ready = time.time()
+    stats = dict(client.driver.last_sweep_stats)
+    out.update({
+        "restore_outcome": outcome,
+        "delta_restored": loader.delta_restored,
+        "resync": loader.stats,
+        "restore_s": round(t_restored - t0, 3),
+        "first_sweep_s": round(t_ready - t_restored, 3),
+        "first_sweep_delta_rows": stats.get("delta_rows"),
+        "ready_s": round(t_ready - t0, 3),
+        "violations": len(res.results()),
+        "repacked_rows": packs["n"],
+    })
+print(json.dumps(out))
+"""
+    )
+    out = {}
+    for mode in ("populate", "cold", "warm"):
+        t0 = time.time()
+        env = dict(os.environ, BENCH_WARM_MODE=mode)
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=1800, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        if proc.returncode != 0:
+            log(f"warm_resume[{mode}] failed: {proc.stderr[-500:]}")
+            raise RuntimeError("warm_resume bench subprocess failed")
+        out[mode] = json.loads(proc.stdout.strip().splitlines()[-1])
+        log(f"warm_resume[{mode}]: {out[mode]} (wall {time.time()-t0:.1f}s)")
+    cold, warm = out["cold"], out["warm"]
+    if warm["violations"] != cold["violations"]:
+        log(
+            f"warm_resume: violation mismatch cold={cold['violations']} "
+            f"warm={warm['violations']}"
+        )
+    speedup = (
+        round(cold["ready_s"] / warm["ready_s"], 2)
+        if warm["ready_s"] > 0 else None
+    )
+    return {
+        "metric": f"warm-resume speedup to first sweep ({n_t}x{n_r})",
+        "value": speedup,
+        "unit": "x",
+        "vs_baseline": 0,
+        "warm_resume_speedup": speedup,
+        "warm_resume_ready_s": warm["ready_s"],
+        "warm_resume_first_sweep_ms": round(warm["first_sweep_s"] * 1e3, 1),
+        "warm_resume_restore_s": warm["restore_s"],
+        "warm_resume_repacked_rows": warm["repacked_rows"],
+        "warm_resume_resync": warm["resync"],
+        "warm_resume_outcome": warm["restore_outcome"],
+        "warm_resume_delta_restored": warm.get("delta_restored"),
+        "warm_resume_delta_rows": warm.get("first_sweep_delta_rows"),
+        "warm_resume_violations_match": warm["violations"] == cold["violations"],
+        "cold_ready_s": cold["ready_s"],
+        "cold_first_sweep_s": cold["first_sweep_s"],
+        "snapshot_bytes": out["populate"].get("snapshot_bytes"),
+        "churned_rows": churn,
+    }
+
+
 def bench_curve() -> dict:
     """The reference's constraint-count scaling sweep
     (policy_benchmark_test.go:269: N in {5,10,50,100,200,1000,2000}):
@@ -1374,6 +1542,7 @@ CONFIGS = {
     "ingest": bench_ingest,
     "curve": bench_curve,
     "restart": bench_restart,
+    "warm_resume": bench_warm_resume,
     "mesh": bench_mesh,
     "multihost": bench_multihost,
 }
@@ -1391,6 +1560,7 @@ _FOLDED = [
     ("batch1m", "streamed_reviews_per_s"),
     ("curve", "curve_p50_ms"),
     ("restart", "warm_restart_ready_s"),
+    ("warm_resume", "warm_resume_speedup"),
     ("mesh", "mesh_scaling_x8"),
     ("multihost", "multihost_sweep_s"),
 ]
@@ -1459,6 +1629,15 @@ def main():
             out["warm_restart_data_replay_s"] = sub.get("data_replay_s")
             out["warm_restart_first_sweep_s"] = sub.get("first_sweep_s")
             out["restart_populate_ready_s"] = sub.get("populate_ready_s")
+        if name == "warm_resume":
+            for k in (
+                "warm_resume_first_sweep_ms", "warm_resume_ready_s",
+                "warm_resume_restore_s", "warm_resume_repacked_rows",
+                "warm_resume_resync", "warm_resume_outcome",
+                "warm_resume_violations_match", "cold_ready_s",
+                "snapshot_bytes",
+            ):
+                out[k] = sub.get(k)
         if name == "ingest":
             out["ingest_p99_ms"] = sub.get("p99_ms")
             out["ingest_unique_p50_ms"] = sub.get("unique_p50_ms")

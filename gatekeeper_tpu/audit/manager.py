@@ -30,6 +30,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from datetime import datetime, timezone
 
 from .. import logging as gklog
+from ..client.drivers import constraint_match_spec
 from ..kube.inmem import GVK, InMemoryKube, NotFound
 from ..obs import trace as obstrace
 from ..process.excluder import AUDIT, Excluder
@@ -111,6 +112,7 @@ class AuditManager:
         review_batch: int = DEFAULT_REVIEW_BATCH,
         require_crd: bool = False,
         exact_totals: bool = False,
+        snapshotter=None,
     ):
         self.kube = kube
         self.client = client
@@ -137,6 +139,10 @@ class AuditManager:
         # streak, exported via Reporters.report_audit_status
         self.consecutive_failures = 0
         self.last_run_status: Optional[str] = None  # "ok" | "error"
+        # warm-resume persistence (gatekeeper_tpu/snapshot/): a completed
+        # sweep is the one moment the packed inventory is exactly synced
+        # to the store, so each success re-arms the background writer
+        self.snapshotter = snapshotter
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -177,6 +183,11 @@ class AuditManager:
         self.consecutive_failures = 0
         self.last_run_status = "ok"
         self._report_status(True)
+        if self.snapshotter is not None:
+            try:
+                self.snapshotter.notify_sweep()
+            except Exception:
+                log.exception("could not arm the snapshotter")
         return True
 
     def _report_status(self, ok: bool):
@@ -338,10 +349,7 @@ class AuditManager:
         matched = set()
         for cgvk in constraint_kinds:
             for constraint in self.kube.list(cgvk):
-                kinds_list = (
-                    ((constraint.get("spec") or {}).get("match") or {})
-                    .get("kinds")
-                )
+                kinds_list = constraint_match_spec(constraint).get("kinds")
                 if kinds_list is None:
                     return {"*"}
                 for entry in kinds_list:

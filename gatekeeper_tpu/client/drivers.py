@@ -22,8 +22,23 @@ from typing import Any, Dict, List, Optional, Protocol, Tuple
 
 from ..engine.interp import TemplatePolicy
 from ..engine.value import freeze
-from ..target.match import constraint_matches, needs_autoreject
+from ..target.match import _get, constraint_matches, needs_autoreject
 from ..target.target import K8sValidationTarget
+
+
+def constraint_parameters(constraint: dict):
+    """spec.parameters with target/match.py _get tolerance: a constraint
+    whose spec is a string/list (malformed but storable) degrades to empty
+    parameters instead of an AttributeError that would fail EVERY review
+    in the batch."""
+    return _get(_get(constraint, "spec", {}), "parameters", {})
+
+
+def constraint_match_spec(constraint: dict) -> dict:
+    """spec.match as a dict, same tolerance (every _get against a
+    non-dict match answers its default, so {} is the exact mirror)."""
+    m = _get(_get(constraint, "spec", {}), "match", {})
+    return m if isinstance(m, dict) else {}
 
 
 @dataclass
@@ -106,6 +121,9 @@ class InventoryStore:
         self.tree: Dict[str, Any] = {}
         self._frozen = None
         self._frozen_epoch: Optional[int] = None
+        # False only after a lazy snapshot restore (adopt_tree): plain-
+        # dict leaves that frozen() converts on its first call
+        self._leaves_frozen = True
         self._lock = threading.Lock()
         # monotonically increasing write epoch: lets evaluators cache
         # packed tensors across sweeps over an unchanged inventory
@@ -153,14 +171,92 @@ class InventoryStore:
                 return None
             return node.get(segments[-1])
 
+    @staticmethod
+    def _same_rv(existing: Any, obj: Any) -> bool:
+        """True when both objects carry the same non-empty
+        metadata.resourceVersion — the kube contract that their content is
+        identical.  This is what turns a restart's full list+replay into a
+        delta resync: re-delivered unchanged objects are dropped here in
+        O(1) instead of bumping the epoch and re-packing their rows."""
+        try:
+            old_rv = existing["metadata"]["resourceVersion"]
+            new_rv = obj["metadata"]["resourceVersion"]
+        except (KeyError, TypeError):
+            return False
+        return bool(old_rv) and old_rv == new_rv
+
     def put(self, segments: Tuple[str, ...], obj: Any):
         with self._lock:
             node = self.tree
             for seg in segments[:-1]:
                 node = node.setdefault(seg, {})
-            node[segments[-1]] = freeze(obj)
+            existing = node.get(segments[-1])
+            if existing is not None:
+                if self._same_rv(existing, obj):
+                    return
+                frozen = freeze(obj)
+                if frozen == existing:
+                    # RV-less sources (direct add_data): content equality
+                    # is the dedup of last resort — one O(size) compare
+                    # beats an O(size) re-pack plus device scatter
+                    return
+                node[segments[-1]] = frozen
+            else:
+                node[segments[-1]] = freeze(obj)
             self.epoch += 1
             self._log_change(tuple(segments))
+
+    def adopt_tree(self, tree: Dict[str, Any], leaves_frozen: bool = True):
+        """Snapshot restore: install a deserialized inventory tree
+        wholesale.  No epoch bump or change-log entry — the loader's
+        resync logs the actual deltas and finishes with
+        invalidate_frozen().
+
+        leaves_frozen=False defers the O(cluster) per-leaf freeze: every
+        consumer that reads individual leaves (cached_namespace, the
+        change-log _apply, iter_objects) thaws or dict-walks them anyway,
+        and frozen() — the one consumer that genuinely needs frozen
+        leaves, for data.inventory hashing — freezes them on its first
+        call (the price a later inventory-reading template install pays
+        once, mirroring _inventory_for_render's contract)."""
+        with self._lock:
+            self.tree = tree
+            self._leaves_frozen = leaves_frozen
+            self._frozen = None
+            self._frozen_epoch = None
+
+    def _freeze_leaves_locked(self):
+        """Freeze any plain-dict leaves adopted by a lazy restore; leaves
+        replaced by later put()s are frozen already and untouched."""
+
+        def walk(node: dict, depth: int):
+            for k, v in list(node.items()):
+                if depth == 1:
+                    if isinstance(v, dict):
+                        node[k] = freeze(v)
+                elif isinstance(v, dict):
+                    walk(v, depth - 1)
+
+        cluster = self.tree.get("cluster")
+        if isinstance(cluster, dict):
+            walk(cluster, 3)  # <api>/<kind>/<name>
+        namespaced = self.tree.get("namespace")
+        if isinstance(namespaced, dict):
+            walk(namespaced, 4)  # <ns>/<api>/<kind>/<name>
+        self._leaves_frozen = True
+
+    def invalidate_frozen(self):
+        """Epoch bump + cached-spine drop without a change-log entry:
+        epoch consumers (sweep caches) re-read and the next frozen() call
+        rebuilds the spine from the live tree (the restored leaves are
+        frozen already, so that is a dict-spine walk, not a re-freeze),
+        while change-log consumers see no phantom paths.  Used once at the
+        end of a snapshot restore, whose adopt_tree bypassed the epoch
+        and log."""
+        with self._lock:
+            self.epoch += 1
+            self._frozen = None
+            self._frozen_epoch = None
 
     def delete(self, segments: Tuple[str, ...]) -> bool:
         with self._lock:
@@ -189,6 +285,8 @@ class InventoryStore:
         a steady-state sweep pays O(changes), not O(cluster) — re-freezing
         100k objects costs ~200ms and used to dominate the audit loop."""
         with self._lock:
+            if not self._leaves_frozen:
+                self._freeze_leaves_locked()
             if self._frozen is not None and self._frozen_epoch == self.epoch:
                 return self._frozen
             changes = None
@@ -332,8 +430,8 @@ class InterpDriver:
 
     @staticmethod
     def _enforcement_action(constraint: dict) -> str:
-        spec = constraint.get("spec") or {}
-        action = spec.get("enforcementAction")
+        spec = constraint.get("spec")
+        action = spec.get("enforcementAction") if isinstance(spec, dict) else None
         return action if isinstance(action, str) and action else "deny"
 
     def review(self, review: dict, tracing: bool = False) -> Tuple[List[Result], Optional[str]]:
@@ -365,7 +463,7 @@ class InterpDriver:
                         trace.append(f"match {kind}/{name} = {matched}")
                     if not matched or tmpl is None:
                         continue
-                    params = (constraint.get("spec") or {}).get("parameters") or {}
+                    params = constraint_parameters(constraint)
                     violations = tmpl.policy.eval_violations(
                         frozen_review, freeze(params), inventory
                     )
@@ -411,7 +509,7 @@ class InterpDriver:
                         constraint = self.constraints[kind][cname]
                         if not constraint_matches(constraint, review, cached_ns):
                             continue
-                        params = (constraint.get("spec") or {}).get("parameters") or {}
+                        params = constraint_parameters(constraint)
                         violations = tmpl.policy.eval_violations(
                             frozen_review, freeze(params), inventory
                         )

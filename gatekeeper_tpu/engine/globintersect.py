@@ -21,10 +21,19 @@ divergences-toward-the-documented-spec are listed in docs/rego.md.
 
 Resource bounds (globs may be attacker-derived via AdmissionReview
 content): character classes are interval lists, never materialized
-per-codepoint (``[\\x20-\\U0010FFFE]`` is one (lo, hi) pair), and globs
-longer than TOKEN_CAP tokens raise GlobLimitError -> whole-query error,
-failing CLOSED like net.cidr_expand's expansion cap — a violation rule
-must not be silenced (nor the webhook wedged) by a pathological glob.
+per-codepoint (``[\\x20-\\U0010FFFE]`` is one (lo, hi) pair), and two
+caps raise GlobLimitError -> whole-query error, failing CLOSED like
+net.cidr_expand's expansion cap — a violation rule must not be silenced
+(nor the webhook wedged) by a pathological glob:
+
+- FLAGGED_TOKEN_CAP bounds only ``*``/``+``-flagged tokens.  Flags are
+  what make the product search expensive (self-loops + epsilon edges);
+  unflagged tokens advance both automata in lock-step, so a long
+  literal-only glob — a >=65-char image/registry path is routine — is
+  linear and must NOT be rejected (the former raw 64-token cap did).
+- VISIT_CAP bounds the product-BFS visited set directly, the actual
+  resource being protected, so no token-shape argument needs to be
+  airtight for the worst case to stay bounded.
 
 Tokenisation validity rules mirror the reference library so that the
 same inputs error (and the builtin call becomes undefined): stray ']',
@@ -36,7 +45,15 @@ from __future__ import annotations
 
 from typing import List, Optional, Tuple
 
-__all__ = ["GlobError", "GlobLimitError", "globs_intersect", "TOKEN_CAP"]
+__all__ = [
+    "FLAGGED_TOKEN_CAP",
+    "GlobError",
+    "GlobLimitError",
+    "TOKEN_CAP",
+    "TOTAL_TOKEN_CAP",
+    "VISIT_CAP",
+    "globs_intersect",
+]
 
 
 class GlobError(ValueError):
@@ -47,10 +64,25 @@ class GlobLimitError(ValueError):
     """Raised for globs over the resource cap (-> whole-query error)."""
 
 
-# Worst-case product-BFS work grows ~quartically in token count for
-# adversarial all-starred globs; 64 keeps that under ~100ms while being
-# far beyond any real-world match pattern.
-TOKEN_CAP = 64
+# Worst-case product-BFS work grows ~quartically in FLAGGED token count
+# for adversarial all-starred globs; 64 keeps that under ~100ms while
+# being far beyond any real-world match pattern.  Literal tokens do not
+# count: they cost O(1) BFS states each.
+FLAGGED_TOKEN_CAP = 64
+# back-compat alias (the former raw per-token cap carried this name)
+TOKEN_CAP = FLAGGED_TOKEN_CAP
+
+# Hard ceiling on product-BFS visited states — the resource actually
+# being protected.  (A+1)(B+1)*2 states for token counts A, B: two
+# 350-token all-literal globs stay well under it, while an adversarial
+# blob that somehow slips the flag cap still terminates in ~ms.
+VISIT_CAP = 250_000
+
+# Generous pre-parse bound on TOTAL tokens: without it a multi-MB blob
+# of literals allocates millions of token tuples and two multi-million-
+# state automata before either cap above can fire.  64k covers any real
+# image/registry/path literal by orders of magnitude.
+TOTAL_TOKEN_CAP = 65_536
 
 # A character class is None for '.' (any character) or a merged, sorted
 # tuple of (lo, hi) inclusive codepoint intervals — possibly empty: the
@@ -81,6 +113,7 @@ def _tokenize(pattern: str) -> List[Token]:
     chars = list(pattern)
     n = len(chars)
     i = 0
+    flagged = 0
     out: List[Token] = []
     while i < n:
         c = chars[i]
@@ -108,10 +141,17 @@ def _tokenize(pattern: str) -> List[Token]:
         if i < n and chars[i] in _FLAGS:
             flag = chars[i]
             i += 1
+            flagged += 1
+            if flagged > FLAGGED_TOKEN_CAP:
+                raise GlobLimitError(
+                    f"glob exceeds {FLAGGED_TOKEN_CAP} flagged (*/+) "
+                    f"tokens (length {len(pattern)})"
+                )
         out.append((cls, flag))
-        if len(out) > TOKEN_CAP:
+        if len(out) > TOTAL_TOKEN_CAP:
             raise GlobLimitError(
-                f"glob exceeds {TOKEN_CAP} tokens (length {len(pattern)})"
+                f"glob exceeds {TOTAL_TOKEN_CAP} tokens "
+                f"(length {len(pattern)})"
             )
     return out
 
@@ -224,6 +264,11 @@ def globs_intersect(lhs: str, rhs: str) -> bool:
     seen = {start}
     stack = [start]
     while stack:
+        if len(seen) > VISIT_CAP:
+            raise GlobLimitError(
+                f"glob intersection exceeds {VISIT_CAP} product states "
+                f"(lengths {len(lhs)}, {len(rhs)})"
+            )
         p, q, consumed = stack.pop()
         if p == a.accept and q == b.accept and consumed:
             return True

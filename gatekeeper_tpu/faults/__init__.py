@@ -26,10 +26,13 @@ WATCH_DELIVER = "watch.deliver"       # watch/manager.py pump fan-out
 TPU_COMPILE = "tpu.compile"           # ops/driver.py fused-fn (re)build
 TPU_DISPATCH = "tpu.dispatch"         # ops/driver.py device dispatch
 WEBHOOK_ENQUEUE = "webhook.enqueue"   # webhook/server.py batch queue
+SNAPSHOT_WRITE = "snapshot.write"     # snapshot/writer.py persist path
+SNAPSHOT_LOAD = "snapshot.load"       # snapshot/loader.py validate+restore
+SNAPSHOT_RESYNC = "snapshot.resync"   # snapshot/loader.py kube delta resync
 
 ALL_POINTS = (
     KUBE_SEND, KUBE_RECV, WATCH_DELIVER, TPU_COMPILE, TPU_DISPATCH,
-    WEBHOOK_ENQUEUE,
+    WEBHOOK_ENQUEUE, SNAPSHOT_WRITE, SNAPSHOT_LOAD, SNAPSHOT_RESYNC,
 )
 
 # ---- the process-global plane ----------------------------------------------
@@ -81,6 +84,9 @@ __all__ = [
     "KUBE_RECV",
     "KUBE_SEND",
     "LATENCY",
+    "SNAPSHOT_LOAD",
+    "SNAPSHOT_RESYNC",
+    "SNAPSHOT_WRITE",
     "TPU_COMPILE",
     "TPU_DISPATCH",
     "WATCH_DELIVER",

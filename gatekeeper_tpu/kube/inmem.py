@@ -194,6 +194,19 @@ class InMemoryKube:
         else:
             yield objs
 
+    def list_rvs(self, gvk: GVK) -> Dict[Tuple[str, str], str]:
+        """Metadata-only listing: {(namespace, name): resourceVersion}.
+        The real-apiserver analogue is a PartialObjectMetadata list; the
+        snapshot loader's delta resync uses this so RV-matched objects
+        never pay a body copy."""
+        with self._lock:
+            return {
+                key: str(
+                    (obj.get("metadata") or {}).get("resourceVersion") or ""
+                )
+                for key, obj in self._store.get(gvk, {}).items()
+            }
+
     def list_gvks(self) -> List[GVK]:
         """Discovery: every GVK with stored objects (the analogue of
         ServerPreferredResources in audit discovery mode)."""

@@ -144,6 +144,23 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--trace-sample-rate", type=float, default=1.0,
                    help="fraction of completed traces retained in the "
                         "/debug/traces ring (slow traces always retained)")
+    # state snapshot & warm resume (docs/snapshots.md)
+    p.add_argument("--snapshot-dir",
+                   default=os.environ.get("GK_SNAPSHOT_DIR", ""),
+                   help="directory for serving-state snapshots: a restart "
+                        "restores the packed inventory and delta-resyncs "
+                        "from the recorded resourceVersions instead of "
+                        "paying the full relist+repack cold sweep "
+                        "(empty = disabled)")
+    p.add_argument("--snapshot-interval", type=float, default=300.0,
+                   help="minimum seconds between background snapshots "
+                        "(each completed audit sweep re-arms the writer)")
+    p.add_argument("--snapshot-retain", type=int, default=3,
+                   help="completed snapshots kept on disk (older ones "
+                        "are pruned after each write)")
+    p.add_argument("--snapshot-disable", action="store_true",
+                   help="keep --snapshot-dir configured but skip both the "
+                        "startup restore and the background writer")
     p.add_argument("--fault-plane-seed", type=int, default=None,
                    help="EXPLICITLY enable the fault-injection plane with "
                         "this seed (testing only; add schedules via "
@@ -400,6 +417,7 @@ class App:
         self.metrics_addr_exporter: Optional[MetricsExporter] = None
         self.micro_batcher: Optional[MicroBatcher] = None
         self.profile_server: Optional[ProfileServer] = None
+        self.snapshotter = None
         self._stopping = False
 
     def start(self):
@@ -439,6 +457,33 @@ class App:
             self.rotator.start()
 
         self.upgrade.upgrade()  # storage-version migration before controllers
+        # warm resume BEFORE controllers start: the restored pack + interner
+        # must be in place before watch replays repopulate the store (the
+        # store's RV dedup then turns the replay into a delta resync), and
+        # before the audit manager's first sweep consumes the restored pack
+        snap_dir = getattr(args, "snapshot_dir", "")
+        if snap_dir and not getattr(args, "snapshot_disable", False):
+            from .snapshot import SnapshotLoader, Snapshotter
+
+            try:
+                outcome = SnapshotLoader(snap_dir).restore(
+                    self.client, self.kube, excluder=self.excluder
+                )
+                log.info("snapshot restore outcome: %s", outcome)
+            except Exception:
+                # restore guards internally; this is the belt over those
+                # braces — a persistence defect must never block startup
+                log.exception("snapshot restore failed; cold start")
+            self.snapshotter = Snapshotter(
+                self.client, snap_dir,
+                interval_s=getattr(args, "snapshot_interval", 300.0),
+                retain=getattr(args, "snapshot_retain", 3),
+            )
+            self.snapshotter.start()
+        elif snap_dir:
+            from .metrics.catalog import record_snapshot_outcome
+
+            record_snapshot_outcome("disabled")
         self.tracker.run(self.kube)
         self.manager.start()
 
@@ -504,6 +549,7 @@ class App:
                     self.kube, "gatekeeper-audit"
                 ),
                 gk_namespace=get_namespace(),
+                snapshotter=self.snapshotter,
             )
             self.audit_manager.start()
 
@@ -601,6 +647,7 @@ class App:
         BG_STOP.set()
         for component in (
             self.audit_manager,
+            self.snapshotter,
             self.webhook_server,
             self.health_server,
             self.metrics_exporter,

@@ -122,6 +122,29 @@ CACHE_M = Measure(
     "Evaluation-cache lookups by cache (request_memo, aotcache, xlacache) "
     "and outcome (hit, miss)",
 )
+# ---- snapshot / warm-resume subsystem (ISSUE 3) -----------------------------
+SNAPSHOT_WRITE_M = Measure(
+    "snapshot_write_seconds",
+    "Wall time to capture + persist one state snapshot (capture under the "
+    "driver lock plus serialization and the atomic rename)",
+    unit="s",
+)
+SNAPSHOT_LOAD_M = Measure(
+    "snapshot_load_seconds",
+    "Wall time of a startup snapshot restore: validation, array load and "
+    "the kube delta resync",
+    unit="s",
+)
+SNAPSHOT_BYTES_M = Measure(
+    "snapshot_bytes",
+    "On-disk size of the most recently written snapshot directory",
+    unit="By",
+)
+SNAPSHOT_RESTORE_M = Measure(
+    "snapshot_restore_outcome",
+    "Startup snapshot restore attempts by outcome (restored, fallback, "
+    "none, disabled)",
+)
 
 # bucket boundaries copied from the reference's view.Distribution calls
 _INGEST_BUCKETS = (
@@ -143,6 +166,11 @@ _STAGE_BUCKETS = (
     0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
 )
 _BATCH_SIZE_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+# snapshot write/load span ~10ms (small corpora) to tens of seconds (100k
+# rows through json+npz on a loaded node)
+_SNAPSHOT_BUCKETS = (
+    0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+)
 
 
 def catalog_views():
@@ -195,6 +223,13 @@ def catalog_views():
              tag_keys=("path", "tier"), buckets=_STAGE_BUCKETS),
         View("cache_requests_total", CACHE_M, AGG_COUNT,
              tag_keys=("cache", "outcome")),
+        View("snapshot_write_seconds", SNAPSHOT_WRITE_M, AGG_DISTRIBUTION,
+             buckets=_SNAPSHOT_BUCKETS),
+        View("snapshot_load_seconds", SNAPSHOT_LOAD_M, AGG_DISTRIBUTION,
+             buckets=_SNAPSHOT_BUCKETS),
+        View("snapshot_bytes", SNAPSHOT_BYTES_M, AGG_LAST_VALUE),
+        View("snapshot_restore_outcome_total", SNAPSHOT_RESTORE_M, AGG_COUNT,
+             tag_keys=("outcome",)),
     ]
 
 
@@ -343,6 +378,33 @@ def record_stage(measure: Measure, seconds: float,
 def record_batch_size(n: int):
     try:
         _global().record(BATCH_SIZE_M, float(n))
+    except Exception:  # pragma: no cover - telemetry never blocks eval
+        pass
+
+
+def record_snapshot_write(seconds: float, nbytes: int):
+    """One completed snapshot write (the background snapshotter records
+    without a Reporters handle).  Guarded like record_stage."""
+    try:
+        reg = _global()
+        reg.record(SNAPSHOT_WRITE_M, seconds)
+        reg.record(SNAPSHOT_BYTES_M, float(nbytes))
+    except Exception:  # pragma: no cover - telemetry never blocks eval
+        pass
+
+
+def record_snapshot_load(seconds: float):
+    try:
+        _global().record(SNAPSHOT_LOAD_M, seconds)
+    except Exception:  # pragma: no cover - telemetry never blocks eval
+        pass
+
+
+def record_snapshot_outcome(outcome: str):
+    """One restore attempt: outcome in (restored, fallback, none,
+    disabled)."""
+    try:
+        _global().record(SNAPSHOT_RESTORE_M, 1.0, {"outcome": outcome})
     except Exception:  # pragma: no cover - telemetry never blocks eval
         pass
 

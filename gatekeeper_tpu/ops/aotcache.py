@@ -17,6 +17,12 @@ Scope and safety:
   must never serve a binary whose kernel semantics changed), and a hash
   of the structure signature plus every input leaf's shape/dtype — any
   mismatch is a miss and the caller falls back to the normal jit path.
+- Entries are pickles, and unpickling attacker-supplied bytes is code
+  execution: the cache directory is created 0700 and every entry is
+  sealed with the shared HMAC scheme (util/seal.py — the same trust
+  model the snapshot manifest uses, documented in docs/snapshots.md).
+  An entry whose seal does not verify is dropped and treated as a
+  miss BEFORE any pickle byte is parsed.
 - Single-device executables only (the mesh path's device assignment
   does not survive a process restart; it stays on the jit path).
 - A deserialized executable that rejects its args is deleted and its
@@ -45,7 +51,6 @@ log = logging.getLogger("gatekeeper.aotcache")
 
 _dir: Optional[str] = None
 _lock = threading.Lock()
-_code_fp: Optional[str] = None
 
 
 def _record_cache(cache: str, hit: bool):
@@ -71,7 +76,9 @@ def _record_compile(seconds: float):
 def enable(cache_dir: str) -> bool:
     global _dir
     try:
-        os.makedirs(cache_dir, exist_ok=True)
+        from ..util import seal as _seal
+
+        _seal.secure_makedirs(cache_dir)
     except OSError:
         log.exception("aot cache dir unavailable: %s", cache_dir)
         return False
@@ -84,25 +91,37 @@ def enabled() -> bool:
 
 
 def _code_fingerprint() -> str:
-    """Digest of every source file in this package: a build whose kernel
-    code changed must never reuse an older build's executables (they
-    would silently reproduce pre-fix semantics)."""
-    global _code_fp
-    if _code_fp is None:
-        h = hashlib.sha256()
-        pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        for root, _dirs, files in sorted(os.walk(pkg)):
-            for f in sorted(files):
-                if f.endswith((".py", ".cpp")):
-                    path = os.path.join(root, f)
-                    h.update(f.encode())
-                    try:
-                        with open(path, "rb") as fh:
-                            h.update(fh.read())
-                    except OSError:
-                        pass
-        _code_fp = h.hexdigest()
-    return _code_fp
+    """Digest of every source file in this package (shared with the
+    snapshot manifest — util/seal.py): a build whose kernel code changed
+    must never reuse an older build's executables (they would silently
+    reproduce pre-fix semantics)."""
+    from ..util.seal import code_fingerprint
+
+    return code_fingerprint()
+
+
+# sealed-entry framing: one hex HMAC line, then the pickle payload
+_SEAL_HEADER_LEN = 64
+
+
+def _seal_entry(payload: bytes) -> bytes:
+    from ..util import seal as _seal
+
+    return _seal.seal(payload).encode("ascii") + b"\n" + payload
+
+
+def _open_sealed(blob: bytes) -> Optional[bytes]:
+    """Payload bytes iff the seal verifies; None otherwise (including
+    pre-seal legacy entries, which are simply re-written on next save)."""
+    if len(blob) < _SEAL_HEADER_LEN + 1 or blob[_SEAL_HEADER_LEN] != 0x0A:
+        return None
+    from ..util import seal as _seal
+
+    tag = blob[:_SEAL_HEADER_LEN].decode("ascii", "replace")
+    payload = blob[_SEAL_HEADER_LEN + 1:]
+    if not _seal.verify(payload, tag):
+        return None
+    return payload
 
 
 def _leaf_sig(x) -> str:
@@ -118,11 +137,24 @@ def load(key: str):
     path = os.path.join(_dir, key + ".aot")
     try:
         with open(path, "rb") as f:
-            payload, in_tree, out_tree = pickle.load(f)
+            blob = f.read()
     except FileNotFoundError:
         return None
     except Exception:
         log.exception("aot cache entry unreadable: %s", key)
+        return None
+    payload_bytes = _open_sealed(blob)
+    if payload_bytes is None:
+        # unauthenticated bytes are never unpickled; drop the entry so
+        # the cost is one miss, and the next save re-writes it sealed
+        log.warning("aot cache entry failed seal verification "
+                    "(dropped, treated as miss): %s", key)
+        drop(key)
+        return None
+    try:
+        payload, in_tree, out_tree = pickle.loads(payload_bytes)
+    except Exception:
+        log.exception("aot cache entry undecodable: %s", key)
         return None
     try:
         from jax.experimental import serialize_executable as se
@@ -147,7 +179,7 @@ def save(key: str, compiled) -> bool:
         path = os.path.join(_dir, key + ".aot")
         tmp = path + f".tmp.{os.getpid()}"
         with open(tmp, "wb") as f:
-            f.write(buf.getvalue())
+            f.write(_seal_entry(buf.getvalue()))
         os.replace(tmp, path)  # atomic: concurrent writers race benignly
         return True
     except Exception:

@@ -115,6 +115,40 @@ class AuditPackCache:
         # never rescans cumulative churn (advisor r3)
         self.delta_dirty: set = set()
 
+    # ---- snapshot restore (gatekeeper_tpu/snapshot/) ----------------------
+
+    def adopt_restored(self, rp, cols, col_keys, reviews, row_path, row_ns,
+                       row_gen, free, n_rows, synced_epoch):
+        """Install state deserialized from a snapshot (under the owning
+        driver's lock).  Arrays arrive writable and exactly as a previous
+        process's _rebuild/_pack_row left them; reviews and row
+        generations are restored verbatim (generations key the render
+        caches, so preserving them is what lets an unchanged constraint
+        reuse its persisted rendered results).  layout_gen bumps so
+        device copies re-place."""
+        self.rp = rp
+        self.cols = cols
+        self.col_keys = col_keys
+        self.capacity = len(next(iter(rp.values())))
+        self.n_rows = n_rows
+        self.reviews = list(reviews)
+        self.row_path = [tuple(p) if p is not None else None for p in row_path]
+        self.row_of = {
+            p: i for i, p in enumerate(self.row_path) if p is not None
+        }
+        self.row_ns = list(row_ns)
+        self.row_gen = [int(g) for g in row_gen]
+        self._gen = max(self.row_gen, default=0)
+        self.ns_rows = {}
+        for i, ns in enumerate(self.row_ns):
+            if ns:
+                self.ns_rows.setdefault(ns, set()).add(i)
+        self.free = list(free)
+        self.synced_epoch = synced_epoch
+        self.dirty = set()
+        self.delta_dirty = set()
+        self.layout_gen += 1
+
     def take_dirty(self) -> set:
         d = self.dirty
         self.dirty = set()

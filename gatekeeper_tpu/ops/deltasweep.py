@@ -200,6 +200,31 @@ class DeltaState:
         self.layout_gen = layout_gen
         self.store_epoch = store_epoch
 
+    @classmethod
+    def from_restore(cls, counts, cand, horizon, crow, K, mask_src,
+                     row_cols, render_cache, cs_epoch, layout_gen,
+                     store_epoch):
+        """Rebuild a state persisted by the snapshot subsystem
+        (gatekeeper_tpu/snapshot/): fields are installed verbatim rather
+        than derived from a fresh device reduction, so a restarted
+        process's first capped sweep can run the O(churn) delta path
+        against the restored basis instead of a full [C, R] dispatch."""
+        st = cls.__new__(cls)
+        st.K = K
+        st.counts = np.asarray(counts, np.int64).copy()
+        st.cand = [list(map(int, c)) for c in cand]
+        st.horizon = list(horizon)
+        st.row_cols = dict(row_cols)
+        st.host_mask = None
+        st.pending_mask_rows = set()
+        st.render_cache = dict(render_cache)
+        st.mask_src = mask_src
+        st.crow = np.asarray(crow, np.int64)
+        st.cs_epoch = cs_epoch
+        st.layout_gen = layout_gen
+        st.store_epoch = store_epoch
+        return st
+
     # ---- incremental update ----------------------------------------------
 
     def old_column(self, r: int) -> Optional[np.ndarray]:

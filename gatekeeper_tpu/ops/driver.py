@@ -32,7 +32,13 @@ from ..metrics.catalog import (
     record_stage,
 )
 from ..obs import trace as obstrace
-from ..client.drivers import CompiledTemplate, InterpDriver, Result
+from ..client.drivers import (
+    CompiledTemplate,
+    InterpDriver,
+    Result,
+    constraint_match_spec,
+    constraint_parameters,
+)
 from ..target.match import constraint_matches, needs_autoreject
 from ..target.target import K8sValidationTarget
 from .columns import extract_columns
@@ -477,6 +483,16 @@ class TpuDriver(InterpDriver):
 
     def put_constraint(self, kind: str, name: str, constraint: dict):
         with self._lock:
+            stored = self.constraints.get(kind, {}).get(name)
+            if stored is not constraint and stored == constraint:
+                # identical replay (controller re-list after a restart):
+                # every downstream structure keys on constraint CONTENT,
+                # so skipping the epoch bump preserves warm state — the
+                # restored delta basis and every compiled executable.
+                # The identity guard matters: re-putting the SAME dict
+                # object after mutating it in place would compare equal
+                # to itself and silently skip invalidation.
+                return
             super().put_constraint(kind, name, constraint)
             self._cs_epoch += 1
             self._memoable_update(kind, name)
@@ -852,7 +868,7 @@ class TpuDriver(InterpDriver):
             return []
         if not constraint_matches(constraint, review, self.store.cached_namespace):
             return []  # device over-approximation filtered here
-        params = (constraint.get("spec") or {}).get("parameters") or {}
+        params = constraint_parameters(constraint)
         return tmpl.policy.eval_violations(
             frozen_review, freeze(params), inventory
         )
@@ -871,7 +887,7 @@ class TpuDriver(InterpDriver):
             return False
         if getattr(tmpl.policy, "uses_inventory", True):
             return False
-        match = (constraint.get("spec") or {}).get("match") or {}
+        match = constraint_match_spec(constraint)
         return "namespaceSelector" not in match
 
     def _render_cell(
@@ -971,9 +987,10 @@ class TpuDriver(InterpDriver):
             nssel: list = []
             for entry in self._ordered_constraints():
                 _kind, _name, c = entry
-                match = (c.get("spec") or {}).get("match") or {}
-                if not isinstance(match, dict):
-                    match = {}
+                # non-dict spec/match degrade to {} (constraint_match_spec
+                # mirrors target/match.py _get): one malformed constraint
+                # must not fail every interp-path review
+                match = constraint_match_spec(c)
                 if "namespaceSelector" in match:
                     nssel.append(entry)
                 kinds = match.get("kinds")
@@ -2197,7 +2214,7 @@ class TpuDriver(InterpDriver):
             return False
         if len(prog.clauses) != 1 or prog.clauses[0].slot_iter is not None:
             return False
-        match = (constraint.get("spec") or {}).get("match") or {}
+        match = constraint_match_spec(constraint)
         return not match.get("labelSelector") and not match.get(
             "namespaceSelector"
         )

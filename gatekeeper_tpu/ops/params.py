@@ -20,7 +20,8 @@ _PRED_FNS = {
 
 
 def _walk_params(constraint: dict, ppath: Tuple[str, ...]):
-    cur = (constraint.get("spec") or {}).get("parameters")
+    spec = constraint.get("spec")
+    cur = spec.get("parameters") if isinstance(spec, dict) else None
     for seg in ppath:
         if isinstance(cur, dict) and seg in cur:
             cur = cur[seg]

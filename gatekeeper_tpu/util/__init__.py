@@ -34,7 +34,9 @@ def validate_enforcement_action(action: str) -> None:
 def get_enforcement_action(constraint: dict) -> str:
     """enforcement_action.go:29-46: default deny; anything unsupported is
     classified as 'unrecognized' (never an error)."""
-    spec = constraint.get("spec") or {}
+    spec = constraint.get("spec")
+    if not isinstance(spec, dict):
+        spec = {}
     action = spec.get("enforcementAction") or DENY
     if not isinstance(action, str):
         return UNRECOGNIZED

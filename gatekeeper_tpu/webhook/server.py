@@ -342,6 +342,22 @@ class WebhookServer:
             self._ssl_context.load_cert_chain(certfile, keyfile)
 
     def start(self):
+        # idempotent: a double start must REPLACE the previous listener
+        # and GC sweeper, not leak them — the old sweeper thread otherwise
+        # outlives the server forever, and the old socket still holds the
+        # port the new bind needs.  shutdown() only when serve_forever is
+        # actually running: on a server whose loop never started (a prior
+        # start() died mid-body) it would wait forever on the
+        # __is_shut_down event that only serve_forever sets.
+        if self._server is not None:
+            if self._thread is not None and self._thread.is_alive():
+                self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+            self._thread = None
+        if getattr(self, "_gc_stop", None) is not None:
+            self._gc_stop.set()
+            self._gc_stop = None
         self._stopping = False  # a stopped server may be restarted
         outer = self
 

@@ -72,10 +72,13 @@ class TestInvalidation:
         assert a._key((x4, x4)) != a._key((x8, x8))
 
     def test_code_fingerprint_in_key(self, cache_dir, monkeypatch):
+        from gatekeeper_tpu.util import seal
+
         x = np.ones(4, dtype=np.float32)
         a = aotcache.aot_jit(_fn, "t-code", sig="s")
         k1 = a._key((x, x))
-        monkeypatch.setattr(aotcache, "_code_fp", "different-build")
+        # the fingerprint is shared with the snapshot seal (util/seal.py)
+        monkeypatch.setattr(seal, "_code_fp", "different-build")
         b = aotcache.aot_jit(_fn, "t-code", sig="s")
         assert b._key((x, x)) != k1
 

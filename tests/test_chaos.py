@@ -621,3 +621,156 @@ class TestFaultPlane:
         assert not done.wait(0.1), "hang returned immediately"
         plane.release_hangs()
         assert done.wait(2.0), "release did not unblock the hang"
+
+
+# ---- snapshot / warm-resume chaos (ISSUE 3 satellite) -----------------------
+# Every corruption or injected fault must degrade to the COLD path — no
+# crash, no partial state — with snapshot_restore_outcome_total{outcome=
+# "fallback"} incremented and the next audit sweep still correct.
+
+import os
+
+from gatekeeper_tpu.metrics.views import global_registry
+from gatekeeper_tpu.snapshot import SnapshotLoader, Snapshotter
+from gatekeeper_tpu.snapshot import format as snapfmt
+
+from .test_snapshot import (
+    TEMPLATE as SNAP_TEMPLATE,
+    CONSTRAINT as SNAP_CONSTRAINT,
+    audit_sig,
+    build_cluster,
+    fresh_client,
+    make_client,
+    outcome_counts,
+)
+
+
+class TestSnapshotChaos:
+    def _written(self, snap_dir, n=6):
+        kube = build_cluster(n=n)
+        client = make_client(kube)
+        sig, _ = audit_sig(client)
+        snapper = Snapshotter(
+            client, str(snap_dir), capture_delta=False
+        )
+        assert snapper.write_once() is not None
+        return kube, sig
+
+    def _restore_expect_fallback(self, snap_dir, kube, cold_sig):
+        before = outcome_counts().get("fallback", 0)
+        client = fresh_client()
+        outcome = SnapshotLoader(str(snap_dir)).restore(client, kube)
+        assert outcome == "fallback"
+        assert outcome_counts().get("fallback", 0) == before + 1
+        # the cold path still produces the oracle's verdicts
+        client.add_template(SNAP_TEMPLATE)
+        client.add_constraint(SNAP_CONSTRAINT)
+        for obj in kube.list(("", "v1", "Namespace")):
+            client.add_data(obj)
+        sig, _ = audit_sig(client)
+        assert sig == cold_sig
+
+    def _corrupt(self, snap_dir, fname, mutate):
+        snap = os.path.join(
+            str(snap_dir), snapfmt.list_snapshots(str(snap_dir))[0]
+        )
+        path = os.path.join(snap, fname)
+        mutate(path)
+
+    def test_corrupt_manifest_falls_back_clean(self, tmp_path):
+        kube, sig = self._written(tmp_path)
+
+        def mutate(path):
+            blob = open(path).read()
+            open(path, "w").write(blob.replace('"schema": 1', '"schema": 9'))
+
+        self._corrupt(tmp_path, snapfmt.MANIFEST, mutate)
+        self._restore_expect_fallback(tmp_path, kube, sig)
+
+    def test_truncated_array_falls_back_clean(self, tmp_path):
+        kube, sig = self._written(tmp_path)
+
+        def mutate(path):
+            blob = open(path, "rb").read()
+            open(path, "wb").write(blob[: max(1, len(blob) // 3)])
+
+        self._corrupt(tmp_path, snapfmt.ARRAYS, mutate)
+        self._restore_expect_fallback(tmp_path, kube, sig)
+
+    def test_wrong_hmac_falls_back_clean(self, tmp_path):
+        kube, sig = self._written(tmp_path)
+
+        def mutate(path):
+            manifest = json.load(open(path))
+            manifest["hmac"] = "f" * 64
+            json.dump(manifest, open(path, "w"))
+
+        self._corrupt(tmp_path, snapfmt.MANIFEST, mutate)
+        self._restore_expect_fallback(tmp_path, kube, sig)
+
+    def test_stale_resource_versions_fall_back_clean(self, tmp_path):
+        kube, _sig = self._written(tmp_path)
+        gvk = ("", "v1", "Namespace")
+        for obj in kube.list(gvk):  # every RV moves while "down"
+            obj["metadata"]["labels"]["churn"] = "y"
+            kube.update(obj)
+        before = outcome_counts().get("fallback", 0)
+        client = fresh_client()
+        outcome = SnapshotLoader(str(tmp_path)).restore(client, kube)
+        assert outcome == "fallback"
+        assert outcome_counts().get("fallback", 0) == before + 1
+        warm_sig, _ = audit_sig(client)  # safe: everything re-packs
+        oracle = make_client(kube)
+        cold_sig, _ = audit_sig(oracle)
+        assert warm_sig == cold_sig
+
+    def test_injected_load_fault_falls_back(self, tmp_path, fault_plane):
+        kube, sig = self._written(tmp_path)
+        fault_plane.add(faults.SNAPSHOT_LOAD, FaultRule(mode="error"))
+        self._restore_expect_fallback(tmp_path, kube, sig)
+
+    def test_injected_resync_fault_wipes_to_cold(self, tmp_path, fault_plane):
+        kube, sig = self._written(tmp_path)
+        fault_plane.add(faults.SNAPSHOT_RESYNC, FaultRule(mode="error"))
+        before = outcome_counts().get("fallback", 0)
+        client = fresh_client()
+        outcome = SnapshotLoader(str(tmp_path)).restore(client, kube)
+        assert outcome == "fallback"
+        assert outcome_counts().get("fallback", 0) == before + 1
+        # mid-restore failure wiped the partial state: the store is empty
+        # and the cold path rebuilds to the oracle verdicts
+        assert client.driver._audit_pack.rp is None
+        client.add_template(SNAP_TEMPLATE)
+        client.add_constraint(SNAP_CONSTRAINT)
+        for obj in kube.list(("", "v1", "Namespace")):
+            client.add_data(obj)
+        cold_sig, _ = audit_sig(client)
+        assert cold_sig == sig
+
+    def test_injected_write_fault_leaves_no_partial_snapshot(
+        self, tmp_path, fault_plane
+    ):
+        kube = build_cluster(n=4)
+        client = make_client(kube)
+        audit_sig(client)
+        fault_plane.add(
+            faults.SNAPSHOT_WRITE, FaultRule(mode="error", count=1)
+        )
+        snapper = Snapshotter(client, str(tmp_path), capture_delta=False)
+        assert snapper.write_once() is None
+        assert snapper.last_error
+        # no partial or temp dirs survive a failed write
+        leftovers = [
+            n for n in os.listdir(str(tmp_path))
+            if n.startswith(snapfmt.TMP_PREFIX)
+        ]
+        assert leftovers == []
+        assert snapfmt.list_snapshots(str(tmp_path)) == []
+        # the audit loop is unaffected by persistence failures
+        mgr = AuditManager(
+            kube, client, from_cache=True, snapshotter=snapper,
+        )
+        assert mgr.run_once_guarded() is True
+        # and the retry (fault exhausted) succeeds
+        snapper._last_write = 0.0
+        assert snapper.write_once() is not None

@@ -15,7 +15,9 @@ import pytest
 
 from gatekeeper_tpu.engine.builtins import BuiltinError, BuiltinLimitError
 from gatekeeper_tpu.engine.globintersect import (
+    FLAGGED_TOKEN_CAP,
     TOKEN_CAP,
+    VISIT_CAP,
     GlobError,
     GlobLimitError,
     globs_intersect,
@@ -158,12 +160,53 @@ class TestResourceBounds:
         assert globs_intersect(g1, g2) is False
         assert time.perf_counter() - t0 < 1.0
 
-    def test_token_cap_fails_closed(self):
-        g = "a" * (TOKEN_CAP + 1)
+    def test_flagged_token_cap_fails_closed(self):
+        g = "a*" * (FLAGGED_TOKEN_CAP + 1)
         with pytest.raises(GlobLimitError):
             globs_intersect(g, "a")
         with pytest.raises(BuiltinLimitError):
             run_bi("regex.globs_match", g, "a")
+        # '+' flags count against the same cap
+        g_plus = "a+" * (FLAGGED_TOKEN_CAP + 1)
+        with pytest.raises(GlobLimitError):
+            globs_intersect(g_plus, "a")
+
+    def test_long_literal_globs_are_not_capped(self):
+        # >=65-char literal image/registry paths are routine; the former
+        # raw 64-token cap rejected them (ISSUE 3 satellite regression)
+        path = (
+            "registry.internal.example.com/platform/production/"
+            "billing-service/sidecar-proxy:v2.31.7-rc.4"
+        )
+        assert len(path) > TOKEN_CAP
+        assert globs_intersect(path, path) is True
+        assert run_bi("regex.globs_match", path, path) is True
+        # and a literal long glob against a flagged pattern still works
+        assert globs_intersect(path, "registry.internal..*") is True
+        assert globs_intersect("x" * 500, "x" * 500) is True
+        assert globs_intersect("x" * 500, "x" * 499) is False
+
+    def test_literal_flag_mix_under_cap_ok(self):
+        g = "a" * 200 + "b*"  # one flagged token, many literals
+        assert globs_intersect(g, "a" * 200) is True
+
+    def test_total_token_cap_bounds_preparse_work(self):
+        from gatekeeper_tpu.engine.globintersect import TOTAL_TOKEN_CAP
+
+        g = "a" * (TOTAL_TOKEN_CAP + 1)
+        t0 = time.perf_counter()
+        with pytest.raises(GlobLimitError):
+            globs_intersect(g, "a")
+        # the cap fires during tokenization, before any automaton builds
+        assert time.perf_counter() - t0 < 2.0
+
+    def test_visit_cap_bounds_product_bfs(self):
+        # two huge literal globs sharing a '.'-prefix explore linearly —
+        # far under VISIT_CAP — while the guard stays cheap to evaluate
+        t0 = time.perf_counter()
+        assert globs_intersect("." * 400 + "a", "." * 400 + "a") is True
+        assert time.perf_counter() - t0 < 2.0
+        assert VISIT_CAP >= (FLAGGED_TOKEN_CAP + 1) ** 2
 
 
 class TestDifferentialOracle:
