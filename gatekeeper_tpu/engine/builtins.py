@@ -953,17 +953,30 @@ def _bits_negate(a):
     return ~_int_arg(a, "bits.negate")
 
 
-def _shift_arg(n: Any, who: str) -> int:
+_SHIFT_CAP = 1 << 20
+
+
+def _shift_arg(n: Any, who: str, compat_exact: bool = False) -> int:
     """Shift counts must be non-negative (Python << raises ValueError,
     which would surface as a whole-query error instead of OPA's
     builtin-error -> undefined) and bounded (bits.lsh(1, 10**9) would
     allocate a gigantic int).  Negative counts are a plain builtin error
     (undefined, matching OPA); over-cap counts fail CLOSED via
     BuiltinLimitError, like net.cidr_expand's cap — a violation rule must
-    not silently stop firing because an attacker passed a huge shift."""
+    not silently stop firing because an attacker passed a huge shift.
+    Under GK_BUG_COMPAT (engine/compat.py) an over-cap count degrades to
+    a plain builtin error (undefined, OPA's never-abort error contract) —
+    or, with compat_exact (bits.rsh, where the result only shrinks), is
+    returned as-is for the caller to clamp and compute exactly."""
     v = _int_arg(n, who)
     _need(v >= 0, f"{who}: negative shift count")
-    if v > 1 << 20:
+    if v > _SHIFT_CAP:
+        from .compat import bug_compat_enabled
+
+        if bug_compat_enabled():
+            if compat_exact:
+                return v
+            raise BuiltinError(f"{who}: shift count {v} exceeds cap 2^20")
         raise BuiltinLimitError(f"{who}: shift count {v} exceeds cap 2^20")
     return v
 
@@ -975,7 +988,11 @@ def _bits_lsh(a, n):
 
 @builtin("bits", "rsh")
 def _bits_rsh(a, n):
-    return _int_arg(a, "bits.rsh") >> _shift_arg(n, "bits.rsh")
+    v = _int_arg(a, "bits.rsh")
+    count = _shift_arg(n, "bits.rsh", compat_exact=True)
+    # clamping to the bit length keeps Python from allocating an
+    # over-cap count while preserving the exact (OPA) result
+    return v >> min(count, v.bit_length() + 1)
 
 
 # ---- objects / json documents --------------------------------------------
@@ -2051,6 +2068,14 @@ def _regex_globs_match(g1: Any, g2: Any):
 
     _need(isinstance(g1, str), "regex.globs_match: not a string")
     _need(isinstance(g2, str), "regex.globs_match: not a string")
+    if g1 == "" and g2 == "":
+        # the vendored library answers true for two empty globs (their
+        # only common string is empty, so the documented "non-empty"
+        # semantics say false); GK_BUG_COMPAT restores the library answer
+        from .compat import bug_compat_enabled
+
+        if bug_compat_enabled():
+            return True
     try:
         return globs_intersect(g1, g2)
     except GlobLimitError as e:

@@ -1,0 +1,38 @@
+"""Reference bug-compatibility switch (GK_BUG_COMPAT).
+
+This engine deliberately diverges from the reference/OPA in a few
+documented places (docs/rego.md "Known divergences") where the reference
+behavior is a bug or a DoS hazard.  Deployments migrating from the
+reference sometimes need the old behavior bit-for-bit; GK_BUG_COMPAT=1
+switches the divergences that can be emulated safely:
+
+- ``regex.globs_match("", "")`` answers **true** (the vendored
+  glob-intersection library's answer for two empty globs; default: false,
+  since the only shared string is empty and OPA documents "non-empty").
+- ``bits.rsh`` accepts arbitrarily large shift counts and computes the
+  exact result (a right shift only shrinks; default: counts above 2^20
+  raise the fail-closed limit error).
+- ``bits.lsh`` over-cap counts degrade to a plain builtin error
+  (expression undefined — OPA's error contract never aborts the query)
+  instead of the fail-closed whole-query error.  The magnitude cap itself
+  stays: materializing a shifted-by-10^9 integer is an allocation bomb no
+  compat flag should re-enable.
+
+The greedy-scan **false negatives** of the vendored library
+(``"a*"`` vs ``"a*b*"`` -> false there, though ``"a"`` is in both glob
+languages) are NOT emulated: reproducing the library's scan bug-for-bug
+would mean vendoring the bug, and a false negative only ever *widens*
+what a policy permits.  The divergence is pinned by explicit assertions
+instead (tests/test_bug_compat.py), so a silent behavior drift fails CI.
+
+The flag is read per call (cheap: one dict lookup) so tests can flip it
+without re-importing; production sets it once in the environment.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def bug_compat_enabled() -> bool:
+    return os.environ.get("GK_BUG_COMPAT", "0") == "1"

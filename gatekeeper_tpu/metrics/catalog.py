@@ -122,6 +122,13 @@ CACHE_M = Measure(
     "Evaluation-cache lookups by cache (request_memo, aotcache, xlacache) "
     "and outcome (hit, miss)",
 )
+# ---- compiled violation rendering (ISSUE 4) ---------------------------------
+RENDER_CELLS_M = Measure(
+    "render_cells",
+    "Violation-candidate cells rendered, by plan tier: static (bind-time "
+    "constant message), slots (compiled field-gather message), interp "
+    "(interpreter fallback)",
+)
 # ---- snapshot / warm-resume subsystem (ISSUE 3) -----------------------------
 SNAPSHOT_WRITE_M = Measure(
     "snapshot_write_seconds",
@@ -223,6 +230,8 @@ def catalog_views():
              tag_keys=("path", "tier"), buckets=_STAGE_BUCKETS),
         View("cache_requests_total", CACHE_M, AGG_COUNT,
              tag_keys=("cache", "outcome")),
+        View("render_cells_total", RENDER_CELLS_M, AGG_COUNT,
+             tag_keys=("plan",)),
         View("snapshot_write_seconds", SNAPSHOT_WRITE_M, AGG_DISTRIBUTION,
              buckets=_SNAPSHOT_BUCKETS),
         View("snapshot_load_seconds", SNAPSHOT_LOAD_M, AGG_DISTRIBUTION,
@@ -405,6 +414,22 @@ def record_snapshot_outcome(outcome: str):
     disabled)."""
     try:
         _global().record(SNAPSHOT_RESTORE_M, 1.0, {"outcome": outcome})
+    except Exception:  # pragma: no cover - telemetry never blocks eval
+        pass
+
+
+def record_render_cells(counts: Dict[str, int]):
+    """One render pass's cell counts by plan tier ({tier: n}); the driver
+    accumulates per-cell increments locally and flushes once per pass so
+    the render hot loop never pays a registry record per cell.  Guarded
+    like record_stage."""
+    try:
+        reg = _global()
+        for tier, n in counts.items():
+            if n > 0:
+                reg.record(
+                    RENDER_CELLS_M, float(n), {"plan": tier}, count=n
+                )
     except Exception:  # pragma: no cover - telemetry never blocks eval
         pass
 

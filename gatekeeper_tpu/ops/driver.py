@@ -4,12 +4,16 @@ Pipeline per Review/Audit:
   1. pack reviews + constraints to integer tensors (host, incremental interner)
   2. device: match kernel -> bool[C, R]; per-kind violation programs
      (vectorizer output) -> bool[C_k, R]; combined candidate mask
-  3. host: for each positive cell, exact native match re-check + interpreter
-     violation rendering (messages/details) — the over-approximation filter
+  3. host: for each positive cell, exact native match re-check + violation
+     rendering — via the compiled render plan (ops/renderplan.py: exact
+     direct-value evaluation + message assembly, the bulk path) when the
+     template's program is exact and its message AST compiled, else the
+     interpreter (the residual tail, drained by a bounded worker pool)
 
 Correctness therefore never depends on the device mask being tight — only
 throughput does.  Templates with no vectorized program get all-true columns
-(pure interpreter fallback for their cells).
+(pure interpreter fallback for their cells).  Per-cell render tiers are
+exported as render_cells_total{plan=static|slots|interp}.
 """
 
 from __future__ import annotations
@@ -29,6 +33,7 @@ from ..metrics.catalog import (
     DISPATCH_M,
     PACK_M,
     record_cache,
+    record_render_cells,
     record_stage,
 )
 from ..obs import trace as obstrace
@@ -66,6 +71,16 @@ def _tree_sig(tree):
 
 
 _REDUCTION_BLOCK = 64
+
+# _bound_plans miss sentinel (None is a valid cached "no plan")
+_PLAN_MISS = object()
+
+
+def _constraint_name(constraint: dict) -> str:
+    md = constraint.get("metadata")
+    if isinstance(md, dict):
+        return str(md.get("name", ""))
+    return ""
 
 
 def _packed_reduction(mask, K: int):
@@ -145,14 +160,12 @@ def _strip_request_meta(frozen_review):
     """The memo key for a review: identical content minus per-request
     metadata (uid), so repeated admissions of the same object hit the
     memo despite fresh uids.  memo_safe policies provably never read
-    the stripped fields (engine/interp.py _validate)."""
-    from ..engine.value import FrozenDict
+    the stripped fields (engine/interp.py _validate).  ONE implementation
+    shared with RowView.memo_frozen — both feed the same _review_memo, so
+    the key normalization must never diverge."""
+    from .renderplan import strip_request_meta
 
-    if isinstance(frozen_review, FrozenDict) and "uid" in frozen_review:
-        return FrozenDict(
-            {k: frozen_review[k] for k in frozen_review._d if k != "uid"}
-        )
-    return frozen_review
+    return strip_request_meta(frozen_review)
 
 
 class TpuDriver(InterpDriver):
@@ -199,6 +212,20 @@ class TpuDriver(InterpDriver):
         self._audit_pack = AuditPackCache()
         self._render_memo: Dict[Tuple, Tuple[int, list]] = {}
         self._render_memo_epoch = -1
+        # compiled render plans (ops/renderplan.py) bound per constraint:
+        # (kind, name) -> BoundPlan | None, valid for one constraint-side
+        # epoch.  GK_RENDER_PLAN=0 forces every cell to the interpreter.
+        self.render_plan_enabled = os.environ.get("GK_RENDER_PLAN", "1") != "0"
+        self._bound_plans: Dict[Tuple[str, str], object] = {}
+        self._bound_plans_epoch = -1
+        self._uses_inventory_cache: Optional[Tuple[int, bool]] = None
+        self._n_constraints_cache: Optional[Tuple[int, int]] = None
+        # per-pass render-tier counters, flushed to
+        # render_cells_total{plan=...} at each render-pass boundary so the
+        # hot loop pays a dict increment, not a registry record, per cell
+        self._tier_counts = {"static": 0, "slots": 0, "interp": 0}
+        # per-pass render instrumentation (read by bench.py's render config)
+        self.last_render_stats: Dict[str, float] = {}
         # review-path render memo, keyed by CONTENT (kind, constraint name,
         # frozen review): admission streams are full of identical objects
         # (deployment replicas, retried requests), and an unchanged
@@ -522,6 +549,8 @@ class TpuDriver(InterpDriver):
 
             self._audit_pack = AuditPackCache()
             self._render_memo.clear()
+            self._bound_plans.clear()
+            self._bound_plans_epoch = -1
             self._audit_cache = None
             self._audit_dev = None  # layout gens restart with the new pack
             self._audit_dev_mesh = None
@@ -854,13 +883,70 @@ class TpuDriver(InterpDriver):
 
     # ---- render (exactness filter) ---------------------------------------
 
+    def _render_plan_for(self, kind: str, name: str, constraint: dict):
+        """The constraint's bound render plan (ops/renderplan.py), or None
+        when the template is plan-ineligible.  Cached per constraint-side
+        epoch (binding is cheap but not free; rendering a drifted cluster
+        touches every constraint).  Caller holds the lock."""
+        if not self.render_plan_enabled:
+            return None
+        if self._bound_plans_epoch != self._cs_epoch:
+            self._bound_plans.clear()
+            self._bound_plans_epoch = self._cs_epoch
+        key = (kind, name)
+        got = self._bound_plans.get(key, _PLAN_MISS)
+        if got is not _PLAN_MISS:
+            return got
+        plan = None
+        tmpl = self.templates.get(kind)
+        prog = self.programs.get(kind)
+        if tmpl is not None and prog is not None:
+            from . import renderplan
+
+            try:
+                plan = renderplan.bind(prog, tmpl.policy, constraint)
+            except Exception:  # a plan bug must degrade, never fail a cell
+                log.exception("render-plan bind failed for %s/%s", kind, name)
+                plan = None
+        self._bound_plans[key] = plan
+        return plan
+
+    def _render_plan_tiers(self) -> Dict[str, str]:
+        """Per-constraint render-plan classification ("kind/name" ->
+        tier), shared by the snapshot writer (persists it in the sweep
+        basis) and loader (validates the rebuilt classification against
+        it).  Caller holds the lock."""
+        out: Dict[str, str] = {}
+        for kind, name, constraint in self._ordered_constraints():
+            try:
+                plan = self._render_plan_for(kind, name, constraint)
+            except Exception:
+                plan = None
+            out[f"{kind}/{name}"] = (
+                plan.tier if plan is not None else "interp"
+            )
+        return out
+
+    def _flush_render_counts(self):
+        """Export the pass's per-tier cell counts to
+        render_cells_total{plan=...} (one registry record per tier per
+        pass, not per cell)."""
+        counts = self._tier_counts
+        if counts["static"] or counts["slots"] or counts["interp"]:
+            record_render_cells(counts)
+            self._tier_counts = {"static": 0, "slots": 0, "interp": 0}
+
     def _eval_cell(
         self, constraint: dict, kind: str, review: dict, frozen_review,
-        inventory,
+        inventory, rowview=None, allow_plan: bool = True,
+        count: bool = True,
     ) -> list:
         """Exact evaluation of one (constraint, review) cell: native match
-        re-check + interpreter violation rendering.  Returns the violation
-        dicts ([] when the device mask over-approximated)."""
+        re-check + violation rendering — via the compiled render plan when
+        this constraint has one (byte-identical to the interpreter by
+        construction, tests/test_render_parity.py), else the interpreter.
+        Returns the violation dicts ([] when the device mask
+        over-approximated)."""
         from ..engine.value import freeze
 
         tmpl = self.templates.get(kind)
@@ -868,7 +954,25 @@ class TpuDriver(InterpDriver):
             return []
         if not constraint_matches(constraint, review, self.store.cached_namespace):
             return []  # device over-approximation filtered here
+        if allow_plan:
+            plan = self._render_plan_for(
+                kind, _constraint_name(constraint), constraint
+            )
+            if plan is not None:
+                if rowview is None:
+                    from .renderplan import RowView
+
+                    rowview = RowView(review, frozen_review)
+                if count:
+                    self._tier_counts[plan.tier] += 1
+                return plan.apply(rowview)
+        if count:
+            self._tier_counts["interp"] += 1
         params = constraint_parameters(constraint)
+        if frozen_review is None:
+            frozen_review = (
+                rowview.frozen() if rowview is not None else freeze(review)
+            )
         return tmpl.policy.eval_violations(
             frozen_review, freeze(params), inventory
         )
@@ -890,17 +994,10 @@ class TpuDriver(InterpDriver):
         match = constraint_match_spec(constraint)
         return "namespaceSelector" not in match
 
-    def _render_cell(
-        self,
-        results: List[Result],
-        constraint: dict,
-        kind: str,
-        review: dict,
-        frozen_review,
-        inventory,
-        tracing_log,
-        memo_review=None,
-    ):
+    def _cell_violations(
+        self, constraint: dict, kind: str, review: dict, frozen_review,
+        inventory, memo_review=None, rowview=None,
+    ) -> list:
         # content-keyed memo: identical (constraint, object) cells render
         # identically while the constraint side is unchanged, PROVIDED the
         # cell depends only on its inputs: excluded are templates reading
@@ -917,12 +1014,15 @@ class TpuDriver(InterpDriver):
                 self._review_memo.clear()
                 self._review_memo_epoch = self._cs_epoch
             if memo_review is None:
-                memo_review = frozen_review
-            mkey = (kind, constraint["metadata"].get("name", ""), memo_review)
+                if frozen_review is None:
+                    frozen_review = rowview.frozen()
+                memo_review = _strip_request_meta(frozen_review)
+            mkey = (kind, _constraint_name(constraint), memo_review)
             violations = self._review_memo.get(mkey)
             if violations is None:
                 violations = self._eval_cell(
-                    constraint, kind, review, frozen_review, inventory
+                    constraint, kind, review, frozen_review, inventory,
+                    rowview,
                 )
                 # bounded: unique objects (pod names) make keys unbounded
                 # on a busy cluster; clearing 16k entries is ~ms, far below
@@ -932,8 +1032,37 @@ class TpuDriver(InterpDriver):
                 self._review_memo[mkey] = violations
         else:
             violations = self._eval_cell(
-                constraint, kind, review, frozen_review, inventory
+                constraint, kind, review, frozen_review, inventory, rowview
             )
+        return violations
+
+    def _render_cell(
+        self,
+        results: List[Result],
+        constraint: dict,
+        kind: str,
+        review: dict,
+        frozen_review,
+        inventory,
+        tracing_log,
+        memo_review=None,
+        rowview=None,
+    ):
+        violations = self._cell_violations(
+            constraint, kind, review, frozen_review, inventory,
+            memo_review=memo_review, rowview=rowview,
+        )
+        self._append_violation_results(
+            results, violations, constraint, kind, review, tracing_log
+        )
+
+    def _append_violation_results(self, results, violations, constraint,
+                                  kind, review, tracing_log=None):
+        """The ONE violation-dict -> Result shaping (msg/str coercion,
+        details default, per-constraint enforcement action), shared by
+        the per-cell and bulk masked render paths."""
+        if not violations:
+            return
         action = self._enforcement_action(constraint)
         for v in violations:
             results.append(
@@ -1036,11 +1165,20 @@ class TpuDriver(InterpDriver):
         sweep skips freezing the whole cluster tree (O(cluster), ~5s at
         20k objects — the dominant share of warm-restart time for
         inventory-free corpora).  Templates that do read inventory keep
-        the full (incrementally re-spined) snapshot."""
-        if any(
-            getattr(t.policy, "uses_inventory", True)
-            for t in self.templates.values()
-        ):
+        the full (incrementally re-spined) snapshot.  The any-template
+        scan is cached per constraint-side epoch: it ran per np-served
+        review, which at 500 installed templates was a measurable slice
+        of the admission path."""
+        cached = self._uses_inventory_cache
+        if cached is not None and cached[0] == self._cs_epoch:
+            uses = cached[1]
+        else:
+            uses = any(
+                getattr(t.policy, "uses_inventory", True)
+                for t in self.templates.values()
+            )
+            self._uses_inventory_cache = (self._cs_epoch, uses)
+        if uses:
             return self.store.frozen()
         from ..engine.value import freeze
 
@@ -1067,6 +1205,9 @@ class TpuDriver(InterpDriver):
             self.last_review_stats = {
                 "lock_wait_ms": (t_locked - t_enter) * 1e3,
             }
+            # the interp walk has no masked render pass: stale stats from
+            # a previous _render_masked must not be re-read by bench
+            self.last_render_stats = {}
             inventory = self._inventory_for_render()
             cached_ns = self.store.cached_namespace
             if memo_key is not None:
@@ -1077,6 +1218,9 @@ class TpuDriver(InterpDriver):
             # synced under THIS lock hold: the store below must never run
             # on a memoable verdict from a pre-epoch-bump constraint side
             memoable = self._memoable_synced()
+            from .renderplan import RowView
+
+            rowview = RowView(review, frozen_review)
             results: List[Result] = []
             for kind, name, constraint in self._gvk_walk_list(review):
                 if needs_autoreject(constraint, review, cached_ns):
@@ -1097,9 +1241,11 @@ class TpuDriver(InterpDriver):
                 self._render_cell(
                     results, constraint, kind, review, frozen_review,
                     inventory, None, memo_review=memo_review,
+                    rowview=rowview,
                 )
             if memoable:
                 self._store_request_memo(review, results, memo_review)
+            self._flush_render_counts()
             self.last_review_stats["eval_ms"] = (
                 _time.perf_counter() - t_locked) * 1e3
             return results, None
@@ -1166,7 +1312,7 @@ class TpuDriver(InterpDriver):
             return out, memo_key
 
     def _eval_one_key(self, kind, name, review, frozen_review, memo_review,
-                      inventory, cached_ns):
+                      inventory, cached_ns, rowview=None):
         """Evaluate a single constraint for the request memo's repair
         path: the same autoreject + render walk _interp_review_memo runs
         per key, returning the memoized tuple list (None when the
@@ -1186,7 +1332,7 @@ class TpuDriver(InterpDriver):
             )
         self._render_cell(
             out, constraint, kind, review, frozen_review, inventory, None,
-            memo_review=memo_review,
+            memo_review=memo_review, rowview=rowview,
         )
         return [
             (r.msg, copy.deepcopy((r.metadata or {}).get("details", {})),
@@ -1203,6 +1349,9 @@ class TpuDriver(InterpDriver):
         the entry (caller falls back to a full evaluation)."""
         if entry_epoch < self._cs_log_floor:
             return None
+        from .renderplan import RowView
+
+        rowview = RowView(review, frozen_review)
         changed_kinds = set()
         changed_keys = set()
         for ep, kind, name in reversed(self._cs_change_log):
@@ -1219,7 +1368,7 @@ class TpuDriver(InterpDriver):
             for name in self.constraints.get(kind, {}):
                 res = self._eval_one_key(
                     kind, name, review, frozen_review, memo_review,
-                    inventory, cached_ns,
+                    inventory, cached_ns, rowview=rowview,
                 )
                 if res:
                     per_key[(kind, name)] = res
@@ -1228,12 +1377,13 @@ class TpuDriver(InterpDriver):
                 continue
             res = self._eval_one_key(
                 kind, name, review, frozen_review, memo_review, inventory,
-                cached_ns,
+                cached_ns, rowview=rowview,
             )
             if res:
                 per_key[(kind, name)] = res
             else:
                 per_key.pop((kind, name), None)
+        self._flush_render_counts()
         return per_key
 
     # Below this many constraint x review cells the device dispatch costs
@@ -1431,7 +1581,15 @@ class TpuDriver(InterpDriver):
         """Route and evaluate (no memo probe: review_batch already served
         the hits)."""
         with self._lock:  # concurrent ingest may resize the dicts (RLock)
-            n_constraints = sum(len(v) for v in self.constraints.values())
+            # cached per epoch: summing 500 kinds per admission is real
+            cached = self._n_constraints_cache
+            if cached is not None and cached[0] == self._cs_epoch:
+                n_constraints = cached[1]
+            else:
+                n_constraints = sum(
+                    len(v) for v in self.constraints.values()
+                )
+                self._n_constraints_cache = (self._cs_epoch, n_constraints)
         route = self._route_eval(len(reviews) * max(n_constraints, 1))
         if route != "device" or (
             # async ingestion: while the background XLA compile for the
@@ -1491,7 +1649,8 @@ class TpuDriver(InterpDriver):
                 with obstrace.span("render", stage=obstrace.RENDER,
                                    tier="tpu"):
                     out = self._render_masked(
-                        reviews, ordered, mask_np, rej_np, inventory
+                        reviews, ordered, mask_np, rej_np, inventory,
+                        memo_keys=memo_reviews,
                     )
                 # admission-sized batches feed the request memo from the
                 # device path too, so repeat content (replica/retry
@@ -1548,24 +1707,134 @@ class TpuDriver(InterpDriver):
                 for i, r in enumerate(reviews)
             ]
 
-    def _render_masked(self, reviews, ordered, mask_np, rej_np, inventory):
-        """Sparse render shared by the device and host (numpy) mask paths:
-        iterate only (review, constraint) cells the mask marked positive,
-        review-major so per-review result ordering matches the dense loop.
-        Reviews with no positive cell (the common admission case) cost zero
-        host work — in particular no freeze(), which dominated the dense
-        loop at 1M-review scale.  Caller holds the lock."""
-        from ..engine.value import freeze
+    def _render_masked(self, reviews, ordered, mask_np, rej_np, inventory,
+                       memo_keys=None):
+        """Bulk sparse render shared by the device and host (numpy) mask
+        paths: iterate only (review, constraint) cells the mask marked
+        positive, review-major so per-review result ordering matches the
+        dense loop.  Reviews with no positive cell (the common admission
+        case) cost zero host work — in particular no freeze().
 
+        Three sub-passes, assembled back in mask order (caller holds the
+        lock):
+          1. plan pass — review-memo probes and compiled render plans
+             (ops/renderplan.py) resolve cells without the interpreter;
+             one RowView per flagged review shares every walked path
+             across its constraints
+          2. interp tail — the remaining cells evaluate through the
+             bounded render pool
+          3. assembly — Results built in the original cell order
+             (autoreject entries first per cell), memo stores applied on
+             this (lock-holding) thread only"""
+        import time as _time
+
+        from .renderplan import RenderPool, RowView
+
+        # reset up front: an early return (no flagged cells) must not
+        # leave the previous pass's stats for bench/telemetry readers
+        self.last_render_stats = {}
         out: List = [([], None) for _ in reviews]
         ris, iis = np.nonzero((mask_np | rej_np).T)
-        frozen_cache: Dict[int, tuple] = {}
-        for ri, i in zip(ris.tolist(), iis.tolist()):
+        cells = list(zip(ris.tolist(), iis.tolist()))
+        if not cells:
+            return out
+        # one vectorized gather instead of two scalar numpy indexings per
+        # cell (each is ~300ns of fancy-indexing machinery)
+        mflags = mask_np[iis, ris].tolist()
+        rflags = rej_np[iis, ris].tolist()
+        t0 = _time.perf_counter()
+        cached_ns = self.store.cached_namespace
+        rows: Dict[int, RowView] = {}
+        resolved: Dict[int, list] = {}
+        stores: List[Tuple] = []  # (mkey, cell idx) review-memo writes
+        deferred: List[Tuple] = []  # (cell idx, ri, i, mkey)
+        # intra-batch dedup: a micro-batch of identical replica pods must
+        # evaluate each memoable (constraint, content) cell ONCE even
+        # though memo stores land only after the render passes
+        seen_mkey: Dict[Tuple, int] = {}
+        aliases: Dict[int, int] = {}
+        memo_hits = 0
+        if self._review_memo_epoch != self._cs_epoch:
+            self._review_memo.clear()
+            self._review_memo_epoch = self._cs_epoch
+        for idx, (ri, i) in enumerate(cells):
+            if not mflags[idx]:
+                continue  # autoreject-only cell: handled at assembly
+            kind, name, constraint = ordered[i]
+            review = reviews[ri]
+            row = rows.get(ri)
+            if row is None:
+                # seed from the request-memo probe's frozen forms when the
+                # caller already paid for them (freeze is ~0.5ms per pod)
+                mk = memo_keys[ri] if memo_keys else None
+                row = RowView(review, mk[0] if mk else None)
+                if mk is not None:
+                    row._memo_frozen = mk[1]
+                rows[ri] = row
+            mkey = None
+            # memoability via the incrementally-maintained complement set
+            # (_memoable_update): O(1) per cell vs the getattr chain of
+            # _cell_memoable
+            if (kind, name) not in self._memoable_false and (
+                kind in self.templates
+            ):
+                mkey = (kind, name, row.memo_frozen())
+                hit = self._review_memo.get(mkey)
+                if hit is not None:
+                    resolved[idx] = hit
+                    memo_hits += 1
+                    continue
+                src = seen_mkey.get(mkey)
+                if src is not None:
+                    aliases[idx] = src  # same batch, same content cell
+                    memo_hits += 1
+                    continue
+                seen_mkey[mkey] = idx
+            plan = self._render_plan_for(kind, name, constraint)
+            if plan is not None:
+                # the mask cell already includes the packed match; the
+                # native re-check is only needed where packing can
+                # over-approximate it (label/namespace selectors)
+                if plan.match_exact or constraint_matches(
+                    constraint, review, cached_ns
+                ):
+                    self._tier_counts[plan.tier] += 1
+                    violations = plan.apply(row)
+                else:
+                    violations = []  # device over-approximated the match
+                resolved[idx] = violations
+                if mkey is not None:
+                    stores.append((mkey, idx))
+                continue
+            deferred.append((idx, ri, i, mkey))
+        t1 = _time.perf_counter()
+        if deferred:
+            thunks = [
+                (lambda c=ordered[i][2], k=ordered[i][0], r=reviews[ri],
+                        f=rows[ri].frozen():
+                 self._eval_cell(c, k, r, f, inventory,
+                                 allow_plan=False, count=False))
+                for _idx, ri, i, _mkey in deferred
+            ]
+            evaled = RenderPool.map_ordered(thunks)
+            self._tier_counts["interp"] += len(deferred)
+            for (idx, _ri, _i, mkey), violations in zip(deferred, evaled):
+                resolved[idx] = violations
+                if mkey is not None:
+                    stores.append((mkey, idx))
+        t2 = _time.perf_counter()
+        for idx, src in aliases.items():
+            resolved[idx] = resolved[src]
+        for mkey, idx in stores:
+            if len(self._review_memo) >= self.REVIEW_MEMO_MAX:
+                self._review_memo.clear()
+            self._review_memo[mkey] = resolved[idx]
+        for idx, (ri, i) in enumerate(cells):
             kind, _name, constraint = ordered[i]
             review = reviews[ri]
             results = out[ri][0]
-            if rej_np[i, ri] and needs_autoreject(
-                constraint, review, self.store.cached_namespace
+            if rflags[idx] and needs_autoreject(
+                constraint, review, cached_ns
             ):
                 results.append(
                     Result(
@@ -1576,16 +1845,31 @@ class TpuDriver(InterpDriver):
                         enforcement_action=self._enforcement_action(constraint),
                     )
                 )
-            if mask_np[i, ri]:
-                fr = frozen_cache.get(ri)
-                if fr is None:
-                    fz = freeze(review)
-                    fr = (fz, _strip_request_meta(fz))
-                    frozen_cache[ri] = fr
-                self._render_cell(
-                    results, constraint, kind, review, fr[0],
-                    inventory, None, memo_review=fr[1],
-                )
+            self._append_violation_results(
+                results, resolved.get(idx), constraint, kind, review
+            )
+        t3 = _time.perf_counter()
+        n_interp = len(deferred)
+        n_plan = len(resolved) - n_interp - memo_hits
+        obstrace.record_span(
+            "render.plan", t0, t1, stage=obstrace.RENDER, plan="compiled",
+            cells=n_plan, memo_hits=memo_hits,
+        )
+        if n_interp:
+            obstrace.record_span(
+                "render.interp", t1, t2, stage=obstrace.RENDER,
+                plan="interp", cells=n_interp,
+            )
+        self.last_render_stats = {
+            "cells": float(len(resolved)),
+            "plan_cells": float(n_plan),
+            "interp_cells": float(n_interp),
+            "memo_hits": float(memo_hits),
+            "plan_ms": (t1 - t0) * 1e3,
+            "interp_ms": (t2 - t1) * 1e3,
+            "assemble_ms": (t3 - t2) * 1e3,
+        }
+        self._flush_render_counts()
         return out
 
     def _np_review(self, reviews: List[dict],
@@ -1626,7 +1910,8 @@ class TpuDriver(InterpDriver):
             with obstrace.span("render", stage=obstrace.RENDER,
                                tier="numpy"):
                 out = self._render_masked(
-                    reviews, ordered, mask, rej, inventory
+                    reviews, ordered, mask, rej, inventory,
+                    memo_keys=memo_reviews,
                 )
             if (
                 len(reviews) <= self.REQUEST_MEMO_BATCH_MAX
@@ -1691,10 +1976,13 @@ class TpuDriver(InterpDriver):
         every constraint in order, including non-matching ones."""
         from ..engine.value import freeze
 
+        from .renderplan import RowView
+
         out = []
         for ri, review in enumerate(reviews):
             frozen_review = freeze(review)
             memo_review = _strip_request_meta(frozen_review)
+            rowview = RowView(review, frozen_review)
             results: List[Result] = []
             trace: List[str] = []
             for i, (kind, name, constraint) in enumerate(ordered):
@@ -1714,8 +2002,10 @@ class TpuDriver(InterpDriver):
                     self._render_cell(
                         results, constraint, kind, review, frozen_review,
                         inventory, trace, memo_review=memo_review,
+                        rowview=rowview,
                     )
             out.append((results, "\n".join(trace)))
+        self._flush_render_counts()
         return out
 
     # Fetched candidate indices per constraint for the capped audit: at
@@ -2148,7 +2438,7 @@ class TpuDriver(InterpDriver):
         return out
 
     def _audit_device(self, tracing: bool = False):
-        from ..engine.value import freeze
+        from .renderplan import RowView
 
         # audit is the throughput path: prefer waiting for the background
         # compile (which holds the driver lock only for host packing) over
@@ -2162,23 +2452,41 @@ class TpuDriver(InterpDriver):
             results: List[Result] = []
             trace: List[str] = [] if tracing else None
             # resource-major order, matching InterpDriver.audit; only
-            # reviews with a positive cell pay the freeze + render cost
+            # reviews with a positive cell pay any render cost (plan
+            # cells skip even the freeze — the RowView freezes lazily,
+            # only when a cell falls back to the interpreter or memo)
             hot_reviews = np.nonzero(mask.any(axis=0))[0]
             for ri in hot_reviews:
                 review = reviews[ri] if ri < len(reviews) else None
                 if review is None:  # tombstoned row (valid=False anyway)
                     continue
-                frozen_review = freeze(review)
+                rowview = RowView(review)
                 for i in np.nonzero(mask[:, ri])[0]:
                     kind, _name, constraint = ordered[i]
                     self._render_cell(
-                        results, constraint, kind, review, frozen_review,
-                        inventory, trace,
+                        results, constraint, kind, review, None,
+                        inventory, trace, rowview=rowview,
                     )
+            self._flush_render_counts()
             return results, ("\n".join(trace) if tracing else None)
 
+    # render-memo bound + eviction chunk: at the cap, the OLDEST 1/16 of
+    # entries (dict insertion order) are deleted instead of a wholesale
+    # clear() — the clear was a guaranteed latency cliff (one sweep
+    # suddenly re-rendering 2M cells) exactly on the largest clusters.
+    # Segmented FIFO, not LRU: hits don't reorder, so eviction is by
+    # insertion age; epoch invalidation (below) is unchanged.
+    RENDER_MEMO_MAX = 2_000_000
+
+    def _evict_render_memo(self):
+        from itertools import islice
+
+        drop = max(1, self.RENDER_MEMO_MAX // 16)
+        for k in list(islice(iter(self._render_memo), drop)):
+            del self._render_memo[k]
+
     def _memo_cell(
-        self, kind, name, ri, constraint, review, frozen_cache, inventory,
+        self, kind, name, ri, constraint, review, rowviews, inventory,
         uses_inv, row_gen,
     ) -> list:
         """Violations for one cell, memoized across sweeps: an unchanged
@@ -2189,16 +2497,18 @@ class TpuDriver(InterpDriver):
             hit = self._render_memo.get(mkey)
             if hit is not None and hit[0] == row_gen:
                 return hit[1]
-        fr = frozen_cache.get(ri)
-        if fr is None:
-            from ..engine.value import freeze
+        row = rowviews.get(ri)
+        if row is None:
+            from .renderplan import RowView
 
-            fr = freeze(review)
-            frozen_cache[ri] = fr
-        violations = self._eval_cell(constraint, kind, review, fr, inventory)
+            row = RowView(review)
+            rowviews[ri] = row
+        violations = self._eval_cell(
+            constraint, kind, review, None, inventory, rowview=row
+        )
         if not uses_inv:
-            if len(self._render_memo) > 2_000_000:
-                self._render_memo.clear()
+            if len(self._render_memo) >= self.RENDER_MEMO_MAX:
+                self._evict_render_memo()
             self._render_memo[mkey] = (row_gen, violations)
         return violations
 
@@ -2505,17 +2815,18 @@ class TpuDriver(InterpDriver):
         reuse = st.render_cache if trace is None else {}
         new_cache: Dict[Tuple, Tuple] = {}
         inventory = self._inventory_for_render()
-        frozen_cache: Dict[int, object] = {}
+        rowviews: Dict[int, object] = {}
         results: List[Result] = []
         totals: Dict[Tuple[str, str], Tuple[int, str]] = {}
         R = len(reviews)
         rendered_cells = 0
         fallback_rows = 0
         fallback_bytes = 0
+        tiers0 = dict(self._tier_counts)
 
         def render(ri, kind, name, constraint, uses_inv, action):
             violations = self._memo_cell(
-                kind, name, ri, constraint, reviews[ri], frozen_cache,
+                kind, name, ri, constraint, reviews[ri], rowviews,
                 inventory, uses_inv, ap.row_gen[ri],
             )
             for v in violations:
@@ -2602,16 +2913,25 @@ class TpuDriver(InterpDriver):
                 new_cache[ckey] = (sig, tuple(results[start:]), totals[ckey])
         if trace is None:
             st.render_cache = new_cache
+        tiers = {
+            k: self._tier_counts[k] - tiers0.get(k, 0)
+            for k in self._tier_counts
+        }
         obstrace.record_span(
             "audit.render", t0, _time.perf_counter(),
             stage=obstrace.RENDER, tier="tpu",
             rendered_cells=rendered_cells,
+            plan_static=tiers["static"], plan_slots=tiers["slots"],
+            plan_interp=tiers["interp"],
         )
         self.last_sweep_stats.update(
             render_ms=(_time.perf_counter() - t0) * 1e3,
             rendered_cells=float(rendered_cells),
+            render_plan_cells=float(tiers["static"] + tiers["slots"]),
+            render_interp_cells=float(tiers["interp"]),
             fallback_rows=float(fallback_rows),
             fallback_bytes=float(fallback_bytes),
             results=float(len(results)),
         )
+        self._flush_render_counts()
         return results, totals, ("\n".join(trace) if trace is not None else None)

@@ -1,0 +1,1328 @@
+"""Compiled violation rendering: the deny path without the interpreter.
+
+The TPU/numpy mask tells the driver WHICH (constraint, resource) cells are
+violation candidates; producing the violation *messages* for those cells
+used to re-run the whole generator-based interpreter per cell — a 10-13x
+latency penalty exactly on the requests that matter most (BENCH_r05:
+ingest_violating_unique_p50 25.9ms vs ingest_unique_p50 2.5ms).
+
+This module compiles each template's ``violation[{"msg": ...}]`` head into
+a **message plan** at vectorize time and *binds* it per constraint, so a
+flagged cell renders by direct field reads + the real sprintf builtin —
+no QueryContext, no per-cell freeze(params), no backtracking search.
+
+Plan classes (exported as render_cells_total{plan=...}):
+
+- ``static``: every clause's violation object is a bind-time constant
+  (message text depends only on constraint parameters — e.g. the
+  port-range family).  Rendering a cell is a per-clause condition check
+  plus a precomputed object.
+- ``slots``: the violation object reads review/slot/keyset fields (the
+  dominant Gatekeeper shape: ``sprintf`` over literals + field refs).
+  Rendering gathers the referenced values from a per-row view and calls
+  the same builtins the interpreter would.
+- ``interp``: anything the plan compiler does not recognize — or any
+  template whose vectorized program is not exact — falls back to the
+  interpreter, cell by cell.  The residual tail is drained by a bounded
+  worker pool (RenderPool) instead of a serial loop.
+
+Exactness contract: a bound plan is only produced when the template's
+VProgram compiled **exactly** (no dropped statements), and the bound
+condition evaluator runs the same IR over *direct* (unpacked) review
+values with full Rego semantics — ``compare`` for cross-type ordering,
+undefined-propagation for missing fields, real builtin calls for string
+predicates and formatting, and RSet dedup + canonical sort for the final
+violation list.  The rendered output is therefore byte-identical to
+``TemplatePolicy.eval_violations`` by construction (asserted corpus-wide
+by tests/test_render_parity.py), and the plan render *replaces* the
+interpreter both as renderer and as the device-mask exactness filter.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..engine import builtins as bi
+from ..engine.value import (
+    FrozenDict,
+    RSet,
+    UNDEFINED,
+    compare,
+    freeze,
+    thaw,
+    values_equal,
+)
+from ..rego.ast import (
+    ArrayTerm,
+    Call,
+    Node,
+    ObjectTerm,
+    Ref,
+    Scalar,
+    Var,
+)
+from .vexpr import (
+    AnyParam,
+    BoolOp,
+    ColRef,
+    Cmp,
+    Const,
+    Lit,
+    ParamElemRef,
+    ParamRef,
+    ReduceSlots,
+    SetCountCmp,
+    StrPred,
+    Truthy,
+    VProgram,
+)
+
+# plan tiers (metric label values)
+STATIC, SLOTS, INTERP = "static", "slots", "interp"
+
+# pure, deterministic builtins a message/details expression may call.
+# Anything outside this set (wall clock, uuid, data access, http) makes
+# the clause dynamic -> interpreter.
+_PURE_CALLS = {
+    ("sprintf",), ("concat",), ("format_int",), ("lower",), ("upper",),
+    ("replace",), ("trim",), ("trim_left",), ("trim_right",),
+    ("trim_prefix",), ("trim_suffix",), ("substring",), ("to_number",),
+    ("count",), ("sort",), ("split",), ("json", "marshal"),
+    ("array", "concat"),
+}
+
+
+class _Dynamic(Exception):
+    """Raised during plan compilation when a term is unrecognized."""
+
+
+# ---------------------------------------------------------------------------
+# value plans: the violation-object expression tree
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class VConst:
+    value: Any  # frozen
+
+
+@dataclass(frozen=True)
+class VReviewRef:
+    segs: Tuple[str, ...]  # review-rooted ([]-free)
+
+
+@dataclass(frozen=True)
+class VSlotRef:
+    rel: Tuple[str, ...]  # entity-relative ([]-free); () = the entity
+
+
+@dataclass(frozen=True)
+class VParamRef:
+    segs: Tuple[str, ...]  # resolved to a constant at bind time
+
+
+@dataclass(frozen=True)
+class VKeySet:
+    iter_paths: Tuple[Tuple[str, ...], ...]
+    rel: Tuple[str, ...]
+    exclude: Tuple[str, ...]
+
+
+@dataclass(frozen=True)
+class VParamIds:
+    ppath: Tuple[str, ...]
+    subpath: Tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class VSetDiff:
+    left: Any  # VKeySet | VParamIds
+    right: Any
+
+
+@dataclass(frozen=True)
+class VObj:
+    pairs: Tuple[Tuple[str, Any], ...]
+
+
+@dataclass(frozen=True)
+class VArr:
+    items: Tuple[Any, ...]
+
+
+@dataclass(frozen=True)
+class VCall:
+    path: Tuple[str, ...]
+    args: Tuple[Any, ...]
+
+
+@dataclass(frozen=True)
+class VBinOp:
+    op: str
+    lhs: Any
+    rhs: Any
+
+
+@dataclass(frozen=True)
+class VFmt:
+    """Bind-time-split sprintf: len(segments) == len(args) + 1 literal
+    segments interleaved with %v/%s-formatted args.  The char-by-char
+    sprintf parse runs once at bind, not per rendered cell."""
+
+    segments: Tuple[str, ...]
+    args: Tuple[Any, ...]
+
+
+def _split_simple_fmt(fmt: str) -> Optional[List[str]]:
+    """Split a sprintf format whose verbs are all plain %v/%s (no flags,
+    width, or precision) into literal segments; None when any other verb
+    or spec appears (the generic builtin then runs per cell)."""
+    segs: List[str] = []
+    cur: List[str] = []
+    i, n = 0, len(fmt)
+    while i < n:
+        ch = fmt[i]
+        if ch != "%":
+            cur.append(ch)
+            i += 1
+            continue
+        if i + 1 < n and fmt[i + 1] == "%":
+            cur.append("%")
+            i += 2
+            continue
+        if i + 1 < n and fmt[i + 1] in "vs":
+            segs.append("".join(cur))
+            cur = []
+            i += 2
+            continue
+        return None
+    segs.append("".join(cur))
+    return segs
+
+
+@dataclass(frozen=True)
+class ClausePlan:
+    """Compiled violation-object plan for one violation rule clause."""
+
+    obj: Any  # value plan for the rule key (the violation object)
+    # definedness guards: value plans for every recognized non-iteration
+    # assignment rhs in the clause body.  The interpreter fails the body
+    # when such an assignment's rhs is undefined (missing field, failed
+    # benign call) even if the assigned var is never used; the MASK may
+    # drop that (widening is sound there), but the plan render is the
+    # exactness filter and must reproduce it per binding.
+    guards: Tuple[Any, ...] = ()
+
+
+# ---------------------------------------------------------------------------
+# plan compilation (vectorize time; driven by ops/vectorizer.py)
+# ---------------------------------------------------------------------------
+
+
+def _always_defined_sym(vec, term, env) -> bool:
+    """True when the term provably never evaluates undefined: literals
+    and comprehension-derived sets/arrays (empty when their source is
+    absent, never undefined)."""
+    from .vectorizer import (
+        SConst, SKeySet, SParamIds, SPredAny, SSetDiff, _Unsupported,
+    )
+
+    try:
+        sym = vec._resolve(term, env, {"slot": None}, allow_compr=True)
+    except _Unsupported:
+        return False
+    return isinstance(sym, (SConst, SKeySet, SParamIds, SSetDiff, SPredAny))
+
+
+def compile_clause_plan(vec, rule, env: dict, ast_env: dict,
+                        slot_iter, guards=(), helper_guards=()) -> Optional[ClausePlan]:
+    """Compile the clause's rule key (the violation object) into a value
+    plan, or None when any part is unrecognized (the clause then renders
+    through the interpreter).  ``vec`` is the live Vectorizer (for its
+    symbolic resolver); ``env``/``ast_env`` are the clause's symbolic and
+    AST assignment environments; ``slot_iter`` the clause's iteration
+    axis (or None); ``guards`` the clause body's assignment rhs terms
+    whose definedness must hold, and ``helper_guards`` the
+    disjunct-scoped ones from inlined helpers (accepted only when
+    always-defined — a failing helper body falsifies just its disjunct,
+    which a clause-level guard cannot express)."""
+    key = rule.key
+    if key is None:
+        return None
+    if helper_guards:
+        # the vectorizer already filtered always-defined ones (in the
+        # helper's own env); anything left cannot be expressed as a
+        # clause-level guard
+        return None
+    try:
+        guard_plans = []
+        for g in guards:
+            if _always_defined_sym(vec, g, env):
+                continue
+            guard_plans.append(
+                _compile_value(vec, g, env, ast_env, slot_iter, depth=0)
+            )
+        obj = _compile_value(vec, key, env, ast_env, slot_iter, depth=0)
+    except _Dynamic:
+        return None
+    except Exception:
+        return None
+    if not isinstance(obj, (VObj,)):
+        # the webhook/audit contract consumes dict-shaped violations
+        return None
+    if not any(k == "msg" for k, _ in obj.pairs):
+        return None
+    # guards that already appear as subtrees of the violation object are
+    # redundant (the object evaluation fails on the same undefined input
+    # with identical no-violation semantics) — and the common case,
+    # `msg := sprintf(...)`, would otherwise format every message twice
+    obj_subplans = set()
+    _collect_subplans(obj, obj_subplans)
+    guard_plans = [g for g in guard_plans if g not in obj_subplans]
+    return ClausePlan(obj=obj, guards=tuple(guard_plans))
+
+
+def _collect_subplans(plan, out: set):
+    out.add(plan)
+    if isinstance(plan, VObj):
+        for _k, v in plan.pairs:
+            _collect_subplans(v, out)
+    elif isinstance(plan, (VArr, VCall, VFmt)):
+        for v in (plan.items if isinstance(plan, VArr) else plan.args):
+            _collect_subplans(v, out)
+    elif isinstance(plan, VBinOp):
+        _collect_subplans(plan.lhs, out)
+        _collect_subplans(plan.rhs, out)
+    elif isinstance(plan, VSetDiff):
+        _collect_subplans(plan.left, out)
+        _collect_subplans(plan.right, out)
+
+
+def _compile_value(vec, t: Node, env, ast_env, slot_iter, depth: int):
+    if depth > 16:
+        raise _Dynamic()
+    if isinstance(t, Scalar):
+        return VConst(freeze(t.value))
+    if isinstance(t, ObjectTerm):
+        pairs = []
+        for k, v in t.pairs:
+            if not (isinstance(k, Scalar) and isinstance(k.value, str)):
+                raise _Dynamic()
+            pairs.append((
+                k.value,
+                _compile_value(vec, v, env, ast_env, slot_iter, depth + 1),
+            ))
+        return VObj(tuple(pairs))
+    if isinstance(t, ArrayTerm):
+        return VArr(tuple(
+            _compile_value(vec, x, env, ast_env, slot_iter, depth + 1)
+            for x in t.items
+        ))
+    if isinstance(t, Call):
+        path = tuple(t.path)
+        if path not in _PURE_CALLS or bi.lookup(path) is None:
+            raise _Dynamic()
+        return VCall(path, tuple(
+            _compile_value(vec, a, env, ast_env, slot_iter, depth + 1)
+            for a in t.args
+        ))
+    from ..rego.ast import BinOp as _BinOp
+
+    if isinstance(t, _BinOp):
+        return VBinOp(
+            t.op,
+            _compile_value(vec, t.lhs, env, ast_env, slot_iter, depth + 1),
+            _compile_value(vec, t.rhs, env, ast_env, slot_iter, depth + 1),
+        )
+    if isinstance(t, Var):
+        sym = _resolve_sym(vec, t, env)
+        if sym is not None:
+            return _sym_to_plan(sym, slot_iter)
+        rhs = ast_env.get(t.name)
+        if rhs is not None:
+            return _compile_value(vec, rhs, env, ast_env, slot_iter,
+                                  depth + 1)
+        raise _Dynamic()
+    if isinstance(t, Ref):
+        sym = _resolve_sym(vec, t, env)
+        if sym is None:
+            raise _Dynamic()
+        return _sym_to_plan(sym, slot_iter)
+    raise _Dynamic()
+
+
+def _resolve_sym(vec, t: Node, env):
+    """Symbolic resolution via the Vectorizer, None on failure (no
+    side-effecting column registration happens on these paths)."""
+    from .vectorizer import SConst, SKeySet, SParamIds, SPath, SSetDiff
+    from .vectorizer import _Unsupported
+
+    try:
+        sym = vec._resolve(t, env, {"slot": None}, allow_compr=True)
+    except _Unsupported:
+        return None
+    if isinstance(sym, (SConst, SPath, SKeySet, SParamIds, SSetDiff)):
+        return sym
+    return None
+
+
+def _sym_to_plan(sym, slot_iter):
+    from .vectorizer import SConst, SKeySet, SParamIds, SPath, SSetDiff
+
+    if isinstance(sym, SConst):
+        return VConst(freeze(sym.value))
+    if isinstance(sym, SPath):
+        if sym.root == "review":
+            return VReviewRef(tuple(sym.segs))
+        if sym.root == "params":
+            return VParamRef(tuple(sym.segs))
+        if isinstance(sym.root, tuple) and sym.root[0] == "slot":
+            if slot_iter is None or sym.root[1] != slot_iter:
+                raise _Dynamic()  # ref to a foreign iteration axis
+            return VSlotRef(tuple(sym.segs))
+        raise _Dynamic()
+    if isinstance(sym, SKeySet):
+        return VKeySet(tuple(sym.iter_paths), tuple(sym.rel),
+                       tuple(sym.exclude))
+    if isinstance(sym, SParamIds):
+        return VParamIds(tuple(sym.ppath), tuple(sym.subpath))
+    if isinstance(sym, SSetDiff):
+        return VSetDiff(_sym_to_plan(sym.left, slot_iter),
+                        _sym_to_plan(sym.right, slot_iter))
+    raise _Dynamic()
+
+
+# ---------------------------------------------------------------------------
+# row views: direct (exact) field access over one review/resource
+# ---------------------------------------------------------------------------
+
+
+def strip_request_meta(frozen_review):
+    """Identical content minus per-request metadata (uid): the content
+    memo key (see driver._strip_request_meta, whose semantics this
+    mirrors — memo_safe policies provably never read the stripped
+    fields)."""
+    if isinstance(frozen_review, FrozenDict) and "uid" in frozen_review:
+        return FrozenDict(
+            {k: frozen_review[k] for k in frozen_review._d if k != "uid"}
+        )
+    return frozen_review
+
+
+class _Absent:
+    __slots__ = ()
+
+    def __repr__(self):  # pragma: no cover - debug aid
+        return "<absent>"
+
+
+ABSENT = _Absent()
+
+# RowView cache-miss sentinel (None and ABSENT are both valid cached
+# values: null fields cache None, missing fields cache ABSENT)
+_MISS = object()
+
+
+def _walk_path(obj, path: Tuple[str, ...], i: int, out: list):
+    """Same traversal as ops/columns.py: [] flattens arrays (and ONLY
+    arrays), string segments index dicts."""
+    if i == len(path):
+        out.append(obj)
+        return
+    seg = path[i]
+    if seg == "[]":
+        if isinstance(obj, list):
+            for item in obj:
+                _walk_path(item, path, i + 1, out)
+        return
+    if isinstance(obj, dict) and seg in obj:
+        _walk_path(obj[seg], path, i + 1, out)
+
+
+def _get_rel(obj, segs: Tuple[str, ...]):
+    cur = obj
+    for seg in segs:
+        if isinstance(cur, dict) and seg in cur:
+            cur = cur[seg]
+        else:
+            return ABSENT
+    return cur
+
+
+class RowView:
+    """Cached direct-value access for one review dict: slot entities per
+    iteration group, scalar paths, keysets, and the (lazily computed)
+    frozen form for interpreter fallback / memo keys.  Shared across every
+    constraint rendered for the row, so each distinct path is walked once
+    per row regardless of the installed-constraint count."""
+
+    __slots__ = ("review", "_frozen", "_memo_frozen", "_entities",
+                 "_scalars", "_keysets", "_frozen_vals")
+
+    def __init__(self, review: dict, frozen_review=None):
+        self.review = review
+        self._frozen = frozen_review
+        self._memo_frozen = None
+        self._entities: Dict[Tuple, list] = {}
+        self._scalars: Dict[Tuple, Any] = {}
+        self._keysets: Dict[Tuple, Any] = {}
+        self._frozen_vals: Dict[Tuple, Any] = {}
+
+    def frozen(self):
+        if self._frozen is None:
+            self._frozen = freeze(self.review)
+        return self._frozen
+
+    def memo_frozen(self):
+        """The uid-stripped frozen review — the content memo key — built
+        (and hashed) ONCE per row.  Building it per cell re-hashed the
+        whole review content per constraint, which dominated the bulk
+        render pass at 500 installed constraints."""
+        if self._memo_frozen is None:
+            self._memo_frozen = strip_request_meta(self.frozen())
+        return self._memo_frozen
+
+    def entities(self, iter_paths: Tuple[Tuple[str, ...], ...]) -> list:
+        got = self._entities.get(iter_paths)
+        if got is None:
+            got = []
+            for p in iter_paths:
+                _walk_path(self.review, p, 0, got)
+            self._entities[iter_paths] = got
+        return got
+
+    def scalar(self, segs: Tuple[str, ...]):
+        # _MISS sentinel, not None: a JSON-null field caches as None and
+        # must not re-walk per cell
+        got = self._scalars.get(segs, _MISS)
+        if got is _MISS:
+            got = _get_rel(self.review, segs)
+            self._scalars[segs] = got
+        return got
+
+    def scalar_frozen(self, segs: Tuple[str, ...]):
+        got = self._frozen_vals.get(segs, _MISS)
+        if got is _MISS:
+            raw = self.scalar(segs)
+            got = UNDEFINED if raw is ABSENT else freeze(raw)
+            self._frozen_vals[segs] = got
+        return got
+
+    def keyset(self, iter_paths, rel, exclude) -> frozenset:
+        """The comprehension ``{k | PATH[k]; k != excl...}`` evaluated
+        exactly: dict targets contribute keys whose value is not false;
+        list targets contribute indices of not-false elements (OPA walks
+        arrays by index); excluded literals are dropped."""
+        ck = (iter_paths, rel, exclude)
+        got = self._keysets.get(ck)
+        if got is None:
+            keys = set()
+            for ent in self.entities(iter_paths):
+                target = _get_rel(ent, rel) if rel else ent
+                if isinstance(target, dict):
+                    for k, v in target.items():
+                        if v is not False and k not in exclude:
+                            keys.add(freeze(k))
+                elif isinstance(target, list):
+                    for i, v in enumerate(target):
+                        if v is not False and i not in exclude:
+                            keys.add(i)
+            got = frozenset(keys)
+            self._keysets[ck] = got
+        return got
+
+
+# ---------------------------------------------------------------------------
+# binding (per constraint) and application (per cell)
+# ---------------------------------------------------------------------------
+
+
+def _param_get(params, segs: Tuple[str, ...]):
+    cur = params
+    for seg in segs:
+        if isinstance(cur, FrozenDict) and seg in cur:
+            cur = cur[seg]
+        else:
+            return UNDEFINED
+    return cur
+
+
+def _param_elems(value) -> list:
+    """Wildcard iteration over a frozen parameter value, mirroring the
+    interpreter's _walk: arrays yield items, objects yield values (sorted
+    key order), sets yield items, scalars yield nothing."""
+    if isinstance(value, tuple):
+        return list(value)
+    if isinstance(value, FrozenDict):
+        return [value[k] for k in value.sorted_keys()]
+    if isinstance(value, RSet):
+        return list(value.sorted_items())
+    return []
+
+
+@dataclass
+class BoundClause:
+    never: bool = False
+    res_conds: Tuple = ()  # resource-level bound cond closures
+    slot_conds: Tuple = ()  # slot-level bound cond closures
+    # definedness-guard value closures (ClausePlan.guards), split by axis:
+    # an UNDEFINED guard value fails the clause (resource level) or the
+    # binding (slot level), like the interpreter's assignment failure
+    res_guards: Tuple = ()
+    slot_guards: Tuple = ()
+    slot_iter: Optional[Tuple] = None
+    obj_fn: Any = None  # compiled value closure (violation object)
+    obj_static: Any = None  # precomputed frozen object when constant
+
+
+@dataclass
+class BoundPlan:
+    """A template plan bound to one constraint's parameters."""
+
+    tier: str  # STATIC | SLOTS
+    clauses: List[BoundClause] = field(default_factory=list)
+    # True when the packed match kernel is provably exact for this
+    # constraint (no label/namespace selectors — the only fields the
+    # packed match can over-approximate through, ops/pack.py): mask-driven
+    # callers may then skip the native constraint_matches re-check
+    match_exact: bool = False
+
+    def apply(self, row: RowView) -> list:
+        """Exact violations for (this constraint, row.review): evaluates
+        each clause's conditions over direct values, materializes the
+        violation object per firing binding, and returns the deduped,
+        canonically-sorted, thawed list — the eval_violations contract."""
+        items = set()
+        for cl in self.clauses:
+            if cl.never:
+                continue
+            ok = True
+            for c in cl.res_conds:
+                if not c(row, None):
+                    ok = False
+                    break
+            if ok:
+                for g in cl.res_guards:
+                    if g(row, None) is UNDEFINED:
+                        ok = False
+                        break
+            if not ok:
+                continue
+            if cl.slot_iter is None:
+                obj = (cl.obj_static if cl.obj_static is not None
+                       else cl.obj_fn(row, None))
+                if obj is not UNDEFINED:
+                    items.add(obj)
+                continue
+            for ent in row.entities(cl.slot_iter):
+                fired = True
+                for c in cl.slot_conds:
+                    if not c(row, ent):
+                        fired = False
+                        break
+                if fired:
+                    for g in cl.slot_guards:
+                        if g(row, ent) is UNDEFINED:
+                            fired = False
+                            break
+                if not fired:
+                    continue
+                obj = (cl.obj_static if cl.obj_static is not None
+                       else cl.obj_fn(row, ent))
+                if obj is not UNDEFINED:
+                    items.add(obj)
+        if not items:
+            return []
+        return [thaw(v) for v in RSet(items)]
+
+
+# ---- bound conditions: compiled to closures --------------------------------
+# Binding emits plain Python closures fn(row, entity) -> value/bool rather
+# than a node tree: the per-cell isinstance dispatch of a tree walk
+# measured as the dominant render cost once sprintf was pre-split.  Each
+# closure returns a body-statement truth value — False covers both
+# Rego-false and undefined (statement failure).
+
+
+def _const_getter(v):
+    def get(row, entity, _v=v):
+        return _v
+
+    return get
+
+
+def _operand_getter(op, params):
+    """fn(row, entity) -> frozen value or UNDEFINED for a Lit/ParamRef/
+    ColRef operand (ParamElemRef binds inside the AnyParam unroll)."""
+    if isinstance(op, Lit):
+        return _const_getter(freeze(op.value))
+    if isinstance(op, ParamRef):
+        return _const_getter(_param_get(params, tuple(op.ppath)))
+    if isinstance(op, ColRef):
+        kind, _ip, rel, _ex = op.colkey
+        rel = tuple(rel)
+        if kind == "scalar":
+            def get_scalar(row, entity, _segs=rel):
+                return row.scalar_frozen(_segs)
+
+            return get_scalar
+        if kind == "slot":
+            if rel:
+                def get_slot(row, entity, _segs=rel):
+                    v = _get_rel(entity, _segs)
+                    return UNDEFINED if v is ABSENT else freeze(v)
+
+                return get_slot
+
+            def get_entity(row, entity):
+                return freeze(entity)
+
+            return get_entity
+    raise _Dynamic()
+
+
+def _cond_false(row, entity):
+    return False
+
+
+def _cond_true(row, entity):
+    return True
+
+
+def _compile_truthy(get, negate):
+    if negate:
+        def f(row, entity):
+            v = get(row, entity)
+            return v is UNDEFINED or v is False
+
+        return f
+
+    def t(row, entity):
+        v = get(row, entity)
+        return v is not UNDEFINED and v is not False
+
+    return t
+
+
+_CMP_RANKS = {"<": (-1,), "<=": (-1, 0), ">": (1,), ">=": (0, 1)}
+
+
+def _compile_cmp(op, a, b):
+    if op == "==":
+        def eq(row, entity):
+            va = a(row, entity)
+            if va is UNDEFINED:
+                return False
+            vb = b(row, entity)
+            if vb is UNDEFINED:
+                return False
+            return values_equal(va, vb)
+
+        return eq
+    if op == "!=":
+        def ne(row, entity):
+            va = a(row, entity)
+            if va is UNDEFINED:
+                return False
+            vb = b(row, entity)
+            if vb is UNDEFINED:
+                return False
+            return not values_equal(va, vb)
+
+        return ne
+    ranks = _CMP_RANKS[op]
+
+    def rel(row, entity):
+        va = a(row, entity)
+        if va is UNDEFINED:
+            return False
+        vb = b(row, entity)
+        if vb is UNDEFINED:
+            return False
+        return compare(va, vb) in ranks
+
+    return rel
+
+
+def _compile_strpred(pred, get, pat, negate):
+    if not isinstance(pat, str):
+        # builtin error for every cell -> statement always fails
+        base = _cond_false
+    elif pred == "startswith":
+        def base(row, entity):
+            v = get(row, entity)
+            return isinstance(v, str) and v.startswith(pat)
+    elif pred == "endswith":
+        def base(row, entity):
+            v = get(row, entity)
+            return isinstance(v, str) and v.endswith(pat)
+    elif pred == "contains":
+        def base(row, entity):
+            v = get(row, entity)
+            return isinstance(v, str) and pat in v
+    elif pred == "re_match":
+        fn = bi.lookup(("re_match",))
+
+        def base(row, entity):
+            v = get(row, entity)
+            if not isinstance(v, str):
+                return False
+            try:
+                return bool(fn(pat, v))
+            except bi.BuiltinError:
+                return False
+    else:
+        raise _Dynamic()
+    if not negate:
+        return base
+
+    def neg(row, entity):
+        return not base(row, entity)
+
+    return neg
+
+
+def _compile_all(conds):
+    if not conds:
+        return _cond_true
+    if len(conds) == 1:
+        return conds[0]
+
+    def f(row, entity, _cs=tuple(conds)):
+        for c in _cs:
+            if not c(row, entity):
+                return False
+        return True
+
+    return f
+
+
+def _bind_cond(node, params, prog: VProgram):
+    """One VExpr condition -> closure fn(row, entity) -> bool with exact
+    interpreter semantics over direct values."""
+    if isinstance(node, Const):
+        return _cond_true if node.value else _cond_false
+    if isinstance(node, Truthy):
+        return _compile_truthy(
+            _operand_getter(node.operand, params), node.negate
+        )
+    if isinstance(node, Cmp):
+        return _compile_cmp(
+            node.op,
+            _operand_getter(node.lhs, params),
+            _operand_getter(node.rhs, params),
+        )
+    if isinstance(node, StrPred):
+        return _compile_strpred(
+            node.pred, _operand_getter(node.operand, params),
+            _strpred_pattern(node, params), node.negate,
+        )
+    if isinstance(node, AnyParam):
+        value = _param_get(params, tuple(node.ppath))
+        branches = tuple(
+            _compile_all(tuple(
+                _bind_elem_cond(c, elem, params, prog) for c in node.inner
+            ))
+            for elem in _param_elems(value)
+        )
+        if not branches:
+            return _cond_false
+
+        def any_branch(row, entity, _bs=branches):
+            for b in _bs:
+                if b(row, entity):
+                    return True
+            return False
+
+        return any_branch
+    if isinstance(node, SetCountCmp):
+        lget = _set_getter(node.left, params)
+        rget = _set_getter(node.right, params)
+        import operator
+
+        cmpf = {
+            ">": operator.gt, ">=": operator.ge, "<": operator.lt,
+            "<=": operator.le, "==": operator.eq, "!=": operator.ne,
+        }[node.op]
+        n = node.n
+
+        def setcount(row, entity):
+            return cmpf(len(lget(row) - rget(row)), n)
+
+        return setcount
+    if isinstance(node, BoolOp):
+        children = tuple(
+            _bind_cond(c, params, prog) for c in node.children
+        )
+        if node.op == "not":
+            c0 = children[0]
+
+            def negated(row, entity):
+                return not c0(row, entity)
+
+            return negated
+        if node.op == "and":
+            return _compile_all(children)
+
+        def any_child(row, entity, _cs=children):
+            for c in _cs:
+                if c(row, entity):
+                    return True
+            return False
+
+        return any_child
+    if isinstance(node, ReduceSlots):
+        inner = _compile_all(tuple(
+            _bind_cond(c, params, prog) for c in node.inner
+        ))
+        ip = tuple(node.iter_key)
+
+        def reduce_slots(row, entity, _inner=inner, _ip=ip):
+            for ent in row.entities(_ip):
+                if _inner(row, ent):
+                    return True
+            return False
+
+        return reduce_slots
+    raise _Dynamic()
+
+
+def _bind_elem_cond(node, elem, params, prog):
+    """Bind an AnyParam inner condition for ONE parameter element:
+    ParamElemRef operands become constants of that element."""
+    def op_of(op):
+        if isinstance(op, ParamElemRef):
+            v = elem
+            for seg in op.subpath:
+                if isinstance(v, FrozenDict) and seg in v:
+                    v = v[seg]
+                else:
+                    return _const_getter(UNDEFINED)
+            return _const_getter(v)
+        return _operand_getter(op, params)
+
+    if isinstance(node, Cmp):
+        return _compile_cmp(node.op, op_of(node.lhs), op_of(node.rhs))
+    if isinstance(node, StrPred):
+        if isinstance(node.rhs, ParamElemRef):
+            pat = op_of(node.rhs)(None, None)
+        else:
+            pat = _strpred_pattern(node, params)
+        return _compile_strpred(
+            node.pred, op_of(node.operand), pat, node.negate
+        )
+    if isinstance(node, Truthy):
+        return _compile_truthy(op_of(node.operand), node.negate)
+    raise _Dynamic()
+
+
+def _strpred_pattern(node: StrPred, params):
+    if isinstance(node.rhs, Lit):
+        return freeze(node.rhs.value)
+    if isinstance(node.rhs, ParamRef):
+        return _param_get(params, tuple(node.rhs.ppath))
+    raise _Dynamic()
+
+
+def _param_id_set(ppath, subpath, params) -> frozenset:
+    vals = set()
+    for elem in _param_elems(_param_get(params, tuple(ppath))):
+        v = elem
+        ok = True
+        for seg in subpath:
+            if isinstance(v, FrozenDict) and seg in v:
+                v = v[seg]
+            else:
+                ok = False
+                break
+        if ok:
+            vals.add(v)
+    return frozenset(vals)
+
+
+def _set_getter(side, params):
+    """fn(row) -> frozenset for a SetCountCmp side."""
+    kind, key = side
+    if kind == "keyset":
+        _k, iter_paths, rel, exclude = key
+        ip, rl, ex = tuple(iter_paths), tuple(rel), tuple(exclude)
+
+        def get_keys(row, _ip=ip, _rl=rl, _ex=ex):
+            return row.keyset(_ip, _rl, _ex)
+
+        return get_keys
+    ppath, subpath = key
+    return lambda row, _v=_param_id_set(ppath, subpath, params): _v
+
+
+# ---- bound value plans -----------------------------------------------------
+
+
+def _bind_value(plan, params):
+    """Partial-evaluate a value plan against the constraint parameters:
+    VParamRef/VParamIds collapse to constants; a fully-constant subtree
+    collapses to VConst.  Raises _Dynamic only at compile; binding never
+    does — an undefined parameter becomes VConst(UNDEFINED), which makes
+    the owning clause render nothing (the interpreter's msg-assignment
+    failure semantics)."""
+    if isinstance(plan, VConst):
+        return plan
+    if isinstance(plan, VParamRef):
+        return VConst(_param_get(params, plan.segs))
+    if isinstance(plan, VParamIds):
+        return VConst(RSet(_param_id_set(plan.ppath, plan.subpath, params)))
+    if isinstance(plan, VObj):
+        pairs = tuple((k, _bind_value(v, params)) for k, v in plan.pairs)
+        if all(isinstance(v, VConst) for _k, v in pairs):
+            if any(v.value is UNDEFINED for _k, v in pairs):
+                return VConst(UNDEFINED)
+            return VConst(FrozenDict({k: v.value for k, v in pairs}))
+        return VObj(pairs)
+    if isinstance(plan, VArr):
+        items = tuple(_bind_value(v, params) for v in plan.items)
+        if all(isinstance(v, VConst) for v in items):
+            if any(v.value is UNDEFINED for v in items):
+                return VConst(UNDEFINED)
+            return VConst(tuple(v.value for v in items))
+        return VArr(items)
+    if isinstance(plan, VCall):
+        args = tuple(_bind_value(v, params) for v in plan.args)
+        out = VCall(plan.path, args)
+        if all(isinstance(v, VConst) for v in args):
+            return VConst(_compile_valuefn(out)(None, None))
+        if (
+            plan.path == ("sprintf",)
+            and len(args) == 2
+            and isinstance(args[0], VConst)
+            and isinstance(args[0].value, str)
+            and isinstance(args[1], VArr)
+        ):
+            segs = _split_simple_fmt(args[0].value)
+            if segs is not None and len(segs) == len(args[1].items) + 1:
+                return VFmt(tuple(segs), args[1].items)
+        return out
+    if isinstance(plan, VBinOp):
+        lhs = _bind_value(plan.lhs, params)
+        rhs = _bind_value(plan.rhs, params)
+        out = VBinOp(plan.op, lhs, rhs)
+        if isinstance(lhs, VConst) and isinstance(rhs, VConst):
+            return VConst(_compile_valuefn(out)(None, None))
+        return out
+    if isinstance(plan, VSetDiff):
+        return VSetDiff(_bind_value(plan.left, params),
+                        _bind_value(plan.right, params))
+    if isinstance(plan, VKeySet):
+        return plan
+    if isinstance(plan, (VReviewRef, VSlotRef)):
+        return plan
+    raise _Dynamic()
+
+
+def _compile_valuefn(plan):
+    """A bound value plan -> closure fn(row, entity) -> frozen value
+    (UNDEFINED propagates: any undefined input makes the whole
+    violation-object binding fail, the interpreter's assignment-failure
+    semantics).  Bind-time constant folding calls the same closures with
+    (None, None), so the semantics exist exactly once."""
+    if isinstance(plan, VConst):
+        return _const_getter(plan.value)
+    if isinstance(plan, VReviewRef):
+        segs = plan.segs
+
+        def review_ref(row, entity, _segs=segs):
+            return row.scalar_frozen(_segs)
+
+        return review_ref
+    if isinstance(plan, VSlotRef):
+        rel = plan.rel
+        if rel:
+            def slot_ref(row, entity, _rel=rel):
+                if entity is None:
+                    return UNDEFINED
+                v = _get_rel(entity, _rel)
+                return UNDEFINED if v is ABSENT else freeze(v)
+
+            return slot_ref
+
+        def slot_entity(row, entity):
+            return UNDEFINED if entity is None else freeze(entity)
+
+        return slot_entity
+    if isinstance(plan, VKeySet):
+        ip, rl, ex = plan.iter_paths, plan.rel, plan.exclude
+
+        def keyset(row, entity, _ip=ip, _rl=rl, _ex=ex):
+            return RSet(row.keyset(_ip, _rl, _ex))
+
+        return keyset
+    if isinstance(plan, VSetDiff):
+        lf, rf = _compile_valuefn(plan.left), _compile_valuefn(plan.right)
+
+        def setdiff(row, entity):
+            left = lf(row, entity)
+            right = rf(row, entity)
+            if not isinstance(left, RSet) or not isinstance(right, RSet):
+                return UNDEFINED
+            return left.difference(right)
+
+        return setdiff
+    if isinstance(plan, VObj):
+        cpairs = tuple((k, _compile_valuefn(v)) for k, v in plan.pairs)
+
+        def obj(row, entity, _ps=cpairs):
+            out = {}
+            for k, fn in _ps:
+                v = fn(row, entity)
+                if v is UNDEFINED:
+                    return UNDEFINED
+                out[k] = v
+            return FrozenDict(out)
+
+        return obj
+    if isinstance(plan, VArr):
+        fns = tuple(_compile_valuefn(v) for v in plan.items)
+
+        def arr(row, entity, _fns=fns):
+            out = []
+            for fn in _fns:
+                v = fn(row, entity)
+                if v is UNDEFINED:
+                    return UNDEFINED
+                out.append(v)
+            return tuple(out)
+
+        return arr
+    if isinstance(plan, VFmt):
+        from ..engine.value import format_value
+
+        segs = plan.segments
+        fns = tuple(_compile_valuefn(a) for a in plan.args)
+
+        def fmt(row, entity, _segs=segs, _fns=fns):
+            parts = [_segs[0]]
+            for j, fn in enumerate(_fns):
+                v = fn(row, entity)
+                if v is UNDEFINED:
+                    return UNDEFINED
+                try:
+                    parts.append(format_value(v))
+                except TypeError:
+                    return UNDEFINED
+                parts.append(_segs[j + 1])
+            return "".join(parts)
+
+        return fmt
+    if isinstance(plan, VCall):
+        fn = bi.lookup(plan.path)
+        argfns = tuple(_compile_valuefn(a) for a in plan.args)
+
+        def call(row, entity, _fn=fn, _argfns=argfns):
+            args = []
+            for afn in _argfns:
+                v = afn(row, entity)
+                if v is UNDEFINED:
+                    return UNDEFINED
+                args.append(v)
+            try:
+                out = _fn(*args)
+            except bi.BuiltinError:
+                return UNDEFINED
+            except (TypeError, ValueError, ZeroDivisionError):
+                return UNDEFINED
+            return freeze(out) if isinstance(out, (list, dict, set)) else out
+
+        return call
+    if isinstance(plan, VBinOp):
+        from ..engine.interp import _apply_binop
+
+        lf, rf = _compile_valuefn(plan.lhs), _compile_valuefn(plan.rhs)
+        op = plan.op
+
+        def binop(row, entity):
+            a = lf(row, entity)
+            if a is UNDEFINED:
+                return UNDEFINED
+            b = rf(row, entity)
+            if b is UNDEFINED:
+                return UNDEFINED
+            return _apply_binop(op, a, b)
+
+        return binop
+    raise TypeError(plan)
+
+
+def bind(prog: Optional[VProgram], policy, constraint: dict) -> Optional[BoundPlan]:
+    """Bind a template's compiled plans to one constraint, or None when
+    the template is ineligible (no program, inexact program, any clause
+    without a message plan, or an inventory-reading policy)."""
+    if prog is None or not prog.exact:
+        return None
+    plans = getattr(prog, "clause_plans", None)
+    if not plans or len(plans) != len(prog.clauses) or any(
+        p is None for p in plans
+    ):
+        return None
+    if getattr(policy, "uses_inventory", False):
+        return None
+    from ..client.drivers import constraint_match_spec, constraint_parameters
+
+    params = freeze(constraint_parameters(constraint))
+    if not isinstance(params, FrozenDict):
+        params = FrozenDict({})
+    match = constraint_match_spec(constraint)
+    out = BoundPlan(
+        tier=STATIC,
+        # PRESENCE semantics, like _cell_memoable: an empty selector ({})
+        # still consults the mutable namespace cache at match time, so
+        # the native re-check may only be skipped when the keys are
+        # absent outright
+        match_exact="labelSelector" not in match
+        and "namespaceSelector" not in match,
+    )
+    try:
+        for clause, cplan in zip(prog.clauses, plans):
+            bc = BoundClause(slot_iter=clause.slot_iter)
+            res_conds, slot_conds = [], []
+            for cond in clause.conds:
+                bound = _bind_cond(cond, params, prog)
+                if _cond_uses_slot(cond):
+                    slot_conds.append(bound)
+                else:
+                    res_conds.append(bound)
+            bc.res_conds = tuple(res_conds)
+            bc.slot_conds = tuple(slot_conds)
+            res_guards, slot_guards = [], []
+            for gplan in cplan.guards:
+                bound_g = _bind_value(gplan, params)
+                if isinstance(bound_g, VConst):
+                    if bound_g.value is UNDEFINED:
+                        # e.g. an assignment from a missing parameter:
+                        # the clause can never fire for any row
+                        bc.never = True
+                    continue  # defined constant: no per-row risk
+                gfn = _compile_valuefn(bound_g)
+                if _value_uses_slot(bound_g):
+                    slot_guards.append(gfn)
+                else:
+                    res_guards.append(gfn)
+            bc.res_guards = tuple(res_guards)
+            bc.slot_guards = tuple(slot_guards)
+            obj = _bind_value(cplan.obj, params)
+            if isinstance(obj, VConst):
+                if obj.value is UNDEFINED:
+                    # a message input is undefined for EVERY row (missing
+                    # parameter): the clause can never produce a violation
+                    bc.never = True
+                else:
+                    bc.obj_static = obj.value
+            else:
+                out.tier = SLOTS
+                bc.obj_fn = _compile_valuefn(obj)
+            if bc.slot_iter is not None:
+                out.tier = SLOTS
+            out.clauses.append(bc)
+    except _Dynamic:
+        return None
+    return out
+
+
+def _cond_uses_slot(node) -> bool:
+    from .vexpr import _clause_uses_slot
+
+    return _clause_uses_slot(node)
+
+
+def _value_uses_slot(plan) -> bool:
+    """True when a bound value plan reads the clause's slot entity."""
+    if isinstance(plan, VSlotRef):
+        return True
+    if isinstance(plan, VObj):
+        return any(_value_uses_slot(v) for _k, v in plan.pairs)
+    if isinstance(plan, (VArr, VCall, VFmt)):
+        items = plan.items if isinstance(plan, VArr) else plan.args
+        return any(_value_uses_slot(v) for v in items)
+    if isinstance(plan, VBinOp):
+        return _value_uses_slot(plan.lhs) or _value_uses_slot(plan.rhs)
+    if isinstance(plan, VSetDiff):
+        return _value_uses_slot(plan.left) or _value_uses_slot(plan.right)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# bounded worker pool for the residual interpreter tail
+# ---------------------------------------------------------------------------
+
+
+class RenderPool:
+    """Bounded DAEMON-thread pool draining interpreter-rendered cells
+    (ThreadPoolExecutor's non-daemon workers would hold process exit and
+    trip the test suite's leak detector).  The coordinator thread owns
+    every shared-state mutation (memos, metrics); workers run pure
+    per-cell evaluations, so the pool adds concurrency only where it is
+    safe.  Sized small: the interpreter is GIL-bound, so the win is
+    bounded overlap (native match, freeze) rather than parallel
+    speedup."""
+
+    _lock = threading.Lock()
+    _queue = None
+    _started = 0
+
+    MIN_CELLS = int(os.environ.get("GK_RENDER_POOL_MIN", "16"))
+    WORKERS = max(1, int(os.environ.get(
+        "GK_RENDER_WORKERS", str(min(4, os.cpu_count() or 1))
+    )))
+
+    @classmethod
+    def _ensure_workers(cls):
+        if cls._started >= cls.WORKERS:
+            return
+        with cls._lock:
+            if cls._queue is None:
+                import queue
+
+                cls._queue = queue.SimpleQueue()
+            while cls._started < cls.WORKERS:
+                t = threading.Thread(
+                    target=cls._worker,
+                    name=f"gk-render-{cls._started}",
+                    daemon=True,
+                )
+                t.start()
+                cls._started += 1
+
+    @classmethod
+    def _worker(cls):
+        q = cls._queue
+        while True:
+            fn, slot, done = q.get()
+            try:
+                slot[0] = fn()
+            except BaseException as e:  # re-raised on the coordinator
+                slot[1] = e
+            done.set()
+
+    @classmethod
+    def map_ordered(cls, fns: List) -> List:
+        """Run thunks concurrently, return results in submission order.
+        Exceptions re-raise in submission order (matching the serial
+        loop's first-failure semantics).  Falls back to a serial loop
+        below MIN_CELLS, where pool overhead would dominate."""
+        if len(fns) < cls.MIN_CELLS:
+            return [fn() for fn in fns]
+        cls._ensure_workers()
+        tasks = []
+        for fn in fns:
+            slot = [None, None]
+            done = threading.Event()
+            cls._queue.put((fn, slot, done))
+            tasks.append((slot, done))
+        out = []
+        for slot, done in tasks:
+            done.wait()
+            if slot[1] is not None:
+                raise slot[1]
+            out.append(slot[0])
+        return out

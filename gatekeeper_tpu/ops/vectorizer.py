@@ -124,16 +124,19 @@ class Vectorizer:
 
     def compile(self) -> Optional[VProgram]:
         clauses: List[Clause] = []
+        plans: List[Optional[object]] = []
         for rule in self.cm.rules.get("violation", []):
             if not rule.is_partial_set:
                 return None
-            clause = self._compile_clause(rule)
+            clause, plan = self._compile_clause(rule)
             if clause is None:
                 # nothing recognized: all-true for this clause
                 clauses.append(Clause(conds=(Const(True),), slot_iter=None))
+                plans.append(None)
                 self.exact = False
             else:
                 clauses.append(clause)
+                plans.append(plan)
         return VProgram(
             clauses=clauses,
             column_specs=list(self.columns.values()),
@@ -144,24 +147,50 @@ class Vectorizer:
             str_preds=self.str_preds,
             literals=sorted(self.literals),
             exact=self.exact,
+            clause_plans=tuple(plans),
         )
 
     # ---- clause compilation ----------------------------------------------
 
-    def _compile_clause(self, rule: Rule) -> Optional[Clause]:
+    def _compile_clause(self, rule: Rule):
         env: Dict[str, Any] = {}
         conds: List = []
-        state = {"slot": None}
+        # guards: rhs terms of recognized non-iteration assignments.  The
+        # MASK may ignore their definedness (dropping them only widens),
+        # but the render plan replaces the interpreter as the exactness
+        # filter, so an assignment whose rhs is undefined (missing field,
+        # failed benign call) must fail the clause there exactly as it
+        # fails the interpreted body (ops/renderplan.py guard plans).
+        # helper_guards is ONE shared list: dict(state) copies in nested
+        # helper inlining alias it, so guards surface from any depth
+        state = {"slot": None, "guards": [], "helper_guards": []}
+        # AST-level assignment environment for the message-plan compiler:
+        # the rule key typically references body-assigned vars
+        # (`msg := sprintf(...)`) whose AST the symbolic env discards
+        ast_env: Dict[str, Node] = {}
         recognized = 0
         for stmt in rule.body:
+            if (
+                stmt.kind in ("assign", "unify")
+                and len(stmt.terms) == 2
+                and isinstance(stmt.terms[0], Var)
+            ):
+                ast_env.setdefault(stmt.terms[0].name, stmt.terms[1])
             ok = self._compile_stmt(stmt, env, conds, state, exact_required=False)
             if ok:
                 recognized += 1
             else:
                 self.exact = False
         if recognized == 0 and not conds and state["slot"] is None:
-            return None
-        return Clause(conds=tuple(conds), slot_iter=state["slot"])
+            return None, None
+        clause = Clause(conds=tuple(conds), slot_iter=state["slot"])
+        from .renderplan import compile_clause_plan
+
+        plan = compile_clause_plan(
+            self, rule, env, ast_env, state["slot"], state["guards"],
+            state["helper_guards"],
+        )
+        return clause, plan
 
     def _compile_stmt(self, stmt: Expr, env, conds, state, exact_required: bool) -> bool:
         """Compile one statement into zero or more conds.  Returns False when
@@ -200,6 +229,9 @@ class Vectorizer:
             env[lhs.name] = it
             return True
         sym = self._resolve(rhs, env, state, allow_compr=True)
+        # non-iteration assignment: its rhs definedness fails the body in
+        # the interpreter, so the render plan must guard on it
+        state.setdefault("guards", []).append(rhs)
         if isinstance(sym, SUnknown):
             env[lhs.name] = sym
             if self._benign_rhs(rhs):
@@ -708,8 +740,27 @@ class Vectorizer:
                     raise _Unsupported()  # literal-arg clauses unsupported
             conds: List = []
             state2 = dict(state)
+            # helper-body assignment guards are DISJUNCT-scoped (a failing
+            # helper body only falsifies its own disjunct, never the outer
+            # clause) — collect them separately; the plan compiler accepts
+            # only always-defined ones and otherwise sends the template to
+            # the interpreter tier
+            state2["guards"] = []
             for stmt in r.body:
                 self._compile_stmt(stmt, env2, conds, state2, exact_required=True)
+            # classify NOW, in the helper's own env: always-defined rhs
+            # (literals, comprehension-derived sets/arrays) carry no
+            # definedness risk and drop; anything else is recorded and
+            # makes the template interpreter-tier for rendering
+            for g in state2["guards"]:
+                try:
+                    gsym = self._resolve(g, env2, state2, allow_compr=True)
+                except _Unsupported:
+                    gsym = None
+                if not isinstance(
+                    gsym, (SConst, SKeySet, SParamIds, SSetDiff, SPredAny)
+                ):
+                    state["helper_guards"].append(g)
             if state2["slot"] != state["slot"]:
                 # The helper clause opened its own iteration axis: reduce it
                 # locally so sibling clauses stay resource-level (a pod with

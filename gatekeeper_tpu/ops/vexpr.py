@@ -159,6 +159,11 @@ class VProgram:
     str_preds: List[StrPred] = field(default_factory=list)
     literals: List[str] = field(default_factory=list)
     exact: bool = True
+    # per-clause compiled violation-object (message) plans, parallel to
+    # `clauses` (ops/renderplan.py); None entries render via the
+    # interpreter.  Deliberately NOT part of structure_key: message
+    # literals never affect the traced device computation.
+    clause_plans: Optional[Tuple] = None
 
     def structure_key(self) -> str:
         """Template-clone batching key: programs with identical structure

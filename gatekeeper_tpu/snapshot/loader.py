@@ -375,6 +375,23 @@ class SnapshotLoader:
                 log.warning("snapshot delta basis dropped: mask shape "
                             "mismatch")
                 return False
+            # re-bind the compiled render plans eagerly and validate the
+            # classification against the snapshot's: the persisted render
+            # cache holds rendered Results, and reusing them under a
+            # DIFFERENT plan classification (a plan-compiler change
+            # between writer and reader) could mask a rendering change —
+            # drop the cache and re-render on mismatch, keep the rest of
+            # the warm basis either way
+            render_cache = delta["render_cache"]
+            persisted_plans = delta.get("render_plans")
+            if persisted_plans is not None:
+                if driver._render_plan_tiers() != dict(persisted_plans):
+                    log.warning(
+                        "snapshot render-plan classification diverged "
+                        "from the rebuilt plans; dropping the persisted "
+                        "render cache (first sweep re-renders)"
+                    )
+                    render_cache = {}
             # device upload stays lazy: the first sweep with zero churn
             # never needs the mask at all
             mask_src = MaskSource(lambda: jax.device_put(mask))
@@ -386,7 +403,7 @@ class SnapshotLoader:
                 K=int(delta["K"]),
                 mask_src=mask_src,
                 row_cols=delta["row_cols"],
-                render_cache=delta["render_cache"],
+                render_cache=render_cache,
                 cs_epoch=driver._cs_epoch,
                 layout_gen=ap.layout_gen,
                 store_epoch=driver.store.epoch,

@@ -79,7 +79,13 @@ class SnapshotWriter:
         ordered_keys = [
             (k, n) for k, n, _c in driver._ordered_constraints()
         ]
+        # compiled message-plan tiers per constraint: the loader re-binds
+        # plans after template replay and validates the classification
+        # against this map — a drift (e.g. a plan-compiler change between
+        # writer and reader versions) drops the persisted render cache
+        # instead of silently reusing results a different tier produced
         return {
+            "render_plans": driver._render_plan_tiers(),
             "counts": st.counts.copy(),
             "cand": [list(c) for c in st.cand],
             "horizon": list(st.horizon),

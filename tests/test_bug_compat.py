@@ -1,0 +1,115 @@
+"""Pins the documented reference divergences (docs/rego.md "Known
+divergences") as executable assertions, and exercises the GK_BUG_COMPAT
+switch (engine/compat.py) that restores the safely-emulable subset of the
+reference's behavior.  A silent drift in either direction fails here
+instead of surfacing as a production migration surprise."""
+
+import pytest
+
+from gatekeeper_tpu.engine import builtins as bi
+from gatekeeper_tpu.engine.builtins import BuiltinError, BuiltinLimitError
+
+
+@pytest.fixture
+def compat(monkeypatch):
+    monkeypatch.setenv("GK_BUG_COMPAT", "1")
+
+
+@pytest.fixture
+def no_compat(monkeypatch):
+    monkeypatch.setenv("GK_BUG_COMPAT", "0")
+
+
+def _call(path, *args):
+    return bi.lookup(path)(*args)
+
+
+# ---- regex.globs_match ------------------------------------------------------
+
+
+def test_globs_match_empty_globs_divergence(no_compat):
+    # documented divergence: the reference's vendored library answers
+    # true for two empty globs; the documented semantics ("share a
+    # non-empty string") say false, and this engine follows the docs
+    assert _call(("regex", "globs_match"), "", "") is False
+
+
+def test_globs_match_empty_globs_bug_compat(compat):
+    assert _call(("regex", "globs_match"), "", "") is True
+
+
+def test_globs_match_greedy_false_negative_divergence(no_compat):
+    # documented divergence pinned in BOTH modes: the reference's greedy
+    # token scan answers false for "a*" vs "a*b*" even though "ab" is in
+    # both glob languages; this engine computes the exact product-NFA
+    # answer (true) and deliberately does NOT emulate the library's
+    # false negative (see engine/compat.py)
+    assert _call(("regex", "globs_match"), "a*", "a*b*") is True
+
+
+def test_globs_match_greedy_false_negative_not_emulated(compat):
+    assert _call(("regex", "globs_match"), "a*", "a*b*") is True
+
+
+# ---- bits.lsh / bits.rsh ----------------------------------------------------
+
+
+def test_bits_shift_negative_is_builtin_error_both_modes(no_compat):
+    with pytest.raises(BuiltinError):
+        _call(("bits", "lsh"), 1, -1)
+    with pytest.raises(BuiltinError):
+        _call(("bits", "rsh"), 1, -1)
+
+
+def test_bits_lsh_over_cap_fails_closed_by_default(no_compat):
+    with pytest.raises(BuiltinLimitError):
+        _call(("bits", "lsh"), 1, (1 << 20) + 1)
+
+
+def test_bits_rsh_over_cap_fails_closed_by_default(no_compat):
+    with pytest.raises(BuiltinLimitError):
+        _call(("bits", "rsh"), 1, (1 << 20) + 1)
+
+
+def test_bits_rsh_over_cap_exact_under_compat(compat):
+    # OPA computes the exact result for any magnitude; a right shift
+    # only shrinks, so compat mode can afford exactness
+    assert _call(("bits", "rsh"), 12345, (1 << 20) + 1) == 0
+    assert _call(("bits", "rsh"), -1, 10**9) == -1  # Go arithmetic shift
+    assert _call(("bits", "rsh"), 1 << 21, 1 << 21) == 0
+
+
+def test_bits_lsh_over_cap_undefined_not_abort_under_compat(compat):
+    # the magnitude cap stays (allocation bomb) but the failure mode
+    # follows OPA's error contract: expression undefined, query survives
+    with pytest.raises(BuiltinError) as ei:
+        _call(("bits", "lsh"), 1, (1 << 20) + 1)
+    assert not isinstance(ei.value, BuiltinLimitError)
+
+
+def test_bits_shift_in_cap_identical_both_modes(monkeypatch):
+    for flag in ("0", "1"):
+        monkeypatch.setenv("GK_BUG_COMPAT", flag)
+        assert _call(("bits", "lsh"), 3, 4) == 48
+        assert _call(("bits", "rsh"), 48, 4) == 3
+
+
+def test_policy_level_bug_compat(compat):
+    """A violation rule using an over-cap rsh fires identically to OPA
+    under compat (exact result) instead of erroring the query."""
+    from gatekeeper_tpu.engine.interp import TemplatePolicy
+    from gatekeeper_tpu.engine.value import freeze
+
+    pol = TemplatePolicy.compile(
+        """
+package t
+
+violation[{"msg": "big shift"}] {
+  bits.rsh(input.review.object.x, 2097153) == 0
+}
+"""
+    )
+    out = pol.eval_violations(
+        freeze({"object": {"x": 7}}), freeze({}), freeze({})
+    )
+    assert out == [{"msg": "big shift"}]

@@ -1,0 +1,36 @@
+"""Tier-1 wiring for tools/check_render_parity.py: plan-vs-interpreter
+byte parity over the corpus and the static/slots classification-coverage
+floor run on every test invocation — a plan-compiler regression fails
+fast, before it could ship wrong deny messages."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+import check_render_parity as chk  # noqa: E402
+
+
+def test_repo_render_plans_are_conformant():
+    assert chk.run_checks() == []
+
+
+def test_parity_detector_flags_divergence(monkeypatch):
+    """A renderer that drops violations must be detected."""
+    from gatekeeper_tpu.ops import renderplan as rp
+
+    orig = rp.BoundPlan.apply
+    monkeypatch.setattr(
+        rp.BoundPlan, "apply", lambda self, row: orig(self, row)[:-1]
+    )
+    problems = chk.check_byte_parity()
+    assert problems and all("diverges" in p for p in problems)
+
+
+def test_coverage_detector_flags_regression(monkeypatch):
+    """If binding started failing wholesale, the coverage floor trips."""
+    from gatekeeper_tpu.ops import renderplan as rp
+
+    monkeypatch.setattr(rp, "bind", lambda *a, **k: None)
+    problems = chk.check_classification_coverage()
+    assert problems and "classification" in problems[0]

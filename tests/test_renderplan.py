@@ -1,0 +1,422 @@
+"""Unit tests for the compiled render pipeline internals
+(ops/renderplan.py) and its driver seams: format splitting, bind-time
+partial evaluation, the bounded render-memo eviction, and the worker
+pool's ordering/exception contract."""
+
+import pytest
+
+from gatekeeper_tpu.engine.interp import TemplatePolicy
+from gatekeeper_tpu.engine.value import freeze
+from gatekeeper_tpu.ops import renderplan as rp
+from gatekeeper_tpu.ops.vectorizer import vectorize
+
+
+def _bind(rego, params):
+    pol = TemplatePolicy.compile(rego)
+    prog = vectorize(pol)
+    constraint = {
+        "kind": "T", "metadata": {"name": "c"},
+        "spec": {"match": {}, "parameters": params},
+    }
+    return rp.bind(prog, pol, constraint), pol
+
+
+# ---- format splitting -------------------------------------------------------
+
+
+def test_split_simple_fmt():
+    assert rp._split_simple_fmt("a %v b %s c") == ["a ", " b ", " c"]
+    assert rp._split_simple_fmt("100%% sure: %v") == ["100% sure: ", ""]
+    assert rp._split_simple_fmt("no verbs") == ["no verbs"]
+    # flags/width/other verbs fall back to the generic builtin
+    assert rp._split_simple_fmt("%d") is None
+    assert rp._split_simple_fmt("%5v") is None
+    assert rp._split_simple_fmt("%+v") is None
+
+
+def test_non_simple_verbs_still_render_exactly():
+    plan, pol = _bind(
+        """
+package t
+
+violation[{"msg": msg}] {
+  input.review.object.x
+  msg := sprintf("x=%d y=%v", [input.review.object.x, input.review.object.y])
+}
+""",
+        {},
+    )
+    review = {"object": {"x": 7, "y": ["a", 1]}}
+    got = plan.apply(rp.RowView(review))
+    want = pol.eval_violations(freeze(review), freeze({}), freeze({}))
+    assert got == want == [{"msg": 'x=7 y=["a", 1]'}]
+
+
+# ---- bind-time behavior -----------------------------------------------------
+
+
+def test_static_tier_precomputes_message():
+    plan, _pol = _bind(
+        """
+package t
+
+violation[{"msg": msg}] {
+  input.review.object.bad
+  msg := sprintf("policy %v forbids this", [input.parameters.p])
+}
+""",
+        {"p": "P1"},
+    )
+    assert plan.tier == rp.STATIC
+    assert plan.clauses[0].obj_static == freeze(
+        {"msg": "policy P1 forbids this"}
+    )
+    assert plan.apply(rp.RowView({"object": {"bad": True}})) == [
+        {"msg": "policy P1 forbids this"}
+    ]
+    assert plan.apply(rp.RowView({"object": {}})) == []
+
+
+def test_missing_message_param_means_clause_never_fires():
+    plan, pol = _bind(
+        """
+package t
+
+violation[{"msg": msg}] {
+  input.review.object.bad
+  msg := sprintf("policy %v forbids this", [input.parameters.p])
+}
+""",
+        {},
+    )
+    assert plan.clauses[0].never
+    review = {"object": {"bad": True}}
+    assert plan.apply(rp.RowView(review)) == []
+    assert pol.eval_violations(freeze(review), freeze({}), freeze({})) == []
+
+
+def test_unused_benign_assignment_guards_definedness():
+    """A body assignment whose rhs may be undefined fails the clause in
+    the interpreter even when the assigned var is never used; the plan
+    must guard on it (code-review finding: without the guard the plan
+    produced violations the interpreter would not — false DENYs)."""
+    rego = """
+package t
+
+violation[{"msg": msg}] {
+  input.review.object.metadata.labels.bad == "x"
+  note := sprintf("%v", [input.review.object.metadata.annotations.foo])
+  msg := "denied"
+}
+"""
+    plan, pol = _bind(rego, {})
+    assert plan is not None
+    # label present, annotation ABSENT: interpreter yields nothing
+    review = {"object": {"metadata": {"labels": {"bad": "x"}}}}
+    want = pol.eval_violations(freeze(review), freeze({}), freeze({}))
+    assert want == []
+    assert plan.apply(rp.RowView(review)) == []
+    # with the annotation present both fire
+    review2 = {"object": {"metadata": {"labels": {"bad": "x"},
+                                       "annotations": {"foo": "f"}}}}
+    want2 = pol.eval_violations(freeze(review2), freeze({}), freeze({}))
+    assert want2 == [{"msg": "denied"}]
+    assert plan.apply(rp.RowView(review2)) == want2
+
+
+def test_unused_field_assignment_guards_definedness():
+    """Same for a plain field-ref assignment (`x := obj.maybe_missing`)
+    with x unused: clause fires only when the field exists."""
+    rego = """
+package t
+
+violation[{"msg": "denied"}] {
+  input.review.object.bad
+  x := input.review.object.maybe
+}
+"""
+    plan, pol = _bind(rego, {})
+    assert plan is not None
+    for review in (
+        {"object": {"bad": True}},
+        {"object": {"bad": True, "maybe": 1}},
+        {"object": {"bad": True, "maybe": False}},  # defined-but-false: fires
+    ):
+        want = pol.eval_violations(freeze(review), freeze({}), freeze({}))
+        assert plan.apply(rp.RowView(review)) == want
+
+
+def test_slot_scoped_assignment_guard():
+    """A per-entity assignment guard fails only that binding."""
+    rego = """
+package t
+
+violation[{"msg": msg}] {
+  c := input.review.object.spec.containers[_]
+  c.bad
+  tag := c.tag
+  msg := sprintf("bad %v", [c.name])
+}
+"""
+    plan, pol = _bind(rego, {})
+    assert plan is not None
+    review = {"object": {"spec": {"containers": [
+        {"name": "a", "bad": True, "tag": "t"},
+        {"name": "b", "bad": True},  # no tag: binding fails
+    ]}}}
+    want = pol.eval_violations(freeze(review), freeze({}), freeze({}))
+    assert want == [{"msg": "bad a"}]
+    assert plan.apply(rp.RowView(review)) == want
+
+
+def test_helper_with_undefined_risk_falls_back_to_interp():
+    """An inlined helper whose body carries a definedness-risky
+    assignment cannot be expressed as a clause-level guard: the template
+    must classify interp rather than mis-render."""
+    rego = """
+package t
+
+risky(o) {
+  x := o.maybe
+  o.bad
+}
+
+violation[{"msg": "denied"}] {
+  risky(input.review.object)
+}
+"""
+    plan, _pol = _bind(rego, {})
+    assert plan is None
+
+
+def test_inexact_program_is_ineligible():
+    plan, _pol = _bind(
+        """
+package t
+
+violation[{"msg": "nope"}] {
+  some_unrecognized_builtin_chain := json.unmarshal(input.review.object.blob)
+  some_unrecognized_builtin_chain.bad
+}
+""",
+        {},
+    )
+    assert plan is None
+
+
+def test_match_exact_requires_no_selectors():
+    pol = TemplatePolicy.compile(
+        """
+package t
+
+violation[{"msg": "m"}] { input.review.object.bad }
+"""
+    )
+    prog = vectorize(pol)
+    plain = rp.bind(prog, pol, {
+        "kind": "T", "metadata": {"name": "a"}, "spec": {"match": {}},
+    })
+    selector = rp.bind(prog, pol, {
+        "kind": "T", "metadata": {"name": "b"},
+        "spec": {"match": {"labelSelector": {"matchLabels": {"x": "y"}}}},
+    })
+    assert plain.match_exact is True
+    assert selector.match_exact is False
+
+
+def test_rowview_caches_and_strips_uid():
+    review = {"uid": "u-1", "object": {"spec": {"containers": [
+        {"name": "a"}, {"name": "b"}]}}}
+    row = rp.RowView(review)
+    e1 = row.entities((("object", "spec", "containers", "[]"),))
+    e2 = row.entities((("object", "spec", "containers", "[]"),))
+    assert e1 is e2 and len(e1) == 2
+    mf = row.memo_frozen()
+    assert "uid" not in mf and mf is row.memo_frozen()
+
+
+# ---- render-memo eviction (bounded, no wholesale clear) ---------------------
+
+
+def test_render_memo_chunked_eviction():
+    from gatekeeper_tpu.ops.driver import TpuDriver
+
+    d = TpuDriver.__new__(TpuDriver)  # no heavy init needed
+    d._render_memo = {}
+    d.RENDER_MEMO_MAX = TpuDriver.RENDER_MEMO_MAX
+    for i in range(1000):
+        d._render_memo[("K", "c", i)] = (0, [])
+    d.RENDER_MEMO_MAX = 1000  # shrink the cap for the test
+    d._evict_render_memo()
+    drop = max(1, 1000 // 16)
+    assert len(d._render_memo) == 1000 - drop
+    # OLDEST entries went; newest stayed
+    assert ("K", "c", 0) not in d._render_memo
+    assert ("K", "c", 999) in d._render_memo
+    # repeated eviction keeps shrinking without ever clearing wholesale
+    d._evict_render_memo()
+    assert 0 < len(d._render_memo) < 1000 - drop
+
+
+def test_memo_cell_eviction_threshold_respected():
+    """End-to-end: crossing the cap evicts a chunk instead of clearing."""
+    from gatekeeper_tpu.client.client import Client
+    from gatekeeper_tpu.ops.driver import TpuDriver
+    from tests.render_corpus import corpus, resources, review_of
+
+    c = Client(driver=TpuDriver())
+    name, t, k, _tier = corpus()[0]
+    c.add_template(t)
+    c.add_constraint(k)
+    for obj in resources():
+        c.add_data(obj)
+    d = c.driver
+    d.mesh_enabled = False
+    d.RENDER_MEMO_MAX = 4
+    d.audit_capped(10)  # the capped path populates _render_memo
+    assert 0 < len(d._render_memo) <= d.RENDER_MEMO_MAX
+
+
+# ---- worker pool ------------------------------------------------------------
+
+
+def test_render_pool_order_and_exceptions():
+    pool = rp.RenderPool
+    n = max(pool.MIN_CELLS, 20)
+    fns = [lambda i=i: i * i for i in range(n)]
+    assert pool.map_ordered(fns) == [i * i for i in range(n)]
+
+    def boom():
+        raise RuntimeError("cell failed")
+
+    fns[3] = boom
+    with pytest.raises(RuntimeError, match="cell failed"):
+        pool.map_ordered(fns)
+    # below the threshold: serial path, same contract
+    assert pool.map_ordered([lambda: 1, lambda: 2]) == [1, 2]
+
+
+def test_intra_batch_duplicate_cells_evaluate_once():
+    """A micro-batch of identical replica pods must evaluate each
+    memoable (constraint, content) cell once even though memo stores
+    land after the render passes (code-review finding: the deferred
+    stores regressed the replica-storm contract)."""
+    from gatekeeper_tpu.client.client import Client
+    from gatekeeper_tpu.ops.driver import TpuDriver
+    from tests.render_corpus import corpus, resources, review_of
+
+    c = Client(driver=TpuDriver())
+    for _n, t, k, _tier in corpus():
+        c.add_template(t)
+        c.add_constraint(k)
+    d = c.driver
+    d.DEVICE_MIN_CELLS = 0
+    calls = [0]
+    orig = d._eval_cell
+
+    def counting(*a, **k):
+        calls[0] += 1
+        return orig(*a, **k)
+
+    d._eval_cell = counting
+    bad = resources()[0]
+    batch = [review_of(bad) for _ in range(8)]
+    # large batch: skips the request-memo probe, exercising _render_masked
+    d.REQUEST_MEMO_BATCH_MAX = 0
+    outs = d.review_batch(batch)
+    per_review = [[(r.msg, r.metadata) for r in o[0]] for o in outs]
+    assert all(pr == per_review[0] for pr in per_review)
+    n_memoable_constraints = sum(
+        1 for kind in d.constraints for name in d.constraints[kind]
+        if (kind, name) not in d._memoable_false
+    )
+    # each memoable flagged cell evaluated at most once for 8 identical
+    # reviews; only non-memoable cells may repeat
+    assert calls[0] <= n_memoable_constraints + 8 * (
+        sum(len(v) for v in d.constraints.values())
+        - n_memoable_constraints
+    )
+
+
+def test_snapshot_persists_plan_tiers_and_validates_on_restore(tmp_path):
+    """The sweep basis carries the per-constraint plan classification;
+    a restore whose rebuilt plans classify differently drops the
+    persisted render cache (results from a different tier must not be
+    replayed) while keeping the rest of the warm basis."""
+    from gatekeeper_tpu.client.client import Client
+    from gatekeeper_tpu.kube.inmem import InMemoryKube
+    from gatekeeper_tpu.ops.driver import TpuDriver
+    from gatekeeper_tpu.snapshot import SnapshotLoader, Snapshotter
+    from tests.render_corpus import corpus, resources
+
+    def fresh():
+        c = Client(driver=TpuDriver())
+        c.driver.mesh_enabled = False
+        return c
+
+    kube = InMemoryKube()
+    for obj in resources():
+        kube.create(obj)
+    snap_dir = str(tmp_path / "snaps")
+    c1 = fresh()
+    name, t, k, _tier = corpus()[0]
+    c1.add_template(t)
+    c1.add_constraint(k)
+    for obj in kube.list(("", "v1", "Pod")):
+        c1.add_data(obj)
+    res1, _tot = c1.audit_capped(20)
+    path = Snapshotter(c1, snap_dir, interval_s=0.0).write_once()
+    assert path is not None
+
+    # matching classification: warm basis restores WITH its render cache
+    c2 = fresh()
+    loader = SnapshotLoader(snap_dir)
+    assert loader.restore(c2, kube) == "restored"
+    assert loader.delta_restored
+    assert c2.driver._delta_state.render_cache  # persisted results kept
+    res2, _ = c2.audit_capped(20)
+    assert sorted((r.msg for r in res2.results())) == sorted(
+        r.msg for r in res1.results()
+    )
+
+    # diverging classification (plans disabled -> everything interp):
+    # the cache is dropped, the audit still renders identically
+    c3 = fresh()
+    c3.driver.render_plan_enabled = False
+    loader3 = SnapshotLoader(snap_dir)
+    assert loader3.restore(c3, kube) == "restored"
+    assert loader3.delta_restored
+    assert c3.driver._delta_state.render_cache == {}
+    res3, _ = c3.audit_capped(20)
+    assert sorted(r.msg for r in res3.results()) == sorted(
+        r.msg for r in res1.results()
+    )
+
+
+def test_interp_tail_through_pool_matches_serial(monkeypatch):
+    """The pooled interp tail must produce identical results to the
+    serial loop (ordering is by cell, not completion)."""
+    from gatekeeper_tpu.client.client import Client
+    from gatekeeper_tpu.ops.driver import TpuDriver
+    from tests.render_corpus import corpus, resources, review_of
+
+    def mk():
+        c = Client(driver=TpuDriver())
+        for _n, t, k, _tier in corpus():
+            c.add_template(t)
+            c.add_constraint(k)
+        c.driver.DEVICE_MIN_CELLS = 0
+        return c
+
+    a, b = mk(), mk()
+    monkeypatch.setattr(rp.RenderPool, "MIN_CELLS", 1)  # force pooling
+    outs_pooled = [
+        [(r.msg, r.metadata) for r in a.review(review_of(o)).results()]
+        for o in resources()
+    ]
+    monkeypatch.setattr(rp.RenderPool, "MIN_CELLS", 10**9)  # force serial
+    outs_serial = [
+        [(r.msg, r.metadata) for r in b.review(review_of(o)).results()]
+        for o in resources()
+    ]
+    assert outs_pooled == outs_serial
