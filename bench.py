@@ -648,6 +648,111 @@ def bench_render() -> dict:
     }
 
 
+def bench_slo() -> dict:
+    """Cost-attribution overhead (ISSUE 5): the violating-unique
+    admission p50 with the cost ledger enabled vs disabled, interleaved
+    round-robin so co-tenant noise hits both arms alike.  Also exercises
+    the SLO collect hook + OpenMetrics exemplar rendering once so the
+    artifact records that the whole attribution surface works."""
+    import gc
+
+    import numpy as np
+
+    from gatekeeper_tpu.client.client import Client
+    from gatekeeper_tpu.metrics.views import Registry
+    from gatekeeper_tpu.metrics.exporter import render_openmetrics
+    from gatekeeper_tpu.obs import costs as obscosts
+    from gatekeeper_tpu.obs import slo as obsslo
+    from gatekeeper_tpu.ops.driver import TpuDriver
+    from gatekeeper_tpu.util.synthetic import make_pods, make_templates
+
+    n_templates = int(os.environ.get("BENCH_SLO_TEMPLATES", "500"))
+    templates, constraints = make_templates(n_templates)
+    c = Client(driver=TpuDriver())
+    for t, k in zip(templates, constraints):
+        c.add_template(t)
+        c.add_constraint(k)
+
+    def req(p, i):
+        return {
+            "uid": f"u{i}",
+            "kind": {"group": "", "version": "v1", "kind": "Pod"},
+            "name": p["metadata"]["name"],
+            "namespace": p["metadata"]["namespace"],
+            "operation": "CREATE",
+            "userInfo": {"username": "bench"},
+            "object": p,
+        }
+
+    ledger = obscosts.get_ledger()
+    was_enabled = ledger.enabled
+    ledger.clear()
+    c.review(req(make_pods(1, seed=9, violation_rate=1.0)[0], 1))  # warm
+    gc.collect()
+    gc.freeze()
+    gc.disable()
+    try:
+        # 3 interleaved rounds per arm, fresh unique pods every batch so
+        # the request memo never serves either arm; best-round p50 per
+        # arm (host work: the minimum is the true cost, the rest is
+        # scheduler noise — the render config's convention)
+        p50s = {False: [], True: []}
+        seq = 0
+        for r in range(3):
+            for enabled in (False, True):
+                ledger.enabled = enabled
+                pods = make_pods(
+                    64, seed=101 + 10 * r + enabled, violation_rate=1.0
+                )
+                lat = []
+                for p in pods:
+                    seq += 1
+                    s = time.perf_counter()
+                    c.review(req(p, seq))
+                    lat.append((time.perf_counter() - s) * 1e3)
+                p50s[enabled].append(float(np.percentile(lat, 50)))
+    finally:
+        gc.enable()
+        gc.unfreeze()
+        ledger.enabled = was_enabled
+    p50_off = min(p50s[False])
+    p50_on = min(p50s[True])
+    overhead_pct = (
+        (p50_on - p50_off) / p50_off * 100.0 if p50_off else 0.0
+    )
+    # attribution sanity on the same traffic: the ledger saw every
+    # template, the top-K export caps labels, exemplars render
+    snap = ledger.snapshot(top=10)
+    reg = Registry()
+    obscosts.collect_hook(reg)
+    obsslo.collect_hook(reg)
+    om = render_openmetrics(reg)
+    exporting_ok = (
+        om.endswith("# EOF\n")
+        and len(snap["templates"]) == 10
+        and bool(reg.view_rows("slo_burn_rate"))
+    )
+    ledger.clear()
+    log(
+        f"slo: violating-unique p50 ledger-off={p50_off:.2f}ms "
+        f"on={p50_on:.2f}ms overhead={overhead_pct:+.2f}%; "
+        f"window tracked {snap['tracked_templates']} templates; "
+        f"export {'ok' if exporting_ok else 'BROKEN'}"
+    )
+    return {
+        "metric": f"cost-attribution overhead on violating-unique "
+                  f"admission p50 ({n_templates} templates)",
+        "value": round(overhead_pct, 2),
+        "unit": "%",
+        "vs_baseline": 0,
+        "cost_attribution_overhead_pct": round(overhead_pct, 2),
+        "ingest_p50_ms_ledger_off": round(p50_off, 3),
+        "ingest_p50_ms_ledger_on": round(p50_on, 3),
+        "cost_tracked_templates": snap["tracked_templates"],
+        "cost_export_ok": exporting_ok,
+    }
+
+
 def bench_restart() -> dict:
     """Warm-restart recovery (SURVEY §5.4; the reference rebuilds all
     derived state on boot in seconds, pkg/controller/controller.go:124-126).
@@ -1656,6 +1761,7 @@ CONFIGS = {
     "batch1m": bench_batch1m,
     "ingest": bench_ingest,
     "render": bench_render,
+    "slo": bench_slo,
     "curve": bench_curve,
     "restart": bench_restart,
     "warm_resume": bench_warm_resume,

@@ -32,6 +32,7 @@ from datetime import datetime, timezone
 from .. import logging as gklog
 from ..client.drivers import constraint_match_spec
 from ..kube.inmem import GVK, InMemoryKube, NotFound
+from ..obs import slo as obsslo
 from ..obs import trace as obstrace
 from ..process.excluder import AUDIT, Excluder
 from ..target.target import AugmentedUnstructured
@@ -183,6 +184,10 @@ class AuditManager:
         self.consecutive_failures = 0
         self.last_run_status = "ok"
         self._report_status(True)
+        # freshness anchor for the SLO engine's audit_last_run_age_s
+        # gauge and audit_freshness probe (obs/slo.py) — success only:
+        # a failing loop must read as stale, not fresh
+        obsslo.observe_audit_run()
         if self.snapshotter is not None:
             try:
                 self.snapshotter.notify_sweep()

@@ -144,6 +144,27 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--trace-sample-rate", type=float, default=1.0,
                    help="fraction of completed traces retained in the "
                         "/debug/traces ring (slow traces always retained)")
+    # cost attribution + SLO engine (docs/slo.md)
+    p.add_argument("--cost-top-k", type=int, default=20,
+                   help="templates exported individually by the cost "
+                        "ledger (gatekeeper_cost_* metrics and "
+                        "/debug/costs); the rest roll up into 'other'")
+    p.add_argument("--slo-admission-latency-ms", type=float, default=100.0,
+                   help="admission latency SLO threshold: a request "
+                        "answered slower than this consumes error budget")
+    p.add_argument("--slo-admission-target", type=float, default=0.999,
+                   help="admission latency SLO objective (fraction of "
+                        "requests within the threshold)")
+    p.add_argument("--slo-error-rate-target", type=float, default=0.999,
+                   help="fail-closed error-rate SLO objective (fraction "
+                        "of requests not answered by the error path)")
+    p.add_argument("--slo-audit-max-age-s", type=float, default=0.0,
+                   help="audit freshness SLO: maximum age of the last "
+                        "successful sweep (0 = 5x --audit-interval)")
+    p.add_argument("--slo-trip-breaker", action="store_true",
+                   help="trip the TPU circuit breaker to the interpreter "
+                        "tier when the admission-latency SLO fast-burn "
+                        "alert fires (default: report only)")
     # state snapshot & warm resume (docs/snapshots.md)
     p.add_argument("--snapshot-dir",
                    default=os.environ.get("GK_SNAPSHOT_DIR", ""),
@@ -364,6 +385,26 @@ class App:
             ),
             sample_rate=getattr(args, "trace_sample_rate", 1.0),
         )
+        # cost attribution + SLO engine (docs/slo.md): configure the
+        # process-global ledger/engine the driver and webhook feed
+        from .obs import costs as obscosts
+        from .obs import slo as obsslo
+
+        obscosts.configure(top_k=getattr(args, "cost_top_k", 20))
+        audit_max_age = getattr(args, "slo_audit_max_age_s", 0.0) or (
+            5.0 * getattr(args, "audit_interval", 60.0)
+        )
+        obsslo.configure(
+            admission_threshold_ms=getattr(
+                args, "slo_admission_latency_ms", 100.0),
+            admission_target=getattr(args, "slo_admission_target", 0.999),
+            error_target=getattr(args, "slo_error_rate_target", 0.999),
+            audit_max_age_s=audit_max_age,
+            # a webhook-only pod never runs a sweep: its freshness probe
+            # must not latch the degraded marker forever
+            audit_expected=self.operations.is_assigned(ops_mod.AUDIT),
+        )
+        self._collect_hooks = [obscosts.collect_hook, obsslo.collect_hook]
 
         if getattr(args, "fault_plane_seed", None) is not None:
             from . import faults
@@ -487,12 +528,30 @@ class App:
         self.tracker.run(self.kube)
         self.manager.start()
 
-        # degradation visibility: breaker state (TPU driver only) for the
-        # health endpoints and /statusz
+        # degradation visibility: breaker state (TPU driver only) plus the
+        # SLO engine's burn-rate status for /healthz + /statusz
+        from .obs import slo as obsslo
+
         breaker_fn = getattr(self.client.driver, "breaker_status", None)
-        health_status = (
-            (lambda: {"tpu_breaker": breaker_fn()}) if breaker_fn else None
-        )
+        slo_engine = obsslo.get_engine()
+
+        def health_status():
+            st = {"slo": slo_engine.evaluate()}
+            if breaker_fn is not None:
+                st["tpu_breaker"] = breaker_fn()
+            return st
+
+        if getattr(args, "slo_trip_breaker", False):
+            breaker = getattr(self.client.driver, "breaker", None)
+            if breaker is not None:
+                def _slo_trip(name, pair, _breaker=breaker):
+                    # the opt-in degradation signal: a fast burn on
+                    # admission latency degrades evaluation to the
+                    # interpreter tier via the existing breaker ladder
+                    if name == obsslo.ADMISSION_LATENCY and pair == "fast":
+                        _breaker.trip()
+
+                slo_engine.on_alert(_slo_trip)
 
         if self.operations.is_assigned(ops_mod.WEBHOOK):
             self.micro_batcher = MicroBatcher(
@@ -554,7 +613,8 @@ class App:
             self.audit_manager.start()
 
         self.metrics_exporter = MetricsExporter(
-            port=args.prometheus_port, registry=self.reporters.registry
+            port=args.prometheus_port, registry=self.reporters.registry,
+            collect_hooks=self._collect_hooks,
         )
         self.metrics_exporter.start()
         # --metrics-addr (main.go:87): an additional bind for the same
@@ -572,6 +632,7 @@ class App:
             self.metrics_addr_exporter = MetricsExporter(
                 port=port, registry=self.reporters.registry,
                 host=host.strip("[]") or "0.0.0.0",  # bracketed IPv6
+                collect_hooks=self._collect_hooks,
             )
             self.metrics_addr_exporter.start()
         if args.enable_pprof:

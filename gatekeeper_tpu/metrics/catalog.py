@@ -152,6 +152,56 @@ SNAPSHOT_RESTORE_M = Measure(
     "Startup snapshot restore attempts by outcome (restored, fallback, "
     "none, disabled)",
 )
+# ---- cost attribution + SLO engine (ISSUE 5) --------------------------------
+# The cost_* gauges are refreshed from the cost ledger's decaying window
+# by the exporter's pre-scrape hook (obs/costs.py collect); their
+# `template` label is top-K-capped with an `other` rollup — the
+# cardinality contract tools/check_observability.py lints.
+COST_DEVICE_MS_M = Measure(
+    "cost_device_ms",
+    "Device (dispatch) milliseconds attributed to a template over the "
+    "cost-ledger window, apportioned by evaluated cells",
+    unit="ms",
+)
+COST_RENDER_MS_M = Measure(
+    "cost_render_ms",
+    "Host render milliseconds attributed to a template over the "
+    "cost-ledger window, apportioned by rendered cells",
+    unit="ms",
+)
+COST_CELLS_M = Measure(
+    "cost_cells",
+    "Cells evaluated for a template over the cost-ledger window",
+)
+COST_RENDER_CELLS_M = Measure(
+    "cost_render_cells",
+    "Violation-candidate cells rendered for a template over the "
+    "cost-ledger window, by render-plan tier",
+)
+COST_VIOLATIONS_M = Measure(
+    "cost_violations",
+    "Violations rendered for a template over the cost-ledger window",
+)
+COST_MEMO_HIT_RATIO_M = Measure(
+    "cost_memo_hit_ratio",
+    "Review-memo hit ratio for a template's rendered cells over the "
+    "cost-ledger window",
+)
+SLO_BURN_M = Measure(
+    "slo_burn_rate",
+    "Error-budget burn rate per SLO objective and trailing window "
+    "(1.0 = budget consumed exactly at the sustainable rate)",
+)
+SLO_BUDGET_M = Measure(
+    "slo_error_budget_remaining",
+    "Fraction of the 6h error budget remaining per SLO objective",
+)
+AUDIT_AGE_M = Measure(
+    "audit_last_run_age_s",
+    "Seconds since the last successful audit sweep finished (since "
+    "process start when none has completed)",
+    unit="s",
+)
 
 # bucket boundaries copied from the reference's view.Distribution calls
 _INGEST_BUCKETS = (
@@ -239,7 +289,38 @@ def catalog_views():
         View("snapshot_bytes", SNAPSHOT_BYTES_M, AGG_LAST_VALUE),
         View("snapshot_restore_outcome_total", SNAPSHOT_RESTORE_M, AGG_COUNT,
              tag_keys=("outcome",)),
+        View("cost_device_ms", COST_DEVICE_MS_M, AGG_LAST_VALUE,
+             tag_keys=("template",)),
+        View("cost_render_ms", COST_RENDER_MS_M, AGG_LAST_VALUE,
+             tag_keys=("template",)),
+        View("cost_cells", COST_CELLS_M, AGG_LAST_VALUE,
+             tag_keys=("template",)),
+        View("cost_render_cells", COST_RENDER_CELLS_M, AGG_LAST_VALUE,
+             tag_keys=("template", "plan")),
+        View("cost_violations", COST_VIOLATIONS_M, AGG_LAST_VALUE,
+             tag_keys=("template",)),
+        View("cost_memo_hit_ratio", COST_MEMO_HIT_RATIO_M, AGG_LAST_VALUE,
+             tag_keys=("template",)),
+        View("slo_burn_rate", SLO_BURN_M, AGG_LAST_VALUE,
+             tag_keys=("objective", "window")),
+        View("slo_error_budget_remaining", SLO_BUDGET_M, AGG_LAST_VALUE,
+             tag_keys=("objective",)),
+        View("audit_last_run_age_s", AUDIT_AGE_M, AGG_LAST_VALUE),
     ]
+
+
+# views whose `template`/`constraint` labels are produced ONLY by the
+# top-K-capped cost-ledger collector (obs/costs.py) — the label-
+# cardinality lint (tools/check_observability.py) requires every view
+# carrying such a tag key to be declared here
+CAPPED_CARDINALITY_VIEWS = {
+    "cost_device_ms",
+    "cost_render_ms",
+    "cost_cells",
+    "cost_render_cells",
+    "cost_violations",
+    "cost_memo_hit_ratio",
+}
 
 
 def register_catalog(registry: Optional[Registry] = None) -> Registry:
@@ -284,6 +365,7 @@ class Reporters:
         self.registry.record(
             REQUEST_DURATION_M, duration_s,
             {"admission_status": admission_status},
+            exemplar_trace_id=_current_trace_id(),
         )
 
     # -- audit ----------------------------------------------------------------
@@ -363,6 +445,30 @@ def record_breaker(status: dict, registry: Optional[Registry] = None):
 _GLOBAL_READY = False
 
 
+_TRACE_ID_FN = None
+
+
+def _current_trace_id():
+    """Trace id of the active span, for histogram exemplars — one
+    ContextVar read once the import is memoized; None (no exemplar)
+    outside a trace."""
+    global _TRACE_ID_FN
+    fn = _TRACE_ID_FN
+    if fn is None:
+        try:
+            from ..obs.trace import current_trace_id as fn
+        except Exception:  # pragma: no cover - degraded obs layer
+            # memoize the failure too: a broken obs import must cost one
+            # attribute read per record, not a re-raised import per
+            # hot-path sample
+            fn = lambda: None  # noqa: E731
+        _TRACE_ID_FN = fn
+    try:
+        return fn()
+    except Exception:  # pragma: no cover - telemetry never blocks eval
+        return None
+
+
 def _global() -> Registry:
     global _GLOBAL_READY
     registry = global_registry()
@@ -376,10 +482,14 @@ def record_stage(measure: Measure, seconds: float,
                  tags: Optional[Dict[str, str]] = None):
     """One stage-duration sample into the new per-stage histograms
     (tpu_pack_seconds / tpu_dispatch_seconds / tpu_compile_seconds /
-    webhook_batch_queue_seconds).  Guarded: a metrics-layer defect must
-    never fail the admission/audit evaluation that is being measured."""
+    webhook_batch_queue_seconds), exemplar-linked to the active trace.
+    Guarded: a metrics-layer defect must never fail the admission/audit
+    evaluation that is being measured."""
     try:
-        _global().record(measure, seconds, tags)
+        _global().record(
+            measure, seconds, tags,
+            exemplar_trace_id=_current_trace_id(),
+        )
     except Exception:  # pragma: no cover - telemetry never blocks eval
         pass
 

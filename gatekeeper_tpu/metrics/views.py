@@ -10,8 +10,15 @@ last-value, and bucketed distribution.
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
+
+# wall-clock anchor for exemplar timestamps: captured once at import so
+# the hot-path record() never touches the wall clock (the obs/trace.py
+# pattern; tools/check_observability.py enforces it)
+_WALL_ANCHOR = time.time()  # wall-clock: ok (import-time anchor)
+_PERF_ANCHOR = time.perf_counter()
 
 AGG_COUNT = "count"
 AGG_SUM = "sum"
@@ -42,6 +49,17 @@ class View:
             raise ValueError(f"view {self.name}: distribution requires buckets")
 
 
+@dataclass(frozen=True)
+class Exemplar:
+    """One trace-linked sample on a distribution bucket (ISSUE 5): the
+    OpenMetrics exemplar triple linking a hot histogram bucket to the
+    /debug/traces entry that produced it."""
+
+    value: float
+    trace_id: str
+    ts: float  # epoch seconds (anchor-derived, never a hot-path time.time)
+
+
 @dataclass
 class DistributionData:
     bucket_counts: List[int]
@@ -49,6 +67,9 @@ class DistributionData:
     sum: float = 0.0
     min: float = float("inf")
     max: float = float("-inf")
+    # bucket index -> latest exemplar; bounded by construction at one
+    # exemplar per bucket (len(buckets)+1 entries at most)
+    exemplars: Dict[int, Exemplar] = field(default_factory=dict)
 
 
 @dataclass
@@ -89,11 +110,14 @@ class Registry:
         value: float,
         tags: Optional[Dict[str, str]] = None,
         count: int = 1,
+        exemplar_trace_id: Optional[str] = None,
     ) -> None:
         """Record one measurement against every view of this measure.
         ``count`` batches AGG_COUNT increments (N cache hits recorded in
         one lock hold); the other aggregations treat the call as a single
-        sample regardless."""
+        sample regardless.  ``exemplar_trace_id`` (when the caller has an
+        active trace) attaches a bounded per-bucket exemplar to every
+        distribution view of the measure."""
         tags = tags or {}
         with self._lock:
             for state in self._by_measure.get(measure.name, ()):
@@ -122,6 +146,13 @@ class Registry:
                     dist.sum += value
                     dist.min = min(dist.min, value)
                     dist.max = max(dist.max, value)
+                    if exemplar_trace_id:
+                        dist.exemplars[idx] = Exemplar(
+                            value=float(value),
+                            trace_id=exemplar_trace_id,
+                            ts=_WALL_ANCHOR
+                            + (time.perf_counter() - _PERF_ANCHOR),
+                        )
 
     def snapshot(self) -> List[Tuple[View, Dict[Tuple[str, ...], object]]]:
         import copy

@@ -36,6 +36,7 @@ from ..metrics.catalog import (
     record_render_cells,
     record_stage,
 )
+from ..obs import costs as obscosts
 from ..obs import trace as obstrace
 from ..client.drivers import (
     CompiledTemplate,
@@ -220,6 +221,10 @@ class TpuDriver(InterpDriver):
         self._bound_plans_epoch = -1
         self._uses_inventory_cache: Optional[Tuple[int, bool]] = None
         self._n_constraints_cache: Optional[Tuple[int, int]] = None
+        # per-template constraint counts for the cost ledger's dispatch
+        # apportioning (obs/costs.py), cached per constraint-side epoch —
+        # attribution must never walk 500 kinds per admission batch
+        self._cost_kinds_cache: Optional[Tuple[int, Dict[str, int]]] = None
         # per-pass render-tier counters, flushed to
         # render_cells_total{plan=...} at each render-pass boundary so the
         # hot loop pays a dict increment, not a registry record, per cell
@@ -872,6 +877,11 @@ class TpuDriver(InterpDriver):
         )
         record_stage(PACK_M, t1 - t0, {"path": "review"})
         record_stage(DISPATCH_M, t2 - t1, {"path": "review", "tier": "tpu"})
+        if obscosts.enabled():
+            obscosts.record_dispatch(
+                self._cost_kind_counts(), t2 - t1, len(reviews),
+                path="review",
+            )
         c = both.shape[0] // 2
         # crow maps each ordered constraint to its group-major mask row
         # (pad block rows drop out here)
@@ -926,6 +936,16 @@ class TpuDriver(InterpDriver):
                 plan.tier if plan is not None else "interp"
             )
         return out
+
+    def _cost_kind_counts(self) -> Dict[str, int]:
+        """{template kind: live constraint count} for cost-ledger
+        dispatch apportioning, cached per constraint-side epoch."""
+        cached = self._cost_kinds_cache
+        if cached is not None and cached[0] == self._cs_epoch:
+            return cached[1]
+        counts = {k: len(v) for k, v in self.constraints.items() if v}
+        self._cost_kinds_cache = (self._cs_epoch, counts)
+        return counts
 
     def _flush_render_counts(self):
         """Export the pass's per-tier cell counts to
@@ -1740,8 +1760,17 @@ class TpuDriver(InterpDriver):
             return out
         # one vectorized gather instead of two scalar numpy indexings per
         # cell (each is ~300ns of fancy-indexing machinery)
-        mflags = mask_np[iis, ris].tolist()
+        mfl = mask_np[iis, ris]
+        mflags = mfl.tolist()
         rflags = rej_np[iis, ris].tolist()
+        # cost-ledger attribution (obs/costs.py): flagged cells per
+        # constraint come from one vectorized bincount; the loops below
+        # only pay a dict add on the RARE events (violations, memo hits)
+        cost_on = obscosts.enabled()
+        if cost_on:
+            cells_by_i = np.bincount(iis[mfl], minlength=len(ordered))
+            attv: Dict[int, int] = {}
+            attm: Dict[int, int] = {}
         t0 = _time.perf_counter()
         cached_ns = self.store.cached_namespace
         rows: Dict[int, RowView] = {}
@@ -1783,11 +1812,17 @@ class TpuDriver(InterpDriver):
                 if hit is not None:
                     resolved[idx] = hit
                     memo_hits += 1
+                    if cost_on:
+                        attm[i] = attm.get(i, 0) + 1
+                        if hit:
+                            attv[i] = attv.get(i, 0) + len(hit)
                     continue
                 src = seen_mkey.get(mkey)
                 if src is not None:
                     aliases[idx] = src  # same batch, same content cell
                     memo_hits += 1
+                    if cost_on:
+                        attm[i] = attm.get(i, 0) + 1
                     continue
                 seen_mkey[mkey] = idx
             plan = self._render_plan_for(kind, name, constraint)
@@ -1803,6 +1838,8 @@ class TpuDriver(InterpDriver):
                 else:
                     violations = []  # device over-approximated the match
                 resolved[idx] = violations
+                if cost_on and violations:
+                    attv[i] = attv.get(i, 0) + len(violations)
                 if mkey is not None:
                     stores.append((mkey, idx))
                 continue
@@ -1818,13 +1855,18 @@ class TpuDriver(InterpDriver):
             ]
             evaled = RenderPool.map_ordered(thunks)
             self._tier_counts["interp"] += len(deferred)
-            for (idx, _ri, _i, mkey), violations in zip(deferred, evaled):
+            for (idx, _ri, i, mkey), violations in zip(deferred, evaled):
                 resolved[idx] = violations
+                if cost_on and violations:
+                    attv[i] = attv.get(i, 0) + len(violations)
                 if mkey is not None:
                     stores.append((mkey, idx))
         t2 = _time.perf_counter()
         for idx, src in aliases.items():
             resolved[idx] = resolved[src]
+            if cost_on and resolved[src]:
+                i = cells[idx][1]
+                attv[i] = attv.get(i, 0) + len(resolved[src])
         for mkey, idx in stores:
             if len(self._review_memo) >= self.REVIEW_MEMO_MAX:
                 self._review_memo.clear()
@@ -1869,6 +1911,20 @@ class TpuDriver(InterpDriver):
             "interp_ms": (t2 - t1) * 1e3,
             "assemble_ms": (t3 - t2) * 1e3,
         }
+        if cost_on:
+            # one ledger record per pass: per-constraint flagged cells,
+            # bound plan tier, violation + memo counts; render seconds
+            # apportioned by cells inside the ledger
+            entries = []
+            for i in np.nonzero(cells_by_i)[0].tolist():
+                kind, name, _constraint = ordered[i]
+                plan = self._bound_plans.get((kind, name))
+                entries.append((
+                    kind, name, int(cells_by_i[i]),
+                    getattr(plan, "tier", None) or "interp",
+                    attv.get(i, 0), attm.get(i, 0),
+                ))
+            obscosts.record_render(entries, t1 - t0, t2 - t1)
         self._flush_render_counts()
         return out
 
@@ -1905,6 +1961,11 @@ class TpuDriver(InterpDriver):
                 DISPATCH_M, t_served - t_synced,
                 {"path": "review", "tier": "numpy"},
             )
+            if obscosts.enabled():
+                obscosts.record_dispatch(
+                    self._cost_kind_counts(), t_served - t_synced,
+                    len(reviews), path="review",
+                )
             ordered, mask, rej = got
             inventory = self._inventory_for_render()
             with obstrace.span("render", stage=obstrace.RENDER,
@@ -2359,6 +2420,11 @@ class TpuDriver(InterpDriver):
                              fetch_bytes=float(packed.nbytes))
         record_stage(PACK_M, t1 - t0, {"path": "audit"})
         record_stage(DISPATCH_M, t2 - t1, {"path": "audit", "tier": "tpu"})
+        if obscosts.enabled():
+            obscosts.record_dispatch(
+                self._cost_kind_counts(), t2 - t1, int(ap.n_rows),
+                path="audit",
+            )
         return sweep
 
     def _audit_masks(self):
@@ -2823,6 +2889,8 @@ class TpuDriver(InterpDriver):
         fallback_rows = 0
         fallback_bytes = 0
         tiers0 = dict(self._tier_counts)
+        cost_on = obscosts.enabled()
+        cost_entries: List[Tuple] = []
 
         def render(ri, kind, name, constraint, uses_inv, action):
             violations = self._memo_cell(
@@ -2887,9 +2955,16 @@ class TpuDriver(InterpDriver):
                     results.extend(hit[1])
                     totals[ckey] = hit[2]
                     new_cache[ckey] = hit
+                    if cost_on:
+                        # wholesale render-cache reuse: zero cells walked,
+                        # one memo hit, the cached violations replayed
+                        cost_entries.append((
+                            kind, name, 0, "interp", len(hit[1]), 1,
+                        ))
                     continue
             action = self._enforcement_action(constraint)
             start = len(results)
+            r_start = rendered_cells
             capped = False
             for ri in candidates(ci, n_cand):
                 if len(results) - start >= cap:
@@ -2911,6 +2986,13 @@ class TpuDriver(InterpDriver):
                 )
             if sig is not None:
                 new_cache[ckey] = (sig, tuple(results[start:]), totals[ckey])
+            if cost_on:
+                plan = self._render_plan_for(kind, name, constraint)
+                cost_entries.append((
+                    kind, name, rendered_cells - r_start,
+                    getattr(plan, "tier", None) or "interp",
+                    len(results) - start, 0,
+                ))
         if trace is None:
             st.render_cache = new_cache
         tiers = {
@@ -2933,5 +3015,9 @@ class TpuDriver(InterpDriver):
             fallback_bytes=float(fallback_bytes),
             results=float(len(results)),
         )
+        if cost_on and cost_entries:
+            obscosts.record_render(
+                cost_entries, _time.perf_counter() - t0, 0.0
+            )
         self._flush_render_counts()
         return results, totals, ("\n".join(trace) if trace is not None else None)

@@ -23,6 +23,7 @@ from typing import Callable, List, Optional
 
 from .. import logging as gklog
 from ..deadline import DeadlineExceeded
+from ..obs import slo as obsslo
 from ..obs import trace as obstrace
 from ..apis.config import CONFIG_NAME, GVK as CONFIG_GVK, parse_config
 from ..kube.inmem import InMemoryKube, NotFound
@@ -195,8 +196,13 @@ class ValidationHandler:
             return _allowed()
         finally:
             obstrace.set_attrs(admission_status=status)
+            duration_s = time.monotonic() - t0
+            # SLO event stream (obs/slo.py): the same outcome + duration
+            # the request metric records, so burn rates and dashboards
+            # agree by construction
+            obsslo.observe_admission(status, duration_s)
             if self.reporter is not None:
-                self.reporter.report_request(status, time.monotonic() - t0)
+                self.reporter.report_request(status, duration_s)
 
     # ---- pieces ------------------------------------------------------------
 

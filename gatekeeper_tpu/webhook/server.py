@@ -26,6 +26,7 @@ from ..metrics.catalog import (
     record_stage,
 )
 from ..obs import trace as obstrace
+from ..obs.debug import get_router
 from .namespacelabel import NamespaceLabelHandler
 from .policy import AdmissionResponse, ValidationHandler
 
@@ -35,7 +36,6 @@ log = gklog.get("webhook.server")
 # the /metrics convention extended to the debug surface)
 QUIET_PATHS = ("/healthz", "/readyz", "/statusz", "/metrics")
 DEBUG_PREFIX = "/debug/"
-DEBUG_ENDPOINTS = ("/debug/traces", "/debug/stacks")
 
 
 class BatcherStopped(RuntimeError):
@@ -436,39 +436,20 @@ class WebhookServer:
                     self._send_text(404, "not found")
 
             def _debug_get(self):
-                """Debug introspection surface (docs/tracing.md):
+                """Debug introspection surface, served by the shared
+                DebugRouter (obs/debug.py) — the same routes (and the
+                same hardened query parsing) the metrics exporter
+                serves, so docs/tracing.md describes one contract:
                 /debug/traces?min_ms=&limit=  recent completed traces
                 /debug/stacks                 live thread-stack dump
-                Unknown /debug paths get a JSON 404 naming the surface
-                (probes must not be mistaken for admission 404s)."""
-                from urllib.parse import parse_qs, urlsplit
+                /debug/costs?top=             per-template cost ledger
+                /debug/slo                    SLO burn-rate status"""
+                from urllib.parse import urlsplit
 
                 parts = urlsplit(self.path)
-                if parts.path == "/debug/traces":
-                    q = parse_qs(parts.query)
-                    try:
-                        min_ms = float(q.get("min_ms", ["0"])[0])
-                        limit_s = q.get("limit", [None])[0]
-                        limit = int(limit_s) if limit_s is not None else None
-                    except ValueError:
-                        self._send_json(
-                            400, {"error": "min_ms/limit must be numeric"}
-                        )
-                        return
-                    self._send_bytes(
-                        200, "application/json",
-                        obstrace.traces_json(
-                            min_ms=min_ms, limit=limit
-                        ).encode(),
-                    )
-                elif parts.path == "/debug/stacks":
-                    self._send_json(200, obstrace.dump_stacks())
-                else:
-                    self._send_json(404, {
-                        "error": "unknown debug path",
-                        "path": parts.path,
-                        "available": list(DEBUG_ENDPOINTS),
-                    })
+                self._send_bytes(
+                    *get_router().handle(parts.path, parts.query)
+                )
 
             # Admission payloads are small; a body this large is abuse or
             # corruption, never a legitimate AdmissionReview.

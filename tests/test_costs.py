@@ -1,0 +1,222 @@
+"""Cost-attribution ledger (gatekeeper_tpu/obs/costs.py): apportioning,
+decaying windows, cardinality caps, concurrent recording, metric export,
+and the driver feed (ISSUE 5)."""
+
+import threading
+
+import pytest
+
+from gatekeeper_tpu.metrics import catalog
+from gatekeeper_tpu.metrics.views import Registry
+from gatekeeper_tpu.obs import costs as obscosts
+from gatekeeper_tpu.obs.costs import OTHER, CostLedger
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def test_dispatch_apportioned_by_cells():
+    ledger = CostLedger(clock=FakeClock())
+    # T1 has 3 constraints, T2 has 1 -> 4 cells/row; 10 rows, 8ms device
+    ledger.record_dispatch({"T1": 3, "T2": 1}, 0.008, 10)
+    totals = ledger.totals_by_template()
+    assert totals["T1"]["device_ms"] == pytest.approx(6.0)
+    assert totals["T2"]["device_ms"] == pytest.approx(2.0)
+    assert totals["T1"]["cells"] == 30
+    assert totals["T2"]["cells"] == 10
+
+
+def test_render_apportioned_and_tier_mix():
+    ledger = CostLedger(clock=FakeClock())
+    ledger.record_render(
+        [
+            ("T1", "c1", 3, "static", 2, 1),
+            ("T2", "c2", 1, "interp", 0, 0),
+        ],
+        plan_s=0.002, interp_s=0.002,
+    )
+    totals = ledger.totals_by_template()
+    assert totals["T1"]["render_ms"] == pytest.approx(3.0)
+    assert totals["T2"]["render_ms"] == pytest.approx(1.0)
+    assert totals["T1"]["tier_mix"] == {"static": 3, "slots": 0, "interp": 0}
+    assert totals["T2"]["tier_mix"] == {"static": 0, "slots": 0, "interp": 1}
+    assert totals["T1"]["violations"] == 2
+    assert totals["T1"]["memo_hits"] == 1
+
+
+def test_window_decays_but_totals_persist():
+    clock = FakeClock()
+    ledger = CostLedger(window_s=300.0, bucket_s=30.0, clock=clock)
+    ledger.record_dispatch({"T1": 1}, 0.004, 10)
+    snap = ledger.snapshot()
+    assert snap["templates"][0]["device_ms"] == pytest.approx(4.0)
+    clock.advance(400.0)  # past the 5m window
+    snap = ledger.snapshot()
+    assert snap["templates"] == []  # window drained
+    assert snap["totals"]["device_ms"] == pytest.approx(4.0)  # cumulative
+    # fresh traffic repopulates the window
+    ledger.record_dispatch({"T1": 1}, 0.002, 5)
+    snap = ledger.snapshot()
+    assert snap["templates"][0]["device_ms"] == pytest.approx(2.0)
+    assert snap["totals"]["device_ms"] == pytest.approx(6.0)
+
+
+def test_top_k_and_other_rollup():
+    ledger = CostLedger(top_k=2, clock=FakeClock())
+    # descending cost so the ranking is deterministic
+    for i, ms in enumerate((0.008, 0.006, 0.004, 0.002)):
+        ledger.record_dispatch({f"T{i}": 1}, ms, 10)
+    snap = ledger.snapshot()  # default top = top_k = 2
+    assert [t["template"] for t in snap["templates"]] == ["T0", "T1"]
+    assert snap["other"]["device_ms"] == pytest.approx(6.0)  # T2 + T3
+    assert snap["other"]["cells"] == 20
+    # explicit ?top= widens the head
+    snap = ledger.snapshot(top=3)
+    assert [t["template"] for t in snap["templates"]] == ["T0", "T1", "T2"]
+    assert snap["other"]["device_ms"] == pytest.approx(2.0)
+
+
+def test_max_tracked_folds_into_other():
+    ledger = CostLedger(top_k=2, max_tracked=3, clock=FakeClock())
+    for i in range(10):
+        ledger.record_dispatch({f"T{i}": 1}, 0.001, 1)
+    totals = ledger.totals_by_template()
+    # 3 tracked keys + the other bucket; cost is conserved
+    assert len(totals) == 4 and OTHER in totals
+    assert sum(t["device_ms"] for t in totals.values()) == pytest.approx(10.0)
+    assert ledger.snapshot()["dropped_keys"] == 7
+
+
+def test_concurrent_records_conserve_cost():
+    """Thread-pounding: N threads recording dispatch+render concurrently
+    must neither crash nor lose cost."""
+    ledger = CostLedger(clock=FakeClock())
+    threads, per_thread = 8, 200
+    errors = []
+
+    def pound(tid):
+        try:
+            for i in range(per_thread):
+                ledger.record_dispatch({f"T{tid}": 2, "shared": 1}, 0.003, 4)
+                ledger.record_render(
+                    [(f"T{tid}", "c", 2, "slots", 1, 0)], 0.001, 0.0
+                )
+        except Exception as e:  # pragma: no cover - the assertion target
+            errors.append(e)
+
+    ts = [
+        threading.Thread(target=pound, args=(t,)) for t in range(threads)
+    ]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errors
+    totals = ledger.totals_by_template()
+    total_device = sum(t["device_ms"] for t in totals.values())
+    total_render = sum(t["render_ms"] for t in totals.values())
+    assert total_device == pytest.approx(threads * per_thread * 3.0, rel=1e-6)
+    assert total_render == pytest.approx(threads * per_thread * 1.0, rel=1e-6)
+    assert totals["shared"]["cells"] == threads * per_thread * 4
+    total_v = sum(t["violations"] for t in totals.values())
+    assert total_v == threads * per_thread
+
+
+def test_collect_exports_capped_gauges_and_retracts():
+    ledger = CostLedger(top_k=2, clock=FakeClock())
+    for i, ms in enumerate((0.008, 0.006, 0.004)):
+        ledger.record_dispatch({f"T{i}": 1}, ms, 10)
+    reg = Registry()
+    ledger.collect(reg)
+    rows = reg.view_rows("cost_device_ms")
+    assert set(rows) == {("T0",), ("T1",), (OTHER,)}
+    assert rows[("T0",)] == pytest.approx(8.0)
+    assert rows[(OTHER,)] == pytest.approx(4.0)
+    # tier-mix rows carry both labels
+    rc = reg.view_rows("cost_render_cells")
+    assert ("T0", "static") in rc
+    # a template leaving the export set is retracted to 0, not left stale
+    ledger.clear()
+    ledger.record_dispatch({"TX": 1}, 0.002, 10)
+    ledger.collect(reg)
+    rows = reg.view_rows("cost_device_ms")
+    assert rows[("TX",)] == pytest.approx(2.0)
+    assert rows[("T0",)] == 0.0 and rows[("T1",)] == 0.0
+
+
+def test_disabled_ledger_records_nothing():
+    ledger = CostLedger(clock=FakeClock())
+    ledger.enabled = False
+    ledger.record_dispatch({"T1": 1}, 0.004, 10)
+    ledger.record_render([("T1", "c", 1, "static", 1, 0)], 0.001, 0.0)
+    assert ledger.totals_by_template() == {}
+
+
+def test_driver_feeds_ledger_end_to_end():
+    """A violating review through the TPU driver lands attributed
+    device-ms, cells, tier mix and violations in the global ledger."""
+    from gatekeeper_tpu.client.client import Client
+    from gatekeeper_tpu.ops.driver import TpuDriver
+
+    from .test_controllers import CONSTRAINT, TEMPLATE
+
+    ledger = obscosts.get_ledger()
+    was_enabled = ledger.enabled
+    ledger.clear()
+    ledger.enabled = True
+    try:
+        driver = TpuDriver()
+        driver.DEVICE_MIN_CELLS = 0  # force the device path
+        driver.mesh_enabled = False
+        c = Client(driver=driver)
+        c.add_template(TEMPLATE)
+        c.add_constraint(CONSTRAINT)
+        review = {
+            "uid": "u1",
+            "kind": {"group": "", "version": "v1", "kind": "Namespace"},
+            "name": "bad", "namespace": "", "operation": "CREATE",
+            "userInfo": {"username": "alice"},
+            "object": {"apiVersion": "v1", "kind": "Namespace",
+                       "metadata": {"name": "bad", "labels": {}}},
+        }
+        resp = c.review(review)
+        assert len(resp.results()) == 1
+        totals = ledger.totals_by_template()
+        row = totals["K8sRequiredLabels"]
+        assert row["device_ms"] > 0.0
+        assert row["cells"] >= 1
+        assert row["render_cells"] >= 1
+        assert row["violations"] >= 1
+        assert sum(row["tier_mix"].values()) == row["render_cells"]
+        # the capped audit sweep (the AuditManager's default path)
+        # attributes dispatch and render too
+        c.add_data({
+            "apiVersion": "v1", "kind": "Namespace",
+            "metadata": {"name": "bad-ns", "labels": {}},
+        })
+        ledger.clear()
+        responses, totals_by_key = c.audit_capped(20)
+        assert totals_by_key
+        totals = ledger.totals_by_template()
+        row = totals["K8sRequiredLabels"]
+        assert row["device_ms"] > 0.0
+        assert row["violations"] >= 1
+    finally:
+        ledger.clear()
+        ledger.enabled = was_enabled
+
+
+def test_catalog_declares_cost_views_capped():
+    for name in catalog.CAPPED_CARDINALITY_VIEWS:
+        assert any(v.name == name for v in catalog.catalog_views())
+    for v in catalog.catalog_views():
+        if {"template", "constraint"} & set(v.tag_keys):
+            assert v.name in catalog.CAPPED_CARDINALITY_VIEWS

@@ -1,0 +1,139 @@
+"""Shared /debug router (gatekeeper_tpu/obs/debug.py): hardened query
+parsing, the new /debug/costs + /debug/slo endpoints on the webhook
+server, and parity between the two HTTP front ends (ISSUE 5)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from gatekeeper_tpu.obs import costs as obscosts
+from gatekeeper_tpu.obs.debug import DebugRouter, get_router
+
+
+def handle(path, query=""):
+    return get_router().handle(path, query)
+
+
+class TestRouterDirect:
+    def test_known_endpoints_listed(self):
+        eps = get_router().endpoints()
+        for p in ("/debug/traces", "/debug/stacks", "/debug/costs",
+                  "/debug/slo"):
+            assert p in eps
+
+    @pytest.mark.parametrize("path,query", [
+        ("/debug/traces", "min_ms=abc"),
+        ("/debug/traces", "limit=abc"),
+        ("/debug/traces", "min_ms=1&limit=1.5"),  # limit must be an int
+        ("/debug/costs", "top=abc"),
+        ("/debug/costs", "top=1.5"),
+    ])
+    def test_non_numeric_params_are_json_400(self, path, query):
+        code, ctype, body = handle(path, query)
+        assert code == 400
+        assert ctype == "application/json"
+        err = json.loads(body)["error"]
+        assert "must be" in err
+
+    def test_non_positive_top_is_400(self):
+        code, _ctype, body = handle("/debug/costs", "top=0")
+        assert code == 400
+        assert "positive" in json.loads(body)["error"]
+
+    def test_unknown_path_404_lists_endpoints(self):
+        code, _ctype, body = handle("/debug/never-heard-of-it")
+        payload = json.loads(body)
+        assert code == 404
+        assert payload["error"] == "unknown debug path"
+        assert "/debug/costs" in payload["available"]
+
+    def test_handler_defect_is_json_500_not_traceback(self):
+        router = DebugRouter()
+        router.register(
+            "/debug/boom", lambda q: (_ for _ in ()).throw(KeyError("x"))
+        )
+        code, ctype, body = router.handle("/debug/boom")
+        assert code == 500
+        assert ctype == "application/json"
+        assert "KeyError" in json.loads(body)["error"]
+
+    def test_costs_payload_respects_top(self):
+        ledger = obscosts.get_ledger()
+        was = ledger.enabled
+        ledger.clear()
+        ledger.enabled = True
+        try:
+            for i, ms in enumerate((0.006, 0.004, 0.002)):
+                ledger.record_dispatch({f"RT{i}": 1}, ms, 10)
+            code, _ctype, body = handle("/debug/costs", "top=1")
+            payload = json.loads(body)
+            assert code == 200
+            assert [t["template"] for t in payload["templates"]] == ["RT0"]
+            assert payload["other"]["device_ms"] == pytest.approx(6.0)
+        finally:
+            ledger.clear()
+            ledger.enabled = was
+
+    def test_slo_payload_shape(self):
+        code, _ctype, body = handle("/debug/slo")
+        payload = json.loads(body)
+        assert code == 200
+        assert "admission_latency" in payload["objectives"]
+        obj = payload["objectives"]["admission_latency"]
+        assert set(obj["burn_rates"]) == {"5m", "30m", "1h", "6h"}
+        assert set(obj["alerts"]) == {"fast", "slow"}
+        assert "audit_last_run_age_s" in payload
+
+
+class TestWebhookServerIntegration:
+    def test_costs_and_slo_served_with_hardened_params(self):
+        from .test_tracing import get_json, make_server
+
+        srv, mb, _rep = make_server()
+        try:
+            costs = get_json(srv.port, "/debug/costs?top=5")
+            assert "templates" in costs and "other" in costs
+            slo = get_json(srv.port, "/debug/slo")
+            assert "objectives" in slo
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                get_json(srv.port, "/debug/costs?top=nope")
+            assert exc.value.code == 400
+            assert json.loads(exc.value.read())["error"] == (
+                "top must be numeric"
+            )
+        finally:
+            srv.stop()
+            mb.stop()
+
+    def test_statusz_carries_slo(self):
+        """App wires the SLO engine into health_status; emulate that
+        wiring directly against the server."""
+        from gatekeeper_tpu.obs import slo as obsslo
+        from gatekeeper_tpu.webhook import (
+            NamespaceLabelHandler,
+            ValidationHandler,
+            WebhookServer,
+        )
+        from gatekeeper_tpu.client.client import Client
+
+        eng = obsslo.get_engine()
+        srv = WebhookServer(
+            ValidationHandler(Client()), NamespaceLabelHandler(), port=0,
+            health_status=lambda: {"slo": eng.evaluate()},
+        )
+        srv.start()
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/statusz", timeout=10
+            ) as r:
+                st = json.loads(r.read())
+            assert "objectives" in st["slo"]
+            # the slo block must not trip the /healthz degraded marker
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/healthz", timeout=10
+            ) as r:
+                assert r.read() == b"ok"
+        finally:
+            srv.stop()

@@ -1,0 +1,265 @@
+"""SLO burn-rate engine (gatekeeper_tpu/obs/slo.py): burn-rate math
+against hand-computed windows, decay, multi-window alerts, audit
+freshness, metric export, and the webhook/audit feeds (ISSUE 5)."""
+
+import pytest
+
+from gatekeeper_tpu.metrics.views import Registry
+from gatekeeper_tpu.obs import slo as obsslo
+from gatekeeper_tpu.obs.slo import (
+    ADMISSION_LATENCY,
+    AUDIT_FRESHNESS,
+    FAIL_CLOSED_ERRORS,
+    SLOEngine,
+)
+
+
+class FakeClock:
+    def __init__(self, t=10_000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def engine_with(name="x", target=0.999):
+    clock = FakeClock()
+    eng = SLOEngine(clock=clock)
+    eng.add_objective(name, target)
+    return eng, clock
+
+
+def test_burn_rate_hand_computed():
+    eng, _clock = engine_with(target=0.999)  # budget 0.001
+    eng.record("x", True, n=990)
+    eng.record("x", False, n=10)
+    rates = eng.burn_rates("x")
+    # bad fraction 10/1000 = 0.01; 0.01 / 0.001 = 10x burn in EVERY
+    # window that contains the events
+    for window in ("5m", "30m", "1h", "6h"):
+        assert rates[window] == pytest.approx(10.0)
+
+
+def test_burn_rate_windows_decay_independently():
+    eng, clock = engine_with(target=0.99)  # budget 0.01
+    eng.record("x", False, n=5)
+    eng.record("x", True, n=5)  # bad frac 0.5 -> burn 50
+    clock.advance(400.0)  # out of 5m, inside 30m/1h/6h
+    rates = eng.burn_rates("x")
+    assert rates["5m"] == 0.0
+    assert rates["30m"] == pytest.approx(50.0)
+    clock.advance(3600.0)  # out of 30m and 1h, inside 6h
+    rates = eng.burn_rates("x")
+    assert rates["30m"] == 0.0 and rates["1h"] == 0.0
+    assert rates["6h"] == pytest.approx(50.0)
+    clock.advance(22_000.0)  # out of every window
+    assert eng.burn_rates("x")["6h"] == 0.0
+
+
+def test_zero_traffic_burns_zero():
+    eng, _clock = engine_with()
+    assert eng.burn_rates("x") == {
+        "5m": 0.0, "30m": 0.0, "1h": 0.0, "6h": 0.0
+    }
+
+
+def test_mixed_buckets_sum_across_window():
+    eng, clock = engine_with(target=0.9)  # budget 0.1
+    # spread events across 3 one-minute buckets inside the 5m window:
+    # 30 bad / 300 total = 0.1 bad frac -> burn 1.0
+    for _ in range(3):
+        eng.record("x", True, n=90)
+        eng.record("x", False, n=10)
+        clock.advance(60.0)
+    assert eng.burn_rates("x")["5m"] == pytest.approx(1.0)
+
+
+def test_multiwindow_alert_fires_and_clears():
+    eng, clock = engine_with(target=0.9)  # budget 0.1
+    fired = []
+    eng.on_alert(lambda name, pair: fired.append((name, pair)))
+    # 100% bad -> burn 10: below fast (14.4), above slow (6.0)
+    eng.record("x", False, n=50)
+    st = eng.evaluate()
+    assert st["objectives"]["x"]["alerts"] == {"fast": False, "slow": True}
+    assert fired == [("x", "slow")]
+    assert eng.degraded()
+    # edge-triggered: an unchanged state must not re-fire
+    eng.evaluate()
+    assert fired == [("x", "slow")]
+    # events age out of 30m -> the alert clears
+    clock.advance(2000.0)
+    st = eng.evaluate()
+    assert st["objectives"]["x"]["alerts"]["slow"] is False
+    assert not eng.degraded()
+
+
+def test_alert_volume_floor():
+    """1 bad event out of 2 must not page anyone even at infinite burn."""
+    eng, _clock = engine_with(target=0.999)
+    eng.record("x", False, n=2)  # burn 1000x but only 2 events
+    st = eng.evaluate()
+    assert st["objectives"]["x"]["alerts"] == {"fast": False, "slow": False}
+    eng.record("x", False, n=eng.min_alert_events)
+    st = eng.evaluate()
+    assert st["objectives"]["x"]["alerts"] == {"fast": True, "slow": True}
+
+
+def test_audit_freshness_probe_and_age():
+    clock = FakeClock()
+    eng = SLOEngine(clock=clock)
+    eng.audit_max_age_s = 100.0
+    eng.add_objective(
+        AUDIT_FRESHNESS, 0.9,
+        probe=lambda: eng.audit_age_s() <= eng.audit_max_age_s,
+    )
+    # never ran: age counts from engine start
+    clock.advance(50.0)
+    assert eng.audit_age_s() == pytest.approx(50.0)
+    eng.evaluate()  # good sample (50 <= 100)
+    clock.advance(100.0)
+    eng.evaluate()  # bad sample (150 > 100)
+    with eng._lock:
+        good, bad = eng._counts(AUDIT_FRESHNESS, 21600.0)
+    assert (good, bad) == (1, 1)
+    eng.observe_audit_run()
+    assert eng.audit_age_s() == 0.0
+    st = eng.evaluate()
+    assert st["audit_last_run_age_s"] == 0.0
+
+
+def test_budget_remaining():
+    eng, _clock = engine_with(target=0.9)  # budget 0.1
+    eng.record("x", True, n=95)
+    eng.record("x", False, n=5)  # consumed: 0.05/0.1 = 50%
+    st = eng.evaluate()
+    assert st["objectives"]["x"]["budget_remaining"] == pytest.approx(0.5)
+
+
+def test_collect_exports_gauges():
+    clock = FakeClock()
+    eng = SLOEngine(clock=clock)
+    eng.add_objective("x", 0.999)
+    eng.record("x", False, n=1)
+    eng.record("x", True, n=99)
+    reg = Registry()
+    eng.collect(reg)
+    rows = reg.view_rows("slo_burn_rate")
+    assert rows[("x", "5m")] == pytest.approx(10.0)
+    assert ("x", "6h") in rows
+    assert reg.view_rows("slo_error_budget_remaining")[("x",)] < 1.0
+    assert reg.view_rows("audit_last_run_age_s")[()] >= 0.0
+
+
+def test_observe_admission_feeds_global_engine():
+    eng = obsslo.get_engine()
+    eng.clear()
+    try:
+        obsslo.observe_admission("allow", 0.001)          # fast + ok
+        obsslo.observe_admission("error", eng.admission_threshold_s + 1.0)
+        with eng._lock:
+            lat = eng._counts(ADMISSION_LATENCY, 300.0)
+            err = eng._counts(FAIL_CLOSED_ERRORS, 300.0)
+        assert lat == (1, 1)  # one within threshold, one over
+        assert err == (1, 1)  # one non-error, one error
+    finally:
+        eng.clear()
+
+
+def test_validation_handler_feeds_slo(monkeypatch):
+    """handle() feeds the global engine through its existing finally
+    block — the same outcome the request metric records."""
+    from gatekeeper_tpu.client.client import Client
+    from gatekeeper_tpu.webhook.policy import ValidationHandler
+
+    eng = obsslo.get_engine()
+    eng.clear()
+    try:
+        handler = ValidationHandler(Client())
+        resp = handler.handle({
+            "uid": "u", "namespace": "",
+            "kind": {"group": "", "version": "v1", "kind": "Pod"},
+            "operation": "CREATE",
+            "object": {"kind": "Pod", "metadata": {"name": "p"}},
+            "userInfo": {"username": "alice"},
+        })
+        assert resp.allowed
+        with eng._lock:
+            good, bad = eng._counts(FAIL_CLOSED_ERRORS, 300.0)
+        assert good == 1 and bad == 0
+    finally:
+        eng.clear()
+
+
+def test_configure_rejects_out_of_range_targets():
+    """A --slo-*-target typo (1.0, or 99.9 meaning percent) must fail
+    loudly at configure time, not zero the budget and crash every later
+    evaluate()."""
+    eng = obsslo.get_engine()
+    before = None
+    with eng._lock:
+        before = eng._objectives[ADMISSION_LATENCY].target
+    try:
+        for bad in (1.0, 0.0, 99.9, -0.1):
+            with pytest.raises(ValueError):
+                obsslo.configure(admission_target=bad)
+        with eng._lock:
+            assert eng._objectives[ADMISSION_LATENCY].target == before
+        obsslo.configure(admission_target=0.95)
+        with eng._lock:
+            assert eng._objectives[ADMISSION_LATENCY].target == 0.95
+        eng.evaluate()  # still healthy
+    finally:
+        obsslo.configure(admission_target=before)
+        eng.clear()
+
+
+def test_webhook_only_pod_is_not_stale():
+    """audit_expected=False (no audit operation assigned): the freshness
+    probe always reports good and the age gauge is withheld, so a
+    webhook-only pod never latches the degraded marker."""
+    clock = FakeClock()
+    eng = SLOEngine(clock=clock)
+    eng.audit_max_age_s = 10.0
+    eng.audit_expected = False
+    eng.min_alert_events = 1
+    eng.add_objective(
+        AUDIT_FRESHNESS, 0.999,
+        probe=lambda: (
+            not eng.audit_expected
+            or eng.audit_age_s() <= eng.audit_max_age_s
+        ),
+    )
+    clock.advance(10_000.0)  # far past any max age
+    for _ in range(5):
+        st = eng.evaluate()
+    assert st["objectives"][AUDIT_FRESHNESS]["burn_rates"]["5m"] == 0.0
+    assert not eng.degraded()
+    reg = Registry()
+    eng.collect(reg)
+    assert reg.view_rows("audit_last_run_age_s") == {}
+    # the same engine WITH audit expected does go stale
+    eng.audit_expected = True
+    for _ in range(5):
+        st = eng.evaluate()
+    assert st["objectives"][AUDIT_FRESHNESS]["alerts"]["fast"] is True
+
+
+def test_audit_manager_moves_freshness_anchor():
+    from gatekeeper_tpu.audit import AuditManager
+    from gatekeeper_tpu.client.client import Client
+    from gatekeeper_tpu.kube.inmem import InMemoryKube
+
+    eng = obsslo.get_engine()
+    eng.clear()
+    try:
+        mgr = AuditManager(InMemoryKube(), Client(), from_cache=True)
+        before = eng.audit_age_s()
+        assert mgr.run_once_guarded()
+        assert eng.audit_age_s() <= before
+        assert eng.audit_age_s() < 1.0
+    finally:
+        eng.clear()

@@ -13,6 +13,16 @@ tests/test_observability_check.py; also runnable standalone):
    ``wall-clock: ok`` annotation (legitimate uses are epoch timestamps
    for export, never durations — wall time steps under NTP and would
    corrupt span/stage math).
+4. Exemplar well-formedness (ISSUE 5): a registry with trace-linked
+   distribution samples must render OpenMetrics that terminates with
+   ``# EOF``, attaches exemplars as ``# {trace_id="<32 hex>"} value ts``
+   on bucket lines, and keeps exemplars OUT of the classic text format.
+5. Label-cardinality lint (ISSUE 5): any catalog view carrying a
+   ``template``/``constraint`` tag key must be declared in
+   catalog.CAPPED_CARDINALITY_VIEWS (i.e. fed only by the top-K-capped
+   cost-ledger collector), and the collector must actually cap — an
+   uncapped per-template label explodes Prometheus cardinality on a
+   500-template cluster.
 
 Run: python tools/check_observability.py   (exit 0 clean, 1 with findings)
 """
@@ -30,6 +40,11 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 HOT_PATH_MODULES = (
     "gatekeeper_tpu/obs/trace.py",
     "gatekeeper_tpu/obs/__init__.py",
+    "gatekeeper_tpu/obs/costs.py",
+    "gatekeeper_tpu/obs/slo.py",
+    "gatekeeper_tpu/obs/debug.py",
+    "gatekeeper_tpu/metrics/views.py",
+    "gatekeeper_tpu/metrics/exporter.py",
     "gatekeeper_tpu/webhook/server.py",
     "gatekeeper_tpu/webhook/policy.py",
     "gatekeeper_tpu/ops/driver.py",
@@ -103,12 +118,107 @@ def check_monotonic_span_timing() -> list:
     return problems
 
 
+_EXEMPLAR_RE = re.compile(
+    r' # \{trace_id="[0-9a-f]{32}"\} [0-9.e+-]+ [0-9]+\.[0-9]+$'
+)
+
+
+def check_exemplar_wellformed() -> list:
+    """Render a synthetic registry through both exposition formats and
+    verify the exemplar contract."""
+    from gatekeeper_tpu.metrics.exporter import (
+        render_openmetrics,
+        render_prometheus,
+    )
+    from gatekeeper_tpu.metrics.views import (
+        AGG_DISTRIBUTION,
+        Measure,
+        Registry,
+        View,
+    )
+
+    problems = []
+    reg = Registry()
+    m = Measure("exemplar_check_seconds", "synthetic", "s")
+    reg.register(View("exemplar_check_seconds", m, AGG_DISTRIBUTION,
+                      buckets=(0.01, 0.1, 1.0)))
+    trace_id = "ab" * 16
+    reg.record(m, 0.05, exemplar_trace_id=trace_id)
+    reg.record(m, 5.0, exemplar_trace_id=trace_id)
+    om = render_openmetrics(reg)
+    if not om.endswith("# EOF\n"):
+        problems.append(
+            "OpenMetrics rendering does not terminate with '# EOF'"
+        )
+    ex_lines = [ln for ln in om.splitlines() if " # {" in ln]
+    if len(ex_lines) != 2:
+        problems.append(
+            f"expected 2 exemplar-carrying bucket lines, got {len(ex_lines)}"
+        )
+    for ln in ex_lines:
+        if "_bucket{" not in ln:
+            problems.append(f"exemplar on a non-bucket line: {ln!r}")
+        if not _EXEMPLAR_RE.search(ln):
+            problems.append(f"malformed exemplar: {ln!r}")
+    classic = render_prometheus(reg)
+    if " # {" in classic or "# EOF" in classic:
+        problems.append(
+            "classic text format must carry neither exemplars nor '# EOF'"
+        )
+    return problems
+
+
+_CARDINALITY_TAGS = {"template", "constraint"}
+
+
+def check_label_cardinality() -> list:
+    """Every view with a template/constraint label must be declared
+    top-K-capped, and the cost-ledger collector must actually cap."""
+    from gatekeeper_tpu.metrics import catalog
+    from gatekeeper_tpu.metrics.views import Registry
+    from gatekeeper_tpu.obs.costs import OTHER, CostLedger
+
+    problems = []
+    declared = set(getattr(catalog, "CAPPED_CARDINALITY_VIEWS", ()))
+    view_names = set()
+    for v in catalog.catalog_views():
+        view_names.add(v.name)
+        if set(v.tag_keys) & _CARDINALITY_TAGS and v.name not in declared:
+            problems.append(
+                f"view {v.name!r} carries a {sorted(_CARDINALITY_TAGS)} "
+                "label but is not declared in "
+                "catalog.CAPPED_CARDINALITY_VIEWS — per-template labels "
+                "must be top-K-capped"
+            )
+    for name in declared - view_names:
+        problems.append(
+            f"CAPPED_CARDINALITY_VIEWS names unknown view {name!r}"
+        )
+    # functional check: K+2 templates through a top-K=2 ledger must export
+    # at most K individual template labels plus the 'other' rollup
+    ledger = CostLedger(top_k=2)
+    for i in range(4):
+        ledger.record_dispatch({f"T{i}": 1}, 0.001, 10)
+    reg = Registry()
+    catalog.register_catalog(reg)
+    ledger.collect(reg)
+    labels = {k[0] for k in reg.view_rows("cost_device_ms")}
+    if len(labels - {OTHER}) > 2 or OTHER not in labels:
+        problems.append(
+            "cost-ledger collector exported uncapped template labels: "
+            f"{sorted(labels)}"
+        )
+    return problems
+
+
 def run_checks() -> list:
     sys.path.insert(0, REPO)
     return (
         check_measures_bound()
         + check_metrics_documented()
         + check_monotonic_span_timing()
+        + check_exemplar_wellformed()
+        + check_label_cardinality()
     )
 
 
