@@ -219,7 +219,7 @@ class AuditManager:
             "audit", audit_id=timestamp,
             mode="from-cache" if self.from_cache else "discovery",
         )
-        _span_ctx.__enter__()
+        _span = _span_ctx.__enter__()
         try:
             gklog.log_event(log, "auditing constraints and violations",
                             **{gklog.EVENT_TYPE: "audit_started",
@@ -256,6 +256,12 @@ class AuditManager:
                     results = responses.results()
                 else:
                     results = self.client.audit().results()
+                # the sweep owner surfaces the sharded-path shape: mesh
+                # width, per-shard row work and (steady state) the
+                # O(churn) delta row count ride the audit root span and
+                # the audit_finished event, so an operator can read the
+                # pipeline's behavior off one trace
+                self._annotate_sweep(_span)
                 self._add_results(
                     results, update_lists, totals_per_constraint,
                     totals_per_action, timestamp,
@@ -316,12 +322,36 @@ class AuditManager:
                 self.reporter.report_audit_duration(dur)
             gklog.log_event(log, "auditing is complete",
                             **{gklog.EVENT_TYPE: "audit_finished",
-                               gklog.AUDIT_ID: timestamp})
+                               gklog.AUDIT_ID: timestamp,
+                               **self._sweep_shape()})
             import sys as _sys
 
             _span_ctx.__exit__(*_sys.exc_info())
 
     # ---- helpers -----------------------------------------------------------
+
+    # last_sweep_stats keys the audit owner republishes (sharded-path
+    # shape: mesh width, per-shard work, steady-state churn row count)
+    _SWEEP_SHAPE_KEYS = (
+        "shards", "rows_per_shard", "rows", "delta_rows", "delta_shards",
+    )
+
+    def _sweep_shape(self) -> Dict[str, float]:
+        """The driver's last sweep shape, filtered to the sharded-path
+        keys; {} when the engine exposes no sweep stats (interp tier)."""
+        drv = getattr(self.client, "driver", None)
+        stats = getattr(drv, "last_sweep_stats", None)
+        if not isinstance(stats, dict):
+            return {}
+        return {k: stats[k] for k in self._SWEEP_SHAPE_KEYS if k in stats}
+
+    def _annotate_sweep(self, span):
+        try:
+            shape = self._sweep_shape()
+            if shape:
+                span.set_attrs(**shape)
+        except Exception:  # telemetry must never fail the sweep
+            log.exception("could not annotate the audit span")
 
     def _crd_exists(self) -> bool:
         try:

@@ -187,6 +187,27 @@ COST_MEMO_HIT_RATIO_M = Measure(
     "Review-memo hit ratio for a template's rendered cells over the "
     "cost-ledger window",
 )
+# ---- sharded mesh audit (ISSUE 6) -------------------------------------------
+# Per-shard stage telemetry for the double-buffered host-pack / device-
+# commit pipeline (parallel/mesh.py pipelined_shard_commit): one sample
+# per shard per full placement, labelled by path (review/audit).
+AUDIT_SHARD_ROWS_M = Measure(
+    "audit_shard_rows",
+    "Rows committed to one mesh shard's contiguous slab per full "
+    "placement (the per-device share of the sharded [C, R] sweep)",
+)
+AUDIT_SHARD_PACK_M = Measure(
+    "audit_shard_pack_seconds",
+    "Host-side slab slice/pad time per shard in the double-buffered "
+    "placement pipeline (overlaps the previous shard's transfer)",
+    unit="s",
+)
+AUDIT_SHARD_DISPATCH_M = Measure(
+    "audit_shard_dispatch_seconds",
+    "Per-shard device commit (async transfer issue) time in the "
+    "double-buffered placement pipeline",
+    unit="s",
+)
 SLO_BURN_M = Measure(
     "slo_burn_rate",
     "Error-budget burn rate per SLO objective and trailing window "
@@ -223,6 +244,11 @@ _STAGE_BUCKETS = (
     0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
 )
 _BATCH_SIZE_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+# rows per shard slab: admission batches (tens) to 1M-row clusters over
+# an 8-chip mesh (125k rows/shard)
+_SHARD_ROWS_BUCKETS = (
+    8, 64, 512, 2048, 8192, 32768, 131072, 524288,
+)
 # snapshot write/load span ~10ms (small corpora) to tens of seconds (100k
 # rows through json+npz on a loaded node)
 _SNAPSHOT_BUCKETS = (
@@ -301,6 +327,12 @@ def catalog_views():
              tag_keys=("template",)),
         View("cost_memo_hit_ratio", COST_MEMO_HIT_RATIO_M, AGG_LAST_VALUE,
              tag_keys=("template",)),
+        View("audit_shard_rows", AUDIT_SHARD_ROWS_M, AGG_DISTRIBUTION,
+             tag_keys=("path",), buckets=_SHARD_ROWS_BUCKETS),
+        View("audit_shard_pack_seconds", AUDIT_SHARD_PACK_M,
+             AGG_DISTRIBUTION, tag_keys=("path",), buckets=_STAGE_BUCKETS),
+        View("audit_shard_dispatch_seconds", AUDIT_SHARD_DISPATCH_M,
+             AGG_DISTRIBUTION, tag_keys=("path",), buckets=_STAGE_BUCKETS),
         View("slo_burn_rate", SLO_BURN_M, AGG_LAST_VALUE,
              tag_keys=("objective", "window")),
         View("slo_error_budget_remaining", SLO_BUDGET_M, AGG_LAST_VALUE,
@@ -540,6 +572,24 @@ def record_render_cells(counts: Dict[str, int]):
                 reg.record(
                     RENDER_CELLS_M, float(n), {"plan": tier}, count=n
                 )
+    except Exception:  # pragma: no cover - telemetry never blocks eval
+        pass
+
+
+def record_audit_shard(rows: int, pack_s: float, dispatch_s: float,
+                       path: str = "audit"):
+    """One shard's slice through the double-buffered placement pipeline
+    (parallel/mesh.py): its slab's row count, host pack time and device
+    commit time.  Guarded like record_stage."""
+    try:
+        reg = _global()
+        tags = {"path": path}
+        tid = _current_trace_id()
+        reg.record(AUDIT_SHARD_ROWS_M, float(rows), tags,
+                   exemplar_trace_id=tid)
+        reg.record(AUDIT_SHARD_PACK_M, pack_s, tags, exemplar_trace_id=tid)
+        reg.record(AUDIT_SHARD_DISPATCH_M, dispatch_s, tags,
+                   exemplar_trace_id=tid)
     except Exception:  # pragma: no cover - telemetry never blocks eval
         pass
 

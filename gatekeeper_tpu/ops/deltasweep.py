@@ -165,8 +165,12 @@ class DeltaState:
 
     def __init__(self, counts: np.ndarray, topk: np.ndarray, K: int,
                  mask_src: "MaskSource", cs_epoch: int, layout_gen: int,
-                 store_epoch: int, crow=None):
+                 store_epoch: int, crow=None, mesh_width: int = 1):
         self.K = K
+        # topology stamp: the basis's mask placement is only valid under
+        # the sweep sharding it was produced by (driver._try_delta refuses
+        # a drifted basis and rebases via a full sweep)
+        self.mesh_width = int(mesh_width)
         self.counts = counts.astype(np.int64).copy()
         self.cand: List[List[int]] = []
         self.horizon: List[Optional[int]] = []
@@ -203,7 +207,7 @@ class DeltaState:
     @classmethod
     def from_restore(cls, counts, cand, horizon, crow, K, mask_src,
                      row_cols, render_cache, cs_epoch, layout_gen,
-                     store_epoch):
+                     store_epoch, mesh_width: int = 1):
         """Rebuild a state persisted by the snapshot subsystem
         (gatekeeper_tpu/snapshot/): fields are installed verbatim rather
         than derived from a fresh device reduction, so a restarted
@@ -223,6 +227,7 @@ class DeltaState:
         st.cs_epoch = cs_epoch
         st.layout_gen = layout_gen
         st.store_epoch = store_epoch
+        st.mesh_width = int(mesh_width)
         return st
 
     # ---- incremental update ----------------------------------------------

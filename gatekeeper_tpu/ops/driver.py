@@ -148,13 +148,21 @@ def _merge_sharded_packed(packed_all: np.ndarray, K: int) -> np.ndarray:
     return np.concatenate([counts[:, None], merged], axis=1)
 
 
-@jax.jit
-def _scatter_rows(dev_tree, idx, rows_tree):
+def _scatter_rows_impl(dev_tree, idx, rows_tree):
     """Patch dirty rows into the device-resident audit input trees in ONE
     dispatch (one RTT behind a network relay, vs one per array leaf)."""
     return jax.tree_util.tree_map(
         lambda d, r: d.at[idx].set(r), dev_tree, rows_tree
     )
+
+
+_scatter_rows = jax.jit(_scatter_rows_impl)
+# Mesh twin: the pre-scatter placement is dead the moment the driver swaps
+# its cache entry, and (unlike the single-device path) no lazy MaskSource
+# dispatch ever re-reads it — the mesh sweep's mask is an eager co-output.
+# Donating lets XLA patch the owning shards' slabs in place instead of
+# copying every R-sized buffer per churn sweep.
+_scatter_rows_mesh = jax.jit(_scatter_rows_impl, donate_argnums=0)
 
 
 def _strip_request_meta(frozen_review):
@@ -197,9 +205,35 @@ class TpuDriver(InterpDriver):
         self._fused_packed = None
         self._fused_packed_src = None
         # multi-chip: data-parallel mesh over every visible device (None on
-        # single-chip).  GK_MESH=0 forces the single-device path; tests pin
-        # bit-parity between both settings.
-        self.mesh_enabled = os.environ.get("GK_MESH", "1") != "0"
+        # single-chip).  GK_MESH=0 forces the single-device path, GK_MESH=1
+        # (the default) meshes every visible device, GK_MESH=<n> for n >= 2
+        # pins the mesh to the first n devices (a pinned width of 1 is only
+        # reachable via set_mesh(True, width=1), which is the single-device
+        # path); tests pin bit-parity across settings.  Mutate via
+        # set_mesh(), which invalidates every cache keyed on the topology.
+        _mesh_env = os.environ.get("GK_MESH", "1")
+        self.mesh_enabled = _mesh_env != "0"
+        try:
+            _w = int(_mesh_env)
+        except ValueError:
+            _w = -1
+        if _w < 0:
+            # fail loudly at construction rather than silently meshing
+            # every visible device off a typo'd width
+            raise ValueError(
+                f"GK_MESH={_mesh_env!r} is not a non-negative integer"
+            )
+        if _w > 1 and _w > len(jax.devices()):
+            # same contract as set_mesh: a width the host cannot satisfy
+            # would otherwise error on every sweep and silently degrade
+            # the whole mesh family to the interpreter tier via the
+            # circuit breaker.  (_w <= 1 skips the check so construction
+            # does not force JAX backend initialization.)
+            raise ValueError(
+                f"GK_MESH={_mesh_env} exceeds visible devices "
+                f"({len(jax.devices())})"
+            )
+        self.mesh_width: Optional[int] = _w if _w > 1 else None
         self._mesh_cache: Optional[tuple] = None
         # device placement of the replicated constraint side (mesh path):
         # re-uploading vocab-sized tables to N chips every call would cost
@@ -753,16 +787,63 @@ class TpuDriver(InterpDriver):
         return fn, ordered, rp, cp, cols, group_params, crow
 
     def _mesh(self):
-        """The production device mesh: all visible devices, data-parallel on
-        the resource axis (parallel/mesh.py).  None on single-chip or when
-        mesh_enabled is off."""
+        """The production device mesh: all visible devices (or the pinned
+        mesh_width), data-parallel on the resource axis (parallel/mesh.py).
+        None on single-chip, width 1, or when mesh_enabled is off."""
         if not self.mesh_enabled:
             return None
         if self._mesh_cache is None:
-            from ..parallel.mesh import maybe_audit_mesh
+            from ..parallel.mesh import audit_mesh, maybe_audit_mesh
 
-            self._mesh_cache = (maybe_audit_mesh(),)
+            if self.mesh_width is not None:
+                mesh = (
+                    audit_mesh(self.mesh_width) if self.mesh_width > 1
+                    else None
+                )
+            else:
+                mesh = maybe_audit_mesh()
+            self._mesh_cache = (mesh,)
         return self._mesh_cache[0]
+
+    def set_mesh(self, enabled: bool, width: Optional[int] = None):
+        """Switch the mesh topology (on/off, or a pinned device count) and
+        invalidate EVERY cache keyed on it: the mesh object itself, the
+        device-resident constraint side and sharded audit inputs, the
+        compiled mesh audit executable, the delta-sweep basis (its resident
+        base mask carries the old topology's layout), the sweep cache and
+        the delta executable (its compiled entries pin the old mask
+        sharding).  This replaces the ad-hoc `_mesh_cache = None` /
+        `mesh_enabled = False` pokes — partial pokes left stale
+        device placements serving the new topology.
+
+        width=None uses every visible device; width=1 forces the
+        single-device path even when enabled."""
+        if enabled and width is not None and width > len(jax.devices()):
+            raise ValueError(
+                f"mesh width {width} exceeds visible devices "
+                f"({len(jax.devices())})"
+            )
+        with self._lock:
+            self.mesh_enabled = bool(enabled)
+            self.mesh_width = width
+            self._mesh_cache = None
+            self._cs_device_cache = None
+            self._audit_dev = None
+            self._audit_dev_mesh = None
+            self._audit_cache = None
+            self._delta_state = None
+            self._delta_jit = None
+            self._delta_jit_key = None
+            self._fused_audit_mesh = None
+            self._fused_audit_mesh_key = None
+
+    def mesh_layout(self) -> int:
+        """The row-sharding width serving production sweeps: device count
+        of the active mesh, 1 on the single-device path.  Persisted in the
+        snapshot sweep basis; a restore whose live layout differs drops
+        the basis (width drift invalidation, gatekeeper_tpu/snapshot/)."""
+        mesh = self._mesh()
+        return 1 if mesh is None else int(mesh.devices.size)
 
     def _dispatch(self, fn, rv_arrays, cp_arrays, cols, group_params, rows,
                   cs_key=None):
@@ -792,8 +873,13 @@ class TpuDriver(InterpDriver):
             fn = fn._jitted
         from ..parallel.mesh import shard_review_side
 
-        rv_p, cols_p, _target = shard_review_side(mesh, rows, rv_arrays, cols)
-        with mesh:
+        from ..parallel.mesh import DISPATCH_LOCK
+
+        rv_p, cols_p, _target = shard_review_side(
+            mesh, rows, rv_arrays, cols,
+            record_shard=self._record_shard("review"),
+        )
+        with DISPATCH_LOCK, mesh:
             return fn(rv_p, cs_p, cols_p, gp_p)
 
     def _constraint_device_side(self, cp_arrays, group_params, cs_key, mesh):
@@ -2163,7 +2249,9 @@ class TpuDriver(InterpDriver):
                     jax.tree_util.tree_map(lambda a: repl, gp),
                 )
                 out_specs = (_P(None, "data"), _P("data", None, None))
-                sharded[0] = jax.jit(jax.shard_map(
+                from ..util.jaxcompat import shard_map as _shard_map
+
+                sharded[0] = jax.jit(_shard_map(
                     body, mesh=mesh, in_specs=in_specs,
                     out_specs=out_specs, check_vma=False,
                 ))
@@ -2265,11 +2353,38 @@ class TpuDriver(InterpDriver):
             self._audit_dev = [ap.layout_gen, placed]
         return self._audit_dev[1]
 
+    def _record_shard(self, path: str):
+        """Per-shard pipeline telemetry hook for pipelined_shard_commit:
+        a pack + dispatch span per shard (they overlap by design — the
+        packer thread works one slab ahead of the transfers) and the
+        audit_shard_* stage histograms."""
+        from ..metrics.catalog import record_audit_shard
+
+        def record(shard, rows, pt0, pt1, ct0, ct1):
+            # NOT stage-tagged: stage_breakdown's contract is disjoint
+            # stage spans summing toward the root duration, and these are
+            # sub-intervals of the enclosing pack/dispatch stages (they
+            # also overlap each other by design — the pipeline packs
+            # shard i+1 while shard i's transfer is in flight)
+            obstrace.record_span(
+                "audit.shard_pack", pt0, pt1,
+                shard=int(shard), rows=int(rows), path=path,
+            )
+            obstrace.record_span(
+                "audit.shard_dispatch", ct0, ct1,
+                shard=int(shard), rows=int(rows), path=path,
+            )
+            record_audit_shard(int(rows), pt1 - pt0, ct1 - ct0, path=path)
+
+        return record
+
     def _audit_device_inputs_mesh(self, mesh):
         """Shard-resident review-side audit arrays (mesh path): the
-        padded, row-sharded placement is committed once per pack layout;
-        steady-state sweeps patch just the dirty rows with the same
-        jitted scatter the single-device path uses, so host->device
+        padded, row-sharded placement is committed once per pack layout —
+        slab by slab through the double-buffered pipeline (packing shard
+        i+1 overlaps the transfer of shard i, parallel/mesh.py) — and
+        steady-state sweeps patch just the dirty rows with one jitted
+        scatter (donating the dead pre-scatter placement), so host->device
         traffic is proportional to churn on every topology."""
         from ..parallel.mesh import shard_review_side
 
@@ -2287,7 +2402,8 @@ class TpuDriver(InterpDriver):
                 # the committed base state
                 tree = jax.tree_util.tree_map(np.array, tree)
             rv_p, cols_p, _target = shard_review_side(
-                mesh, ap.capacity, tree[0], tree[1]
+                mesh, ap.capacity, tree[0], tree[1],
+                record_shard=self._record_shard("audit"),
             )
             # the mesh OBJECT rides in the cache: identity-is-liveness (a
             # recycled id() could alias a dead mesh, advisor r5)
@@ -2300,8 +2416,14 @@ class TpuDriver(InterpDriver):
             host_rows = jax.tree_util.tree_map(
                 lambda a: a[rows], (ap.rp, ap.cols)
             )
-            with mesh:
-                placed = _scatter_rows(cache[2], rows, host_rows)
+            from ..parallel.mesh import DISPATCH_LOCK
+
+            # the pre-scatter placement is donated (dead after the swap);
+            # drop the cache first so a failed dispatch cannot leave a
+            # consumed tree serving the next sweep
+            self._audit_dev_mesh = None
+            with DISPATCH_LOCK, mesh:
+                placed = _scatter_rows_mesh(cache[2], rows, host_rows)
             self._audit_dev_mesh = [ap.layout_gen, mesh, placed]
         return self._audit_dev_mesh[2]
 
@@ -2365,15 +2487,22 @@ class TpuDriver(InterpDriver):
             # by a jitted scatter of just the dirty rows — re-placing the
             # full row pack across N shards every sweep was the measured
             # ~4x sharded-path overhead (r4 verdict weak #5)
+            from ..parallel.mesh import DISPATCH_LOCK
+
             rv_p, cols_p = self._audit_device_inputs_mesh(mesh)
             cs_p, gp_p = self._constraint_device_side(
                 cp.arrays, group_params, None, mesh
             )
-            with mesh:
+            with DISPATCH_LOCK, mesh:
                 mask_dev, packed_dev = self._fused_audit_mesh_fn(K, mesh)(
                     rv_p, cs_p, cols_p, gp_p
                 )
             mask_src = MaskSource.resolved(mask_dev)
+            # warm the mesh-specialized delta executable off the sweep
+            # path (the mask is already resolved; only the trace/compile
+            # rides the background thread) so the first O(churn) delta
+            # sweep under the mesh pays a dispatch, not an SPMD compile
+            self._warm_delta_async(mask_src, cs_p, gp_p, mesh)
         packed_dev.block_until_ready()
         t2 = _time.perf_counter()
         # the ONE small fetch per sweep; crow folds the group-major pad
@@ -2393,10 +2522,16 @@ class TpuDriver(InterpDriver):
         self._audit_cache = (key, sweep, None)
         # a full sweep (re)bases the incremental state: its inputs include
         # every dirty row the scatter just applied
+        # the mesh_width stamp pins the basis to the sweep sharding that
+        # produced it: _try_delta refuses a drifted basis, so even code
+        # that pokes mesh_enabled directly (instead of set_mesh, which
+        # clears the state) rebases via a full sweep rather than
+        # dispatching across topologies.
         self._delta_state = DeltaState(
             counts, packed[:, 1:], K, mask_src,
             cs_epoch=self._cs_epoch, layout_gen=ap.layout_gen,
             store_epoch=self.store.epoch, crow=crow,
+            mesh_width=1 if mesh is None else int(mesh.devices.size),
         )
         # the full sweep's inputs already reflect every pending change;
         # drop the delta channel so those rows aren't re-applied
@@ -2408,7 +2543,17 @@ class TpuDriver(InterpDriver):
             "fetch_bytes": float(packed.nbytes),
             "rows": float(ap.n_rows),
             "cells": float(len(ordered) * ap.n_rows),
+            "shards": 1.0 if mesh is None else float(mesh.devices.size),
         }
+        from ..parallel.mesh import slab_rows
+
+        # capacity-slab based at EVERY width (width 1 included) so the
+        # bench scaling curve compares like with like across widths
+        self.last_sweep_stats["rows_per_shard"] = float(
+            slab_rows(
+                ap.capacity, 1 if mesh is None else int(mesh.devices.size)
+            )[1]
+        )
         obstrace.record_span("audit.pack", t0, t1, stage=obstrace.PACK,
                              rows=ap.n_rows)
         obstrace.record_span(
@@ -2608,29 +2753,60 @@ class TpuDriver(InterpDriver):
     # conftest raises it for CPU-backend determinism.
     DELTA_MASK_WAIT_S = 0.05
 
-    def _warm_delta_async(self, mask_src, cs_d, gp_d):
+    def _delta_dispatch_fn(self, mesh):
+        """The delta executable for this topology: the AOT wrapper on a
+        single device; its plain jit twin under a mesh (serialized
+        executables pin a single-device layout — the sharded base mask
+        must go through the jit machinery's SPMD compile)."""
+        from .aotcache import aot_jit
+
+        dfn = self._delta_fn()
+        if mesh is not None and isinstance(dfn, aot_jit):
+            return dfn._jitted
+        return dfn
+
+    def _warm_delta_async(self, mask_src, cs_d, gp_d, mesh=None):
         """Resolve the base mask, then compile+dispatch the width-8 delta
         executable against it, on the MaskSource's resolver thread.  All
         state it needs is captured here under the driver lock; the thread
-        itself only calls thread-safe jax entry points."""
+        itself only calls thread-safe jax entry points.  On the mesh path
+        the mask is already resolved — the prefetch then only warms the
+        mesh-specialized delta executable off the sweep path."""
         ap = self._audit_pack
         if not self.delta_enabled or ap.n_rows == 0:
             # no delta path will consume the mask: leave it lazy (the
             # uncapped audit resolves it on demand) instead of paying a
             # background full evaluation nobody may read
             return
-        delta_jit = self._delta_fn()  # cheap wrapper; cached per epoch
+        delta_jit = self._delta_dispatch_fn(mesh)  # cached per epoch
         rows_pad = np.zeros(8, np.int32)
         rv_slice = {k: a[rows_pad] for k, a in ap.rp.items()}
         cols_slice = {
             ck: {leaf: a[rows_pad] for leaf, a in leaves.items()}
             for ck, leaves in ap.cols.items()
         }
-        mask_src.prefetch(
-            after=lambda m: delta_jit(
-                m, rows_pad, rv_slice, cs_d, cols_slice, gp_d
-            )
-        )
+        if mesh is not None:
+            from ..parallel.mesh import DISPATCH_LOCK
+
+            def _warm(m):
+                # collective-bearing executable dispatched off-thread:
+                # take the mesh dispatch lock AND drain the result before
+                # releasing it, so the warm's psums can never interleave
+                # with a foreground sweep's on any device.  The first warm
+                # per (epoch, topology) holds the lock across the SPMD
+                # trace+compile too — jit's call cache cannot be populated
+                # from a lock-free lower().compile() (measured: the next
+                # call still recompiles) — a bounded one-time stall the
+                # foreground delta sweep would otherwise pay itself.
+                with DISPATCH_LOCK:
+                    delta_jit(
+                        m, rows_pad, rv_slice, cs_d, cols_slice, gp_d
+                    ).block_until_ready()
+        else:
+            def _warm(m):
+                delta_jit(m, rows_pad, rv_slice, cs_d, cols_slice, gp_d)
+
+        mask_src.prefetch(after=_warm)
 
     def _delta_fn(self):
         """Jitted fused evaluation restricted to a [d]-row slice of the
@@ -2660,12 +2836,25 @@ class TpuDriver(InterpDriver):
         """Bring the incremental sweep state current with an O(dirty-rows)
         device evaluation (ops/deltasweep.py).  Returns
         (reviews, ordered, state) or None when the delta path is
-        ineligible (disabled, mesh active, no base state, layout changed,
-        or too many dirty rows — then the caller runs a full sweep)."""
-        if not self.delta_enabled or self._mesh() is not None:
+        ineligible (disabled, no base state, layout changed, or too many
+        dirty rows — then the caller runs a full sweep).  Runs under the
+        mesh too: the [C, d] dirty-row evaluation is dispatched against
+        the shard-resident base mask, so steady-state cost stays O(churn)
+        on every topology and only the owning shards' slabs see traffic."""
+        if not self.delta_enabled:
             return None
         st = self._delta_state
         if st is None or st.cs_epoch != self._cs_epoch:
+            return None
+        if st.mesh_width != self.mesh_layout():
+            # the basis was produced under a different sweep sharding
+            # (someone poked mesh_enabled/_mesh_cache directly instead of
+            # set_mesh): its mask placement belongs to the old topology —
+            # dispatching against it raises, so rebase via a full sweep.
+            # The sweep cache rides the same topology and must go too, or
+            # _audit_sweep would serve it without recreating the state.
+            self._delta_state = None
+            self._audit_cache = None
             return None
         import time as _time
 
@@ -2747,17 +2936,26 @@ class TpuDriver(InterpDriver):
             for ck, leaves in ap.cols.items()
         }
         group_params = [p for *_s, p in groups]
+        mesh = self._mesh()
         cs_d, gp_d = self._constraint_device_side(
-            cp.arrays, group_params, None, None
+            cp.arrays, group_params, None, mesh
         )
         # [C_total, 2d] from the device; crow folds pad rows out so the
         # incremental state stays per ordered constraint
-        both = np.asarray(
-            self._delta_fn()(
+        if mesh is not None:
+            from ..parallel.mesh import DISPATCH_LOCK
+
+            with DISPATCH_LOCK:
+                both_dev = self._delta_dispatch_fn(mesh)(
+                    st.mask_src.get(), rows_pad, rv_slice, cs_d,
+                    cols_slice, gp_d
+                )
+        else:
+            both_dev = self._delta_dispatch_fn(mesh)(
                 st.mask_src.get(), rows_pad, rv_slice, cs_d, cols_slice,
                 gp_d
             )
-        ).astype(bool)[st.crow]
+        both = np.asarray(both_dev).astype(bool)[st.crow]
         fetch_bytes = both.nbytes
         base_old, dmask = both[:, :width], both[:, width:]
         t2 = _time.perf_counter()
@@ -2777,7 +2975,16 @@ class TpuDriver(InterpDriver):
             "delta_rows": float(len(rows)),
             "rows": float(ap.n_rows),
             "cells": float(len(ordered) * len(rows)),
+            "shards": 1.0 if mesh is None else float(mesh.devices.size),
         }
+        if mesh is not None:
+            # churn locality: the dirty rows' slabs are the only shards
+            # whose resident state the next full placement must touch
+            from ..parallel.mesh import owning_shards
+
+            self.last_sweep_stats["delta_shards"] = float(
+                len(owning_shards(rows, ap.capacity, mesh.devices.size))
+            )
         return ap.reviews, ordered, st
 
     def audit_capped(self, cap: int, tracing: bool = False):
@@ -2789,8 +2996,10 @@ class TpuDriver(InterpDriver):
         since the last sweep are re-evaluated on device ([C, d] delta), and
         the per-constraint counts + first-K candidate lists are maintained
         host-side (ops/deltasweep.py) — per-sweep cost is O(churn), not
-        O(cluster).  The first sweep (and any sweep after a template or
-        layout change, under a mesh, or with too much churn) is a FULL
+        O(cluster), on the single-device path AND under the mesh (the
+        delta dispatch runs against the shard-resident base mask).  The
+        first sweep (and any sweep after a template or
+        layout change, or with too much churn) is a FULL
         device sweep whose on-device reduction ships only [C] counts +
         [C, K] candidate indices to the host (never the [C, R] mask).
         When capped rendering needs candidates beyond the known horizon it

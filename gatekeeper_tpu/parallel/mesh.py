@@ -19,11 +19,29 @@ independent re-evaluation, pkg/controller/constraintstatus).
 
 from __future__ import annotations
 
-from typing import Optional
+import os as _os
+import queue as _queue
+import threading as _threading
+import time as _time
+from typing import Callable, Optional
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+# Process-wide mesh-collective dispatch lock.  Two collective-bearing
+# SPMD executables enqueued concurrently from different threads can
+# interleave their per-device launch order (A before B on one device,
+# B before A on another) and deadlock the cross-device rendezvous —
+# observed as a hung AllReduce between the background delta-executable
+# warm and a foreground sweep on the virtual CPU mesh, and the same
+# hazard exists on any single-process multi-device topology (webhook
+# request threads dispatch reviews while the audit thread sweeps).
+# Hold it across the enqueue (the jitted call), not the result fetch:
+# per-device execution is in-order, so a globally consistent enqueue
+# order suffices, and device work still overlaps the host.
+DISPATCH_LOCK = _threading.Lock()
 
 
 def audit_mesh(n_devices: Optional[int] = None) -> Mesh:
@@ -45,21 +63,21 @@ def pad_rows(rows: int, multiple: int) -> int:
     return ((rows + multiple - 1) // multiple) * multiple
 
 
-def _pad_rows_tree(tree, rows: int, target: int):
-    """Zero-pad every row-major array (leading dim == rows) to target rows.
-    Zero padding is semantically inert: the match kernel ANDs every cell
-    with the review-side `valid` flag (ops/matchkernel.py:173-175), which
-    pads to False, so padded rows can never produce a positive cell."""
-    if target == rows:
-        return tree
-
-    def pad(x):
-        if hasattr(x, "shape") and x.ndim >= 1 and x.shape[0] == rows:
-            widths = [(0, target - rows)] + [(0, 0)] * (x.ndim - 1)
-            return np.pad(np.asarray(x), widths)
-        return x
-
-    return jax.tree_util.tree_map(pad, tree)
+def virtual_mesh_env(n_devices: int, base: Optional[dict] = None) -> dict:
+    """Subprocess environment for an ``n_devices`` virtual CPU mesh — the
+    one recipe every bench/tool mesh lane uses: force the CPU platform,
+    disable axon pool discovery, and replace any existing
+    ``xla_force_host_platform_device_count`` XLA flag with ours.  Built
+    over ``base`` (default: ``os.environ``); the caller's own process is
+    never touched — pass the result to ``subprocess``."""
+    env = dict(_os.environ if base is None else base)
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    kept = [f for f in env.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f]
+    kept.append(f"--xla_force_host_platform_device_count={n_devices}")
+    env["XLA_FLAGS"] = " ".join(kept)
+    return env
 
 
 def shardings_for(mesh: Mesh, rows: int, args):
@@ -95,27 +113,181 @@ def replicate_tree(mesh: Mesh, tree):
     return jax.tree_util.tree_map(lambda x: jax.device_put(x, repl), tree)
 
 
-def shard_review_side(mesh: Mesh, rows: int, rv, cols):
-    """Pad the row axis to a mesh multiple and commit the review-side trees
-    with row-major arrays partitioned on "data" (everything else, e.g.
-    vocab-sized tables, replicated).  Returns (rv, cols, padded_rows)."""
-    target = pad_rows(rows, mesh.devices.size)
-    rv = _pad_rows_tree(rv, rows, target)
-    cols = _pad_rows_tree(cols, rows, target)
+# Slab size below which pipelined_shard_commit skips the packer thread:
+# slicing a few thousand rows costs microseconds, so the 2-deep pipeline
+# would only add thread-spawn + queue overhead (admission batches routed
+# to the device path land here; the audit's 100k-row placements don't).
+PIPELINE_MIN_SLAB_ROWS = 2048
+
+
+def slab_rows(rows: int, mesh_size: int) -> tuple:
+    """(padded row count, rows per shard) for a row axis laid over the
+    mesh in contiguous slabs."""
+    target = pad_rows(rows, mesh_size)
+    return target, target // mesh_size
+
+
+def owning_shards(rows, capacity: int, mesh_size: int) -> set:
+    """The set of shard indices whose contiguous row slab holds any of
+    `rows` — the shards a churn batch actually touches (everything else
+    keeps its resident slab untouched)."""
+    _target, slab = slab_rows(capacity, mesh_size)
+    return {int(r) // slab for r in rows}
+
+
+def _row_blocks(mesh: Mesh, target: int):
+    """Authoritative (device, lo, hi) row-slab assignment for P("data")
+    over a [target, ...] array, in ascending-row order — derived from the
+    sharding's own index map, never assumed from device iteration order."""
+    sh = NamedSharding(mesh, P("data"))
+    blocks = []
+    for dev, idx in sh.addressable_devices_indices_map((target,)).items():
+        s = idx[0]
+        lo = s.start or 0
+        hi = s.stop if s.stop is not None else target
+        blocks.append((dev, lo, hi))
+    blocks.sort(key=lambda b: b[1])
+    return blocks
+
+
+def _slab_of(x: np.ndarray, lo: int, hi: int) -> np.ndarray:
+    """Rows [lo, hi) of a (possibly shorter) row-major host array, zero-
+    padded past its end.  The in-range case is a VIEW — the pipeline's
+    host cost per slab is the device_put copy, nothing extra.  Zero
+    padding is semantically inert: the match kernel ANDs every cell with
+    the review-side `valid` flag (ops/matchkernel.py), which pads to
+    False, so a padded row can never produce a positive cell."""
+    if hi <= x.shape[0]:
+        return x[lo:hi]
+    live = x[lo: min(hi, x.shape[0])]
+    widths = [(0, (hi - lo) - live.shape[0])] + [(0, 0)] * (x.ndim - 1)
+    return np.pad(live, widths)
+
+
+def pipelined_shard_commit(
+    mesh: Mesh, rows: int, trees,
+    record_shard: Optional[Callable] = None,
+):
+    """Commit row-major trees to the mesh slab-by-slab with a two-deep
+    host-pack / device-commit pipeline: a packer thread slices+pads shard
+    i+1's row slab while the main thread's `jax.device_put` of shard i is
+    in flight (transfers are asynchronous, so the device DMA of slab i
+    also overlaps the packing of i+1).  This replaces the serial
+    pad-everything-then-put placement whose Python packing cost sat ahead
+    of every dispatch.  Placements whose slabs are at most
+    PIPELINE_MIN_SLAB_ROWS rows commit serially (same slabs, same
+    telemetry): there the packing cost the pipeline would hide is smaller
+    than the thread+queue overhead.
+
+    trees: tuple of pytrees; leaves with leading dim == rows shard on
+    "data" in contiguous slabs, everything else (vocab-sized tables)
+    replicates.  record_shard(shard, n_rows, pack_t0, pack_t1, commit_t0,
+    commit_t1) is invoked on the calling thread per committed shard.
+    Returns (placed_trees, padded_rows)."""
+    n = mesh.devices.size
+    target, _slab = slab_rows(rows, n)
     repl = NamedSharding(mesh, P())
-
-    def place(x):
-        if hasattr(x, "shape") and x.ndim >= 1 and x.shape[0] == target:
-            sh = NamedSharding(mesh, P("data", *([None] * (x.ndim - 1))))
+    leaves, treedef = jax.tree_util.tree_flatten(trees)
+    row_idx = [
+        i for i, x in enumerate(leaves)
+        if hasattr(x, "shape") and getattr(x, "ndim", 0) >= 1
+        and x.shape[0] == rows
+    ]
+    row_set = set(row_idx)
+    placed = [
+        x if i in row_set else jax.device_put(x, repl)
+        for i, x in enumerate(leaves)
+    ]
+    if row_idx:
+        row_leaves = [np.asarray(leaves[i]) for i in row_idx]
+        blocks = _row_blocks(mesh, target)
+        per_shard = [[] for _ in row_leaves]
+        _slab_n = target // n
+        if _slab_n <= PIPELINE_MIN_SLAB_ROWS:
+            # small placement (e.g. an admission batch routed to the
+            # device path): the packing cost the pipeline hides is
+            # microseconds here, so the thread+queue machinery would be
+            # pure overhead — commit serially, same telemetry
+            for shard, (dev, lo, hi) in enumerate(blocks):
+                pt0 = _time.perf_counter()
+                slabs = [_slab_of(x, lo, hi) for x in row_leaves]
+                pt1 = ct0 = _time.perf_counter()
+                puts = jax.device_put(slabs, dev)  # async transfer
+                for li, arr in enumerate(puts):
+                    per_shard[li].append(arr)
+                ct1 = _time.perf_counter()
+                if record_shard is not None:
+                    record_shard(shard, hi - lo, pt0, pt1, ct0, ct1)
         else:
-            sh = repl
-        return jax.device_put(x, sh)
+            q: _queue.Queue = _queue.Queue(maxsize=1)  # pack i+1 / commit i
+            stop = _threading.Event()
 
-    return (
-        jax.tree_util.tree_map(place, rv),
-        jax.tree_util.tree_map(place, cols),
-        target,
+            def _put(item) -> bool:
+                # bounded put: if the consumer died, its finally sets
+                # `stop` and we bail instead of blocking forever on the
+                # full queue (which would also stall the consumer's join)
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.05)
+                        return True
+                    except _queue.Full:
+                        continue
+                return False
+
+            def packer():
+                try:
+                    for shard, (dev, lo, hi) in enumerate(blocks):
+                        t0 = _time.perf_counter()
+                        slabs = [_slab_of(x, lo, hi) for x in row_leaves]
+                        if not _put((shard, dev, lo, hi, slabs,
+                                     t0, _time.perf_counter())):
+                            return
+                    _put(None)
+                except BaseException as e:  # surfaced on the consumer side
+                    _put(e)
+
+            t = _threading.Thread(target=packer, daemon=True,
+                                  name="gk-shard-pack")
+            t.start()
+            try:
+                while True:
+                    item = q.get()
+                    if item is None:
+                        break
+                    if isinstance(item, BaseException):
+                        raise item
+                    shard, dev, lo, hi, slabs, pt0, pt1 = item
+                    ct0 = _time.perf_counter()
+                    puts = jax.device_put(slabs, dev)  # async transfer
+                    for li, arr in enumerate(puts):
+                        per_shard[li].append(arr)
+                    ct1 = _time.perf_counter()
+                    if record_shard is not None:
+                        record_shard(shard, hi - lo, pt0, pt1, ct0, ct1)
+            finally:
+                stop.set()
+                t.join(timeout=5.0)
+        for li, i in enumerate(row_idx):
+            x = row_leaves[li]
+            shape = (target,) + x.shape[1:]
+            sh = NamedSharding(mesh, P("data", *([None] * (x.ndim - 1))))
+            placed[i] = jax.make_array_from_single_device_arrays(
+                shape, sh, per_shard[li]
+            )
+    out = jax.tree_util.tree_unflatten(treedef, placed)
+    return out, target
+
+
+def shard_review_side(mesh: Mesh, rows: int, rv, cols, record_shard=None):
+    """Pad the row axis to a mesh multiple and commit the review-side trees
+    with row-major arrays partitioned on "data" in contiguous slabs
+    (everything else, e.g. vocab-sized tables, replicated) — slab by slab
+    through the double-buffered pipeline (pipelined_shard_commit).
+    Returns (rv, cols, padded_rows)."""
+    (rv_p, cols_p), target = pipelined_shard_commit(
+        mesh, rows, (rv, cols), record_shard=record_shard
     )
+    return rv_p, cols_p, target
 
 
 def shard_args(mesh: Mesh, rows: int, args):

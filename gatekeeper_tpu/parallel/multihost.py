@@ -147,7 +147,9 @@ def multihost_capped_sweep(driver, K: int):
             jax.tree_util.tree_map(lambda a: row_spec(a), cols_g),
             jax.tree_util.tree_map(lambda a: repl, gp_g),
         )
-        sharded = jax.jit(jax.shard_map(
+        from ..util.jaxcompat import shard_map
+
+        sharded = jax.jit(shard_map(
             body, mesh=mesh, in_specs=in_specs, out_specs=repl,
             check_vma=False,
         ))

@@ -367,6 +367,21 @@ class SnapshotLoader:
                     "diverged (first sweep will be a full dispatch)"
                 )
                 return False
+            # width-drift invalidation: a basis produced under a different
+            # sweep sharding layout (mesh width) carries that layout's row
+            # padding in its base mask — rebase via one full sweep instead
+            # of serving candidates across a drifted slab geometry.  A
+            # basis missing the field predates the stamp; those were all
+            # produced by the single-device sweep, so treat as width 1.
+            snap_width = int(delta.get("mesh_width") or 1)
+            live_width = driver.mesh_layout()
+            if snap_width != live_width:
+                log.warning(
+                    "snapshot delta basis dropped: sweep sharding width "
+                    "drifted (snapshot %d, live %d); first sweep will be "
+                    "a full dispatch", snap_width, live_width,
+                )
+                return False
             shape = tuple(delta["mask_shape"])
             mask = np.unpackbits(
                 np.asarray(delta["mask_packed"]), axis=1, count=shape[1]
@@ -393,8 +408,21 @@ class SnapshotLoader:
                     )
                     render_cache = {}
             # device upload stays lazy: the first sweep with zero churn
-            # never needs the mask at all
-            mask_src = MaskSource(lambda: jax.device_put(mask))
+            # never needs the mask at all.  Under a mesh the mask commits
+            # row-sharded on "data" (the same-width check above guarantees
+            # the slab geometry matches) — a single-device commit would
+            # collide with the mesh-replicated constraint side inside the
+            # first delta dispatch
+            mesh = driver._mesh()
+            if mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                sh = NamedSharding(mesh, P(None, "data"))
+                mask_src = MaskSource(
+                    lambda: jax.device_put(mask, sh)
+                )
+            else:
+                mask_src = MaskSource(lambda: jax.device_put(mask))
             driver._delta_state = DeltaState.from_restore(
                 counts=delta["counts"],
                 cand=delta["cand"],
@@ -407,6 +435,9 @@ class SnapshotLoader:
                 cs_epoch=driver._cs_epoch,
                 layout_gen=ap.layout_gen,
                 store_epoch=driver.store.epoch,
+                # the same-width check above ran against the live layout,
+                # so the restored basis carries exactly that topology
+                mesh_width=live_width,
             )
         return True
 

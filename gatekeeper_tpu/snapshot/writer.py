@@ -86,6 +86,16 @@ class SnapshotWriter:
         # instead of silently reusing results a different tier produced
         return {
             "render_plans": driver._render_plan_tiers(),
+            # sweep sharding layout the basis was produced under (mesh
+            # device count, 1 = single-device) — the basis's OWN stamp,
+            # not the live driver layout: a topology poke between the
+            # basis's full sweep and the snapshot tick must not mis-label
+            # a mask whose row padding belongs to the old geometry.  The
+            # loader refuses the basis when the restoring process's
+            # layout differs and rebases via one full sweep rather than
+            # serve candidates off a mask whose padded tail no longer
+            # matches the live slab geometry.
+            "mesh_width": int(st.mesh_width),
             "counts": st.counts.copy(),
             "cand": [list(c) for c in st.cand],
             "horizon": list(st.horizon),

@@ -1,0 +1,49 @@
+"""JAX API compatibility shims.
+
+The mesh audit path is written against the modern spelling
+``jax.shard_map(f, mesh=..., in_specs=..., out_specs=..., check_vma=...)``.
+On jax 0.4.x that symbol lives at ``jax.experimental.shard_map.shard_map``
+and the replication-check kwarg is named ``check_rep`` — without this shim
+every sharded sweep raises ``AttributeError`` at trace time and the circuit
+breaker silently degrades the whole mesh family to the interpreter tier
+(the seed-state failure mode of test_mesh / test_race_determinism /
+test_audit_topk mesh variants).
+
+One resolver, used by BOTH shard_map call sites (ops/driver.py
+_fused_audit_mesh_fn and parallel/multihost.py multihost_capped_sweep),
+so the two paths can never drift onto different underlying APIs.
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["shard_map"]
+
+
+def _resolve():
+    fn = getattr(jax, "shard_map", None)
+    if fn is not None:
+        return fn, "check_vma"
+    from jax.experimental.shard_map import shard_map as legacy
+
+    return legacy, "check_rep"
+
+
+_SHARD_MAP, _CHECK_KW = _resolve()
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None):
+    """``jax.shard_map`` with the modern signature on every supported jax.
+
+    ``check_vma`` maps onto 0.4.x's ``check_rep`` (same meaning: verify
+    per-output replication annotations; both paths here disable it — the
+    fused audit body mixes replicated and row-sharded outputs the checker
+    cannot type).  ``None`` keeps the backend default.
+    """
+    kwargs = {}
+    if check_vma is not None:
+        kwargs[_CHECK_KW] = check_vma
+    return _SHARD_MAP(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+    )

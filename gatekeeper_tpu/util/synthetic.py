@@ -255,11 +255,27 @@ def make_pods(n: int, seed: int = 1, violation_rate: float = 0.05) -> List[dict]
 def build_driver(n_templates: int, n_resources: int, seed: int = 0):
     """A TpuDriver loaded with the synthetic workload (via the Client so all
     validation paths run)."""
-    from ..client.client import Client
     from ..ops.driver import TpuDriver
 
+    return _load_client(TpuDriver(), n_templates, n_resources, seed)
+
+
+def build_oracle(n_templates: int, n_resources: int, seed: int = 0):
+    """An InterpDriver client loaded with the SAME synthetic corpus
+    build_driver creates — the interpreter oracle for byte-parity checks.
+    It must be its own instance: an unbound InterpDriver method call on a
+    TpuDriver would dispatch polymorphically right back onto the device
+    path."""
+    from ..client.drivers import InterpDriver
+
+    return _load_client(InterpDriver(), n_templates, n_resources, seed)
+
+
+def _load_client(driver, n_templates: int, n_resources: int, seed: int):
+    from ..client.client import Client
+
     templates, constraints = make_templates(n_templates, seed)
-    client = Client(driver=TpuDriver())
+    client = Client(driver=driver)
     for t in templates:
         client.add_template(t)
     for c in constraints:
@@ -267,3 +283,21 @@ def build_driver(n_templates: int, n_resources: int, seed: int = 0):
     for p in make_pods(n_resources, seed + 1):
         client.add_data(p)
     return client
+
+
+def audit_result_sig(results):
+    """Canonical order-independent signature of audit results for
+    byte-parity comparisons (constraint kind+name, rendered message,
+    resource name).  The ONE definition shared by the mesh parity tool,
+    the mesh tests and bench.py mesh_curve — so all three gate on the
+    same notion of parity."""
+    return sorted(
+        (
+            r.constraint.get("kind", ""),
+            (r.constraint.get("metadata") or {}).get("name", ""),
+            r.msg,
+            str((r.review.get("object") or {}).get("metadata", {})
+                .get("name")),
+        )
+        for r in results
+    )

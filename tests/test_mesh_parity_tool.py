@@ -1,0 +1,46 @@
+"""Tier-1 wiring for tools/check_mesh_parity.py: the width-1-vs-width-4
+virtual-mesh parity sweep (rendered results, totals, interpreter oracle)
+and the O(churn) locality check run on every test invocation — a
+sharding regression fails fast, before it could ship wrong audit
+results.  The conftest's 8 virtual CPU devices make the width-4 mesh
+available in-process."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+import check_mesh_parity as chk  # noqa: E402
+
+
+def test_repo_mesh_sharding_is_conformant():
+    assert chk.run_checks() == []
+
+
+def test_parity_detector_flags_divergence(monkeypatch):
+    """A merge that drops one shard's candidates must be detected."""
+    import numpy as np
+
+    from gatekeeper_tpu.ops import driver as drv
+
+    orig = drv._merge_sharded_packed
+
+    def broken(packed_all, K):
+        out = orig(packed_all, K)
+        out = np.array(out)
+        out[:, 0] = np.maximum(out[:, 0] - 1, 0)  # lose one count
+        return out
+
+    monkeypatch.setattr(drv, "_merge_sharded_packed", broken)
+    problems = chk.check_width_parity()
+    assert problems and any("diverge" in p for p in problems)
+
+
+def test_locality_detector_flags_full_resweeps(monkeypatch):
+    """If the delta path stopped serving churn under the mesh (every
+    sweep a full dispatch again), the locality check trips."""
+    from gatekeeper_tpu.ops.driver import TpuDriver
+
+    monkeypatch.setattr(TpuDriver, "_try_delta", lambda self, K: None)
+    problems = chk.check_churn_locality()
+    assert problems and "churn locality" in problems[0]

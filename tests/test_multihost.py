@@ -65,19 +65,11 @@ def test_two_process_multihost_sweep_parity(tmp_path):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     coord = f"127.0.0.1:{_free_port()}"
     procs = []
+    from gatekeeper_tpu.parallel.mesh import virtual_mesh_env
+
     for pid in range(2):
-        env = dict(os.environ)
-        env.update(
-            GK_REPO=repo,
-            GK_COORD=coord,
-            GK_PROC=str(pid),
-            PALLAS_AXON_POOL_IPS="",
-            JAX_PLATFORMS="cpu",
-        )
-        kept = [f for f in env.get("XLA_FLAGS", "").split()
-                if "xla_force_host_platform_device_count" not in f]
-        kept.append("--xla_force_host_platform_device_count=4")
-        env["XLA_FLAGS"] = " ".join(kept)
+        env = virtual_mesh_env(4)
+        env.update(GK_REPO=repo, GK_COORD=coord, GK_PROC=str(pid))
         procs.append(subprocess.Popen(
             [sys.executable, "-c", _WORKER], env=env, cwd=repo,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,

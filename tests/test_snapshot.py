@@ -44,17 +44,28 @@ def build_cluster(n=12, labeled_every=2):
     return kube
 
 
-def fresh_client():
-    """Single-device TPU client: the snapshot delta path (like the
-    incremental sweep it restores) is a single-device feature, and the
-    test env's virtual 8-CPU mesh lacks jax.shard_map anyway."""
+def fresh_client(mesh_width=None):
+    """TPU client pinned to a known sweep sharding: single-device by
+    default so the basis round-trip is deterministic; pass mesh_width to
+    exercise the sharded sweep (the conftest provisions 8 virtual CPU
+    devices).  set_mesh also invalidates every topology-keyed cache, so
+    each test starts from a clean placement."""
     client = Client(driver=TpuDriver())
-    client.driver.mesh_enabled = False
+    client.driver.set_mesh(mesh_width is not None, width=mesh_width)
     return client
 
 
 def make_client(kube):
     client = fresh_client()
+    client.add_template(TEMPLATE)
+    client.add_constraint(CONSTRAINT)
+    for obj in kube.list(("", "v1", "Namespace")):
+        client.add_data(obj)
+    return client
+
+
+def make_client_mesh(kube, width):
+    client = fresh_client(mesh_width=width)
     client.add_template(TEMPLATE)
     client.add_constraint(CONSTRAINT)
     for obj in kube.list(("", "v1", "Namespace")):
@@ -120,6 +131,66 @@ class TestRoundTrip:
         hash(frozen["cluster"]["v1"]["Namespace"]["ns-000"])
         ns = client2.driver.store.cached_namespace("ns-000")
         assert ns is None or isinstance(ns, dict)
+
+    def test_delta_basis_dropped_on_mesh_width_drift(self, snap_dir):
+        """A basis persisted under one sweep sharding layout must not
+        serve a process whose mesh width differs: the restore keeps the
+        pack (still 'restored') but drops the basis, and the first sweep
+        is a full dispatch that rebases — with identical verdicts."""
+        kube = build_cluster(n=12)
+        client1 = make_client(kube)
+        cold_sig, _ = audit_sig(client1)  # single-device basis (width 1)
+
+        snapper = Snapshotter(client1, snap_dir, interval_s=0.0)
+        assert snapper.write_once() is not None
+
+        # same width restores the basis...
+        same = fresh_client()
+        loader = SnapshotLoader(snap_dir)
+        assert loader.restore(same, kube) == "restored"
+        assert loader.delta_restored is True
+
+        # ...a width-4 mesh process drops it (width drift) but keeps the
+        # restored pack and produces identical verdicts via a full sweep
+        drifted = fresh_client(mesh_width=4)
+        loader2 = SnapshotLoader(snap_dir)
+        assert loader2.restore(drifted, kube) == "restored"
+        assert loader2.delta_restored is False
+        assert drifted.driver._delta_state is None
+        warm_sig, _ = audit_sig(drifted)
+        assert warm_sig == cold_sig
+        assert drifted.driver.last_sweep_stats.get("cached") != 1.0
+
+    def test_delta_basis_roundtrips_under_same_mesh_width(self, snap_dir):
+        """Writer persists the mesh layout: a width-4 process's basis
+        restores into another width-4 process and the first sweep serves
+        from it (no full dispatch)."""
+        kube = build_cluster(n=12)
+        client1 = make_client_mesh(kube, width=4)
+        cold_sig, _ = audit_sig(client1)
+
+        snapper = Snapshotter(client1, snap_dir, interval_s=0.0)
+        assert snapper.write_once() is not None
+
+        client2 = fresh_client(mesh_width=4)
+        loader = SnapshotLoader(snap_dir)
+        assert loader.restore(client2, kube) == "restored"
+        assert loader.delta_restored is True
+        warm_sig, _ = audit_sig(client2)
+        assert warm_sig == cold_sig
+        assert client2.driver.last_sweep_stats.get("cached") == 1.0
+        # churn after the restore rides the O(churn) delta path AGAINST
+        # the restored (now mesh-committed) base mask — one dirty row
+        # dispatched, not a full [C, R] resweep
+        flipped = kube.get(("", "v1", "Namespace"), "ns-000")
+        flipped["metadata"]["labels"].pop("gatekeeper", None)
+        kube.update(flipped)
+        client2.add_data(kube.get(("", "v1", "Namespace"), "ns-000"))
+        churn_sig, _ = audit_sig(client2)
+        assert client2.driver.last_sweep_stats.get("delta_rows") == 1.0
+        ref = make_client_mesh(kube, width=4)
+        ref_sig, _ = audit_sig(ref)
+        assert churn_sig == ref_sig
 
     def test_delta_resync_packs_only_churn(self, snap_dir):
         kube = build_cluster(n=10)
