@@ -127,9 +127,15 @@ class Manager:
             self.template_status.registrar = tstatus_reg
             self.controllers += [self.constraint_status, self.template_status]
 
-    def start(self):
-        # engine state is derived; rebuild from the API server on boot
-        self.deps.client.reset()
+    def start(self, reset: bool = True):
+        # engine state is derived; rebuild from the API server on boot.
+        # reset=False is the warm-resume path (docs/snapshots.md,
+        # docs/fleet.md): a successful snapshot restore already installed
+        # the engine state, and the watch replay's RV/content dedup turns
+        # the rebuild into a delta resync — resetting here would throw
+        # the restored pack away and pay the cold path anyway.
+        if reset:
+            self.deps.client.reset()
         self.template.registrar.add_watch(TEMPLATES_GVK)
         self.config.registrar.add_watch(CONFIG_GVK)
         if self.operations.is_assigned(ops_mod.STATUS):

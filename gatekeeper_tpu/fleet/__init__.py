@@ -1,0 +1,33 @@
+"""Fleet serving: N single-role webhook replicas with shared warmth.
+
+The million-user admission plane (ROADMAP item 2, docs/fleet.md) is
+horizontal: one Python process tops out around the measured streamed
+review rate, so scale comes from running N webhook-only replicas
+(`--operation webhook`, main.py role wiring per the reference
+pkg/operations/operations.go:13-29), each restoring the HMAC-sealed
+snapshot and the AOT executable cache a single audit-role process
+maintains — a scaled-up replica is device-ready in seconds instead of
+paying the cold relist + trace + compile.
+
+Pieces:
+
+- :mod:`replica` — the replica worker runtime (subprocess entry point +
+  parent-side spawn/ready/stop helpers used by ``bench.py fleet`` and
+  ``tools/check_fleet_parity.py``);
+- :mod:`frontdoor` — a stdlib HTTP front door (round-robin or
+  least-inflight) for benching and parity checks; production fleets
+  use a Service/LB, this one exists so the repo can DRIVE and PROVE
+  the topology end to end.
+
+Trust model: replicas share the snapshot + AOT directories read-mostly
+(atomic-rename snapshots, flock-serialized writers, sealed entries
+verified before any unpickle — util/seal.py, same key via GK_SEAL_KEY).
+Per-replica identity (`--replica-id`) is stamped into metrics
+(`replica_up`, `webhook_batch_*`), root spans, and the SLO /statusz
+payload.
+"""
+
+from .frontdoor import FrontDoor
+from .replica import ReplicaHandle, spawn_replica, spawn_fleet
+
+__all__ = ["FrontDoor", "ReplicaHandle", "spawn_replica", "spawn_fleet"]

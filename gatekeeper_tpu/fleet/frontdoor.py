@@ -1,0 +1,268 @@
+"""Stdlib HTTP front door for a webhook replica fleet (docs/fleet.md).
+
+Production fleets sit behind a Kubernetes Service/LB; this front door
+exists so the repo can drive and prove the fleet topology end to end
+(bench.py fleet, tools/check_fleet_parity.py) with nothing but the
+standard library.  It forwards POST bodies (admission reviews) to one
+of N backends, chosen by
+
+- ``round_robin`` — strict rotation, or
+- ``least_inflight`` (default) — the backend with the fewest requests
+  currently in flight, ties broken by rotation order; under mixed
+  request costs this tracks per-replica service speed without any
+  backend-side signal.
+
+Per-thread persistent connections to each backend (the apiserver's
+webhook client behaves the same way); a backend that fails to answer is
+marked, its connection dropped, and the request retried once on the
+next choice so a dead replica degrades capacity rather than failing
+admissions.  Per-backend served/error/inflight counters are exposed on
+``/fleetz`` and via :meth:`FrontDoor.stats`.
+"""
+
+from __future__ import annotations
+
+import http.client
+import itertools
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional, Sequence, Tuple
+
+from .. import logging as gklog
+from ..util import close_listener
+
+log = gklog.get("fleet.frontdoor")
+
+ROUND_ROBIN = "round_robin"
+LEAST_INFLIGHT = "least_inflight"
+
+# headers copied through to the backend (trace context must survive the
+# hop so replica traces correlate with the front-door request)
+_FORWARD_HEADERS = ("Content-Type", "traceparent")
+
+
+class Backend:
+    __slots__ = ("host", "port", "replica_id", "inflight", "served",
+                 "errors", "consecutive_errors", "lock")
+
+    def __init__(self, host: str, port: int, replica_id: str = ""):
+        self.host = host
+        self.port = int(port)
+        self.replica_id = replica_id or f"{host}:{port}"
+        self.inflight = 0
+        self.served = 0
+        self.errors = 0
+        self.consecutive_errors = 0
+        self.lock = threading.Lock()
+
+
+class FrontDoor:
+    # /healthz counts a backend live until it fails this many requests
+    # in a row with no success in between
+    LIVE_ERROR_STREAK = 3
+
+    def __init__(self, backends: Sequence[Tuple[str, int]] | Sequence[dict],
+                 port: int = 0, policy: str = LEAST_INFLIGHT):
+        if policy not in (ROUND_ROBIN, LEAST_INFLIGHT):
+            raise ValueError(f"unknown front-door policy: {policy!r}")
+        self.policy = policy
+        self.port = port
+        self.backends: List[Backend] = []
+        for b in backends:
+            if isinstance(b, dict):
+                self.backends.append(Backend(
+                    b.get("host", "127.0.0.1"), b["port"],
+                    b.get("replica_id", ""),
+                ))
+            else:
+                host, bport = b
+                self.backends.append(Backend(host, bport))
+        if not self.backends:
+            raise ValueError("front door needs at least one backend")
+        self._rr = itertools.count()
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._local = threading.local()  # per-thread backend connections
+
+    # ---- choice ----------------------------------------------------------
+
+    def _choose(self, exclude: Optional[set] = None) -> Optional[Backend]:
+        live = [
+            (i, b) for i, b in enumerate(self.backends)
+            if not exclude or i not in exclude
+        ]
+        if not live:
+            return None
+        start = next(self._rr) % len(live)
+        if self.policy == ROUND_ROBIN:
+            return live[start][1]
+        # least inflight, rotation as tiebreak so equal backends share
+        rotated = live[start:] + live[:start]
+        return min(rotated, key=lambda ib: ib[1].inflight)[1]
+
+    # ---- forwarding ------------------------------------------------------
+
+    def _conn(self, backend: Backend) -> http.client.HTTPConnection:
+        conns = getattr(self._local, "conns", None)
+        if conns is None:
+            conns = self._local.conns = {}
+        key = (backend.host, backend.port)
+        conn = conns.get(key)
+        if conn is None:
+            conn = http.client.HTTPConnection(
+                backend.host, backend.port, timeout=30
+            )
+            conns[key] = conn
+        return conn
+
+    def _drop_conn(self, backend: Backend):
+        conns = getattr(self._local, "conns", None)
+        if conns is not None:
+            conn = conns.pop((backend.host, backend.port), None)
+            if conn is not None:
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+
+    def forward(self, method: str, path: str, body: bytes,
+                headers: dict) -> Tuple[int, dict, bytes, str]:
+        """-> (status, response_headers, body, replica_id).  Tries up to
+        len(backends) distinct backends; raises ConnectionError when all
+        fail (the caller answers 502 — never a silent allow)."""
+        tried: set = set()
+        last_exc: Optional[Exception] = None
+        for _ in range(len(self.backends)):
+            backend = self._choose(exclude=tried)
+            if backend is None:
+                break
+            idx = self.backends.index(backend)
+            tried.add(idx)
+            with backend.lock:
+                backend.inflight += 1
+            try:
+                conn = self._conn(backend)
+                conn.request(method, path, body=body, headers=headers)
+                resp = conn.getresponse()
+                data = resp.read()
+                with backend.lock:
+                    backend.inflight -= 1
+                    backend.served += 1
+                    backend.consecutive_errors = 0
+                return resp.status, dict(resp.getheaders()), data, \
+                    backend.replica_id
+            except Exception as e:
+                last_exc = e
+                self._drop_conn(backend)
+                with backend.lock:
+                    backend.inflight -= 1
+                    backend.errors += 1
+                    backend.consecutive_errors += 1
+                log.warning("backend %s failed (%s: %s); trying next",
+                            backend.replica_id, type(e).__name__, e)
+        raise ConnectionError(
+            f"no fleet backend answered: {last_exc!r}"
+        )
+
+    # ---- stats -----------------------------------------------------------
+
+    def stats(self) -> dict:
+        return {
+            "policy": self.policy,
+            "backends": [
+                {
+                    "replica_id": b.replica_id,
+                    "host": b.host, "port": b.port,
+                    "inflight": b.inflight,
+                    "served": b.served,
+                    "errors": b.errors,
+                    "consecutive_errors": b.consecutive_errors,
+                }
+                for b in self.backends
+            ],
+        }
+
+    # ---- server ----------------------------------------------------------
+
+    def start(self):
+        # idempotent, like every other listener in this repo (a double
+        # start replaces, never leaks)
+        close_listener(self._server, self._thread)
+        self._server = None
+        self._thread = None
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+            disable_nagle_algorithm = True
+
+            def log_message(self, *args):
+                pass
+
+            def _send(self, code: int, ctype: str, body: bytes,
+                      replica: str = ""):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                if replica:
+                    self.send_header("X-GK-Replica", replica)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    # liveness must be RECENT: a backend that once
+                    # served but now fails every request is dead, so
+                    # the predicate is the current error streak, not a
+                    # sticky served counter
+                    live = sum(
+                        1 for b in outer.backends
+                        if b.consecutive_errors < outer.LIVE_ERROR_STREAK
+                    )
+                    self._send(200 if live else 503, "text/plain",
+                               b"ok" if live else b"no backends")
+                elif self.path == "/fleetz":
+                    self._send(200, "application/json",
+                               json.dumps(outer.stats()).encode())
+                else:
+                    self._send(404, "text/plain", b"not found")
+
+            def do_POST(self):
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                except (TypeError, ValueError):
+                    self.close_connection = True
+                    self._send(400, "text/plain", b"bad Content-Length")
+                    return
+                body = self.rfile.read(length) if length > 0 else b""
+                fwd = {
+                    k: v for k in _FORWARD_HEADERS
+                    if (v := self.headers.get(k)) is not None
+                }
+                fwd["Content-Length"] = str(len(body))
+                try:
+                    code, _hdrs, data, rid = outer.forward(
+                        "POST", self.path, body, fwd
+                    )
+                except ConnectionError as e:
+                    # all backends down: explicit 502, the apiserver's
+                    # failurePolicy decides — never a fabricated verdict
+                    self._send(502, "text/plain", str(e).encode())
+                    return
+                self._send(code, "application/json", data, replica=rid)
+
+        self._server = ThreadingHTTPServer(("0.0.0.0", self.port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="frontdoor", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+            self._thread = None

@@ -1,0 +1,494 @@
+"""Webhook replica runtime + parent-side spawn helpers (docs/fleet.md).
+
+Child entry point (``python -m gatekeeper_tpu.fleet.replica``): builds a
+webhook-ONLY :class:`gatekeeper_tpu.main.App` (no audit manager, no
+snapshot writer arming, no status writer — asserted by
+tests/test_fleet.py) against the in-memory API store, restores the
+shared HMAC-sealed snapshot WITHOUT the RV resync (``--snapshot-no-
+resync``: the local store starts empty; the pack is adopted read-mostly)
+and the shared AOT executable cache, then announces readiness as one
+JSON line on stdout::
+
+    {"event": "ready", "replica_id": ..., "port": ..., "ready_s": ...,
+     "restore_outcome": ..., "templates": N}
+
+and serves until stdin closes (the parent dropping its pipe is the stop
+signal — no PID files, no signal races) or SIGTERM.
+
+``ready_s`` is measured in-process from runtime entry to the first
+admission answered end to end over HTTP — the "warm replica is
+device-ready in seconds" number the fleet bench records; the parent
+additionally measures spawn-to-ready wall time (interpreter + import
+cost included).
+
+Parent side: :func:`spawn_replica` / :func:`spawn_fleet` start children,
+wait for the ready line, and return :class:`ReplicaHandle` objects whose
+``stop()`` closes stdin and reaps the process.  Used by ``bench.py
+fleet`` and ``tools/check_fleet_parity.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import queue
+import subprocess
+import sys
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence
+
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+# ---- child runtime ---------------------------------------------------------
+
+
+def _child_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="gatekeeper-tpu-replica")
+    p.add_argument("--replica-id", required=True)
+    p.add_argument("--port", type=int, default=0,
+                   help="webhook port (0 = ephemeral, announced on stdout)")
+    p.add_argument("--snapshot-dir", default="",
+                   help="shared warm snapshot dir (restored, never written)")
+    p.add_argument("--xla-cache-dir", default="",
+                   help="shared XLA + AOT executable cache dir")
+    p.add_argument("--driver", choices=["interp", "tpu"], default="tpu")
+    p.add_argument("--webhook-batch-static", action="store_true")
+    p.add_argument("--no-seed-namespaces", action="store_true",
+                   help="do not create Namespace objects for restored "
+                        "pack rows in the local in-memory store")
+    return p
+
+
+def _seed_namespaces(app) -> int:
+    """Standalone (in-memory store) replicas: admission of a namespaced
+    object requires its Namespace in the store (ValidationHandler's
+    augmentation lookup).  A real cluster syncs them via the watch; here
+    they are seeded from the restored pack's rows."""
+    ap = getattr(app.client.driver, "_audit_pack", None)
+    if ap is None:
+        return 0
+    names = set()
+    for rv in getattr(ap, "reviews", ()) or ():
+        if not isinstance(rv, dict):
+            continue
+        obj = rv.get("object")
+        if isinstance(obj, dict):
+            ns = (obj.get("metadata") or {}).get("namespace")
+            if ns:
+                names.add(ns)
+    n = 0
+    for ns in sorted(names):
+        try:
+            app.kube.create({
+                "apiVersion": "v1", "kind": "Namespace",
+                "metadata": {"name": ns},
+            })
+            n += 1
+        except Exception:
+            pass  # already present
+    return n
+
+
+def _probe_ready(port: int, timeout_s: float = 120.0) -> None:
+    """One end-to-end admission over HTTP against our own server: the
+    replica is 'device-ready' when a review ANSWERS, not merely when the
+    listener binds."""
+    import http.client
+
+    body = json.dumps({"request": {
+        "uid": "replica-ready-probe",
+        "kind": {"group": "", "version": "v1", "kind": "Namespace"},
+        "name": "gk-replica-probe", "namespace": "",
+        "operation": "CREATE",
+        "userInfo": {"username": "replica-probe"},
+        "object": {"apiVersion": "v1", "kind": "Namespace",
+                   "metadata": {"name": "gk-replica-probe", "labels": {}}},
+    }}).encode()
+    deadline = time.monotonic() + timeout_s
+    last: Optional[Exception] = None
+    while time.monotonic() < deadline:
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+            conn.request("POST", "/v1/admit", body=body,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            data = resp.read()
+            conn.close()
+            if resp.status == 200 and b"response" in data:
+                return
+            last = RuntimeError(f"probe status {resp.status}")
+        except Exception as e:  # listener not up yet
+            last = e
+        time.sleep(0.05)
+    raise TimeoutError(f"replica never became ready: {last!r}")
+
+
+def _stream_requests(app, k: int = 4096) -> List[dict]:
+    """k admission requests cycled from the restored pack's objects (the
+    bench.py batch1m shape: a bounded unique set streamed in chunks)."""
+    objs = []
+    ap = getattr(app.client.driver, "_audit_pack", None)
+    for rv in (getattr(ap, "reviews", ()) or ()):
+        if isinstance(rv, dict) and isinstance(rv.get("object"), dict):
+            objs.append(rv["object"])
+        if len(objs) >= k:
+            break
+    if not objs:  # cold replica: synthesize something admissible
+        objs = [{
+            "apiVersion": "v1", "kind": "Namespace",
+            "metadata": {"name": f"gk-stream-{i}", "labels": {}},
+        } for i in range(min(k, 256))]
+    reqs = []
+    for i, obj in enumerate(objs):
+        kind = obj.get("kind", "Namespace")
+        md = obj.get("metadata") or {}
+        reqs.append({
+            "uid": f"stream-{i}",
+            "kind": {"group": "", "version": "v1", "kind": kind},
+            "name": md.get("name", f"o{i}"),
+            "namespace": md.get("namespace", ""),
+            "operation": "CREATE",
+            "userInfo": {"username": "fleet-bench"},
+            "object": obj,
+        })
+    return reqs
+
+
+def _stream_bench(app, n: int, chunk: int, replica_id: str) -> Dict:
+    """In-process chunked review_batch stream (the bench.py batch1m
+    shape) against THIS replica's restored engine: per-replica saturated
+    throughput without the HTTP framing cost, which the fleet bench's
+    latency phase measures separately through the front door."""
+    reqs = _stream_requests(app)
+    driver = app.client.driver
+
+    def batch_of(start: int, size: int) -> List[dict]:
+        return [reqs[(start + j) % len(reqs)] for j in range(size)]
+
+    # warm with the exact chunk shapes the timed loop dispatches
+    driver.review_batch(batch_of(0, min(chunk, n)))
+    tail = n % chunk
+    if tail and n > chunk:
+        driver.review_batch(batch_of(0, tail))
+    # wall-clock stamps so the PARENT can compute the true overlapping
+    # window across replicas (per-process monotonic clocks don't align;
+    # same-host wall clock does)
+    w0 = time.time()
+    t0 = time.perf_counter()
+    done = 0
+    while done < n:
+        size = min(chunk, n - done)
+        driver.review_batch(batch_of(done, size))
+        done += size
+    dur = time.perf_counter() - t0
+    return {
+        "event": "stream_done",
+        "replica_id": replica_id,
+        "n": n,
+        "chunk": chunk,
+        "s": round(dur, 3),
+        "t0_wall": w0,
+        "t1_wall": time.time(),
+        "reviews_per_s": round(n / dur, 1),
+    }
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    t0 = time.monotonic()
+    args = _child_parser().parse_args(argv)
+    from ..kube.inmem import InMemoryKube
+    from ..main import App, build_parser
+
+    # fleet replicas are read-mostly consumers of the SHARED AOT cache:
+    # they add entries but never delete ones they cannot verify — those
+    # may be another build's warmth (docs/fleet.md trust model)
+    os.environ.setdefault("GK_AOT_READ_MOSTLY", "1")
+    flags = [
+        "--driver", args.driver,
+        "--operation", "webhook",
+        "--replica-id", args.replica_id,
+        "--port", str(args.port),
+        "--prometheus-port", "0",
+        "--health-addr", ":0",
+        "--disable-cert-rotation",  # TLS terminates at the front door
+        "--log-level", os.environ.get("GK_REPLICA_LOG_LEVEL", "WARNING"),
+    ]
+    if args.snapshot_dir:
+        flags += ["--snapshot-dir", args.snapshot_dir,
+                  "--snapshot-no-resync"]
+    if args.xla_cache_dir:
+        flags += ["--xla-cache-dir", args.xla_cache_dir]
+    if args.webhook_batch_static:
+        flags += ["--webhook-batch-static"]
+    app = App(build_parser().parse_args(flags), kube=InMemoryKube())
+    app.start()
+    try:
+        seeded = 0
+        if not args.no_seed_namespaces:
+            seeded = _seed_namespaces(app)
+        drv = app.client.driver
+        if hasattr(drv, "wait_ready"):
+            drv.wait_ready(timeout=300.0)
+        _probe_ready(app.webhook_server.port)
+        ready = {
+            "event": "ready",
+            "replica_id": args.replica_id,
+            "port": app.webhook_server.port,
+            "ready_s": round(time.monotonic() - t0, 3),
+            "restore_outcome": getattr(
+                app, "snapshot_restore_outcome", "none"),
+            "templates": len(app.client.templates()),
+            "namespaces_seeded": seeded,
+        }
+        print(json.dumps(ready), flush=True)
+        # serve until the parent closes our stdin (or EOF on a detached
+        # run): the pipe IS the lifetime — a dead parent reaps the fleet.
+        # Lines on stdin are JSON commands (bench.py fleet drives the
+        # in-process throughput stream this way); unknown lines are
+        # ignored so a plain `echo | replica` still just serves.
+        try:
+            for line in sys.stdin:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    cmd = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(cmd, dict) and cmd.get("cmd") == "stream":
+                    print(json.dumps(_stream_bench(
+                        app,
+                        n=int(cmd.get("n", 100_000)),
+                        chunk=int(cmd.get("chunk", 8192)),
+                        replica_id=args.replica_id,
+                    )), flush=True)
+        except (KeyboardInterrupt, ValueError):
+            pass
+        return 0
+    finally:
+        app.stop()
+
+
+# ---- parent-side spawn helpers ---------------------------------------------
+
+
+_EOF = object()  # reader-thread sentinel: child stdout closed
+
+
+def _spawn_proc(replica_id: str, snapshot_dir: str, cache_dir: str,
+                extra_flags: Sequence[str],
+                env: Optional[Dict[str, str]]) -> subprocess.Popen:
+    cmd = [sys.executable, "-m", "gatekeeper_tpu.fleet.replica",
+           "--replica-id", replica_id]
+    if snapshot_dir:
+        cmd += ["--snapshot-dir", snapshot_dir]
+    if cache_dir:
+        cmd += ["--xla-cache-dir", cache_dir]
+    cmd += list(extra_flags)
+    child_env = dict(os.environ)
+    if env:
+        child_env.update(env)
+    return subprocess.Popen(
+        cmd, cwd=REPO_ROOT, env=child_env,
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True,
+    )
+
+
+def _attach_pipes(proc: subprocess.Popen, replica_id: str):
+    """Reader threads own BOTH child pipes from the moment of spawn:
+
+    - stdout: parsed JSON dicts land on a queue the parent reads with a
+      real timeout — a bare ``readline()`` would block past any deadline
+      on a wedged child, and mixing ``select()`` with buffered readline
+      misses replies already sitting in the text-wrapper buffer;
+    - stderr: drained continuously into a bounded tail — a chatty child
+      (WARNING logs under co-tenant load) would otherwise fill the 64KB
+      pipe and deadlock mid-command; the tail feeds error messages.
+    """
+    msgs: queue.Queue = queue.Queue()
+    stderr_tail: deque = deque(maxlen=400)
+
+    def _read_stdout():
+        try:
+            for line in proc.stdout:
+                try:
+                    msg = json.loads(line)
+                except ValueError:
+                    continue  # stray log line on stdout
+                if isinstance(msg, dict):
+                    msgs.put(msg)
+        except Exception:
+            pass
+        msgs.put(_EOF)
+
+    def _read_stderr():
+        try:
+            for line in proc.stderr:
+                stderr_tail.append(line)
+        except Exception:
+            pass
+
+    for target, name in ((_read_stdout, "out"), (_read_stderr, "err")):
+        threading.Thread(
+            target=target, name=f"replica-{replica_id}-{name}", daemon=True,
+        ).start()
+    return msgs, stderr_tail
+
+
+def _stderr_str(stderr_tail: deque) -> str:
+    return "".join(stderr_tail)[-2000:]
+
+
+def _wait_ready(proc: subprocess.Popen, replica_id: str,
+                msgs: queue.Queue, stderr_tail: deque,
+                t0: float, timeout_s: float) -> Dict:
+    """Block until the child's ready line; on timeout KILL the child so
+    a wedged spawn never leaks, on early exit report rc + stderr tail."""
+    deadline = t0 + timeout_s
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            proc.kill()
+            proc.wait(timeout=10)
+            raise TimeoutError(
+                f"replica {replica_id} never announced ready; stderr "
+                f"tail:\n{_stderr_str(stderr_tail)}"
+            )
+        try:
+            msg = msgs.get(timeout=min(remaining, 1.0))
+        except queue.Empty:
+            continue
+        if msg is _EOF:
+            proc.wait(timeout=10)
+            raise RuntimeError(
+                f"replica {replica_id} exited rc={proc.returncode} before "
+                f"ready; stderr tail:\n{_stderr_str(stderr_tail)}"
+            )
+        if msg.get("event") == "ready":
+            return msg
+
+
+class ReplicaHandle:
+    def __init__(self, proc: subprocess.Popen, replica_id: str,
+                 ready: Dict, spawn_s: float,
+                 msgs: queue.Queue, stderr_tail: deque):
+        self.proc = proc
+        self.replica_id = replica_id
+        self.ready = ready          # the child's announced ready line
+        self.port: int = int(ready["port"])
+        self.ready_s: float = float(ready["ready_s"])  # in-process
+        self.spawn_s = spawn_s      # parent wall: Popen -> ready line
+        self.host = "127.0.0.1"
+        self._msgs = msgs
+        self._stderr_tail = stderr_tail
+
+    def backend(self) -> Dict:
+        return {"host": self.host, "port": self.port,
+                "replica_id": self.replica_id}
+
+    def command(self, cmd: Dict, timeout_s: float = 600.0) -> Dict:
+        """Send one JSON command line to the child and return its JSON
+        reply (the reader thread skips stray stdout lines; the queue
+        read enforces the timeout even when the child emits nothing)."""
+        self.proc.stdin.write(json.dumps(cmd) + "\n")
+        self.proc.stdin.flush()
+        deadline = time.monotonic() + timeout_s
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"replica {self.replica_id} command timed out: {cmd}"
+                )
+            try:
+                msg = self._msgs.get(timeout=remaining)
+            except queue.Empty:
+                continue
+            if msg is _EOF:
+                raise RuntimeError(
+                    f"replica {self.replica_id} died mid-command "
+                    f"(rc={self.proc.poll()}); stderr tail:\n"
+                    f"{_stderr_str(self._stderr_tail)}"
+                )
+            return msg
+
+    def stop(self, timeout_s: float = 15.0):
+        if self.proc.poll() is None:
+            try:
+                self.proc.stdin.close()  # the lifetime signal
+            except Exception:
+                pass
+            try:
+                self.proc.wait(timeout=timeout_s)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(timeout=5.0)
+
+
+def spawn_replica(replica_id: str, snapshot_dir: str = "",
+                  cache_dir: str = "", extra_flags: Sequence[str] = (),
+                  env: Optional[Dict[str, str]] = None,
+                  timeout_s: float = 300.0) -> ReplicaHandle:
+    """Start one replica child and block until its ready line (raising
+    with the child's stderr tail on failure)."""
+    t0 = time.monotonic()
+    proc = _spawn_proc(replica_id, snapshot_dir, cache_dir, extra_flags, env)
+    msgs, stderr_tail = _attach_pipes(proc, replica_id)
+    ready = _wait_ready(proc, replica_id, msgs, stderr_tail, t0, timeout_s)
+    return ReplicaHandle(proc, replica_id, ready,
+                         round(time.monotonic() - t0, 3), msgs, stderr_tail)
+
+
+def spawn_fleet(n: int, snapshot_dir: str = "", cache_dir: str = "",
+                extra_flags: Sequence[str] = (),
+                env: Optional[Dict[str, str]] = None,
+                timeout_s: float = 300.0,
+                sequential: bool = True) -> List[ReplicaHandle]:
+    """Start n replicas (r0..r{n-1}).  ``sequential`` (default) waits for
+    each before starting the next — on a small host, concurrent cold
+    spawns contend for cores and every ready time degrades; a k8s fleet
+    scales up on fresh nodes, which sequential spawn approximates."""
+    handles: List[ReplicaHandle] = []
+    procs: List = []
+    try:
+        if sequential:
+            for i in range(n):
+                handles.append(spawn_replica(
+                    f"r{i}", snapshot_dir, cache_dir, extra_flags, env,
+                    timeout_s,
+                ))
+        else:
+            for i in range(n):
+                rid = f"r{i}"
+                t0 = time.monotonic()
+                proc = _spawn_proc(
+                    rid, snapshot_dir, cache_dir, extra_flags, env
+                )
+                procs.append((rid, t0, proc, *_attach_pipes(proc, rid)))
+            for rid, t0, proc, msgs, stderr_tail in procs:
+                ready = _wait_ready(
+                    proc, rid, msgs, stderr_tail, t0, timeout_s
+                )
+                handles.append(ReplicaHandle(
+                    proc, rid, ready, round(time.monotonic() - t0, 3),
+                    msgs, stderr_tail,
+                ))
+    except BaseException:
+        # kill EVERY spawned child, wrapped in a handle or not — a
+        # partially-failed concurrent spawn must not leak live replicas
+        for _rid, _t0, proc, *_rest in procs:
+            if proc.poll() is None:
+                proc.kill()
+        for h in handles:
+            h.stop()
+        raise
+    return handles
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -29,7 +29,16 @@ from . import logging as gklog
 from . import operations as ops_mod
 from .apis import status as status_api
 from .audit import AuditManager
-from .certs import CertRotator
+
+# cert rotation needs the `cryptography` package; a fleet replica running
+# behind a TLS-terminating front door (or a dev/bench process) must still
+# be able to come up without it.  The import is gated, and App degrades
+# with an explicit warning when rotation is requested but unavailable —
+# never silently.
+try:
+    from .certs import CertRotator
+except ImportError:  # pragma: no cover - environment-dependent
+    CertRotator = None  # type: ignore[assignment]
 from .client.client import Client
 from .client.drivers import InterpDriver
 from .controllers import Dependencies, Manager
@@ -38,7 +47,9 @@ from .metrics import MetricsExporter, Reporters
 from .process.excluder import Excluder
 from .readiness.tracker import Tracker
 from .upgrade import UpgradeManager
-from .util import get_id, get_namespace
+from .util import (
+    close_listener, get_id, get_namespace, replica_id, set_replica_id,
+)
 from .webhook import (
     MicroBatcher,
     NamespaceLabelHandler,
@@ -80,6 +91,13 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=list(ops_mod.ALL_OPERATIONS),
                    help="operation roles for this process (repeatable; "
                         "default all)")
+    # fleet serving (docs/fleet.md): per-replica identity for metrics,
+    # spans, SLO payloads and logs
+    p.add_argument("--replica-id",
+                   default=os.environ.get("GK_REPLICA_ID", ""),
+                   help="fleet replica id stamped into telemetry "
+                        "(metrics label, root-span attr, /statusz); "
+                        "empty = not part of a fleet")
     # metrics exporter.go:14-15
     p.add_argument("--metrics-backend", default="Prometheus")
     p.add_argument("--prometheus-port", type=int, default=8888)
@@ -119,6 +137,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "interpreter while compiling in the background")
     p.add_argument("--webhook-batch-window-ms", type=float, default=2.0,
                    help="micro-batching window for admission reviews")
+    p.add_argument("--webhook-batch-max-deadline-ms", type=float,
+                   default=25.0,
+                   help="ceiling on the load-adaptive batcher's flush "
+                        "deadline under saturating load (docs/fleet.md)")
+    p.add_argument("--webhook-batch-static", action="store_true",
+                   help="disable the load-adaptive batch controller and "
+                        "keep the fixed recent-concurrency window")
     # graceful degradation (docs/failure-modes.md)
     p.add_argument("--admission-deadline-budget-ms", type=float, default=0.0,
                    help="per-request admission deadline budget in ms; work "
@@ -182,6 +207,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--snapshot-disable", action="store_true",
                    help="keep --snapshot-dir configured but skip both the "
                         "startup restore and the background writer")
+    p.add_argument("--snapshot-no-resync", action="store_true",
+                   help="restore the snapshot WITHOUT the resourceVersion "
+                        "delta resync against the API store.  For fleet "
+                        "webhook replicas adopting a shared warm snapshot "
+                        "whose pack they do not own: the watch replay "
+                        "still reconciles the store afterwards "
+                        "(docs/fleet.md)")
     p.add_argument("--fault-plane-seed", type=int, default=None,
                    help="EXPLICITLY enable the fault-injection plane with "
                         "this seed (testing only; add schedules via "
@@ -257,8 +289,15 @@ class HealthServer:
         self.port = port
         self.readiness_check = readiness_check
         self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
 
     def start(self):
+        # idempotent: a double start replaces the previous listener
+        # instead of leaking its thread and socket (the PR 3
+        # WebhookServer.start / PR 5 MetricsExporter.start contract)
+        close_listener(self._server, self._thread)
+        self._server = None
+        self._thread = None
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -283,15 +322,15 @@ class HealthServer:
 
         self._server = ThreadingHTTPServer(("0.0.0.0", self.port), Handler)
         self.port = self._server.server_address[1]
-        threading.Thread(
+        self._thread = threading.Thread(
             target=self._server.serve_forever, name="health", daemon=True
-        ).start()
+        )
+        self._thread.start()
 
     def stop(self):
-        if self._server:
-            self._server.shutdown()
-            self._server.server_close()
-            self._server = None
+        close_listener(self._server, self._thread)
+        self._server = None
+        self._thread = None
 
 
 class ProfileServer:
@@ -301,8 +340,15 @@ class ProfileServer:
     def __init__(self, port: int = 6060):
         self.port = port
         self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
 
     def start(self):
+        # idempotent, like HealthServer.start (no leaked listener thread
+        # or socket on a double start)
+        close_listener(self._server, self._thread)
+        self._server = None
+        self._thread = None
+
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *args):
                 pass
@@ -335,15 +381,15 @@ class ProfileServer:
 
         self._server = ThreadingHTTPServer(("0.0.0.0", self.port), Handler)
         self.port = self._server.server_address[1]
-        threading.Thread(
+        self._thread = threading.Thread(
             target=self._server.serve_forever, name="pprof", daemon=True
-        ).start()
+        )
+        self._thread.start()
 
     def stop(self):
-        if self._server:
-            self._server.shutdown()
-            self._server.server_close()
-            self._server = None
+        close_listener(self._server, self._thread)
+        self._server = None
+        self._thread = None
 
 
 class App:
@@ -375,6 +421,9 @@ class App:
         self.kube = kube if kube is not None else make_kube(
             getattr(args, "api_server", "inmem"))
         self.operations = ops_mod.Operations(args.operation or None)
+        # fleet identity: stamped into root spans, the replica-labelled
+        # metric series and the SLO /statusz payload (docs/fleet.md)
+        set_replica_id(getattr(args, "replica_id", "") or "")
         self.reporters = Reporters()
         from .obs import trace as obstrace
 
@@ -434,9 +483,21 @@ class App:
 
         self.excluder = Excluder()
         self.tracker = Tracker()
-        self.rotator: Optional[CertRotator] = None
+        self.rotator = None
         if not args.disable_cert_rotation:
-            self.rotator = CertRotator(self.kube)
+            if CertRotator is None:
+                # the gated import above: never silent — a replica that
+                # cannot rotate serves externally-provided certs from
+                # --cert-dir or plain HTTP behind a TLS-terminating
+                # front door (docs/fleet.md trust model)
+                log.warning(
+                    "cert rotation requested but the 'cryptography' "
+                    "package is unavailable; continuing without rotation "
+                    "(provide certs in --cert-dir or terminate TLS "
+                    "upstream)"
+                )
+            else:
+                self.rotator = CertRotator(self.kube)
 
         self.manager = Manager(
             Dependencies(
@@ -459,6 +520,7 @@ class App:
         self.micro_batcher: Optional[MicroBatcher] = None
         self.profile_server: Optional[ProfileServer] = None
         self.snapshotter = None
+        self.snapshot_restore_outcome = "none"
         self._stopping = False
 
     def start(self):
@@ -503,30 +565,46 @@ class App:
         # store's RV dedup then turns the replay into a delta resync), and
         # before the audit manager's first sweep consumes the restored pack
         snap_dir = getattr(args, "snapshot_dir", "")
+        self.snapshot_restore_outcome = "none"
         if snap_dir and not getattr(args, "snapshot_disable", False):
             from .snapshot import SnapshotLoader, Snapshotter
 
             try:
                 outcome = SnapshotLoader(snap_dir).restore(
-                    self.client, self.kube, excluder=self.excluder
+                    self.client, self.kube, excluder=self.excluder,
+                    resync=not getattr(args, "snapshot_no_resync", False),
                 )
+                self.snapshot_restore_outcome = outcome
                 log.info("snapshot restore outcome: %s", outcome)
             except Exception:
                 # restore guards internally; this is the belt over those
                 # braces — a persistence defect must never block startup
                 log.exception("snapshot restore failed; cold start")
-            self.snapshotter = Snapshotter(
-                self.client, snap_dir,
-                interval_s=getattr(args, "snapshot_interval", 300.0),
-                retain=getattr(args, "snapshot_retain", 3),
-            )
-            self.snapshotter.start()
+            # only the audit role ARMS the background writer: snapshots
+            # capture the packed audit state right after a sweep, which
+            # only that role produces.  A webhook-only fleet replica is a
+            # read-mostly consumer of the shared snapshot dir — it must
+            # never write to (or prune) warmth other replicas restore
+            # from (docs/fleet.md)
+            if self.operations.is_assigned(ops_mod.AUDIT):
+                self.snapshotter = Snapshotter(
+                    self.client, snap_dir,
+                    interval_s=getattr(args, "snapshot_interval", 300.0),
+                    retain=getattr(args, "snapshot_retain", 3),
+                )
+                self.snapshotter.start()
         elif snap_dir:
             from .metrics.catalog import record_snapshot_outcome
 
             record_snapshot_outcome("disabled")
         self.tracker.run(self.kube)
-        self.manager.start()
+        # warm resume keeps the restored engine state: the controllers'
+        # boot reset would wipe the pack the loader just installed, and
+        # the watch replay's RV/content dedup reconciles the store against
+        # it as a delta resync instead (docs/snapshots.md, docs/fleet.md)
+        self.manager.start(
+            reset=self.snapshot_restore_outcome != "restored"
+        )
 
         # degradation visibility: breaker state (TPU driver only) plus the
         # SLO engine's burn-rate status for /healthz + /statusz
@@ -555,7 +633,10 @@ class App:
 
         if self.operations.is_assigned(ops_mod.WEBHOOK):
             self.micro_batcher = MicroBatcher(
-                self.client, window_s=args.webhook_batch_window_ms / 1000.0
+                self.client, window_s=args.webhook_batch_window_ms / 1000.0,
+                adaptive=not getattr(args, "webhook_batch_static", False),
+                max_deadline_s=getattr(
+                    args, "webhook_batch_max_deadline_ms", 25.0) / 1000.0,
             )
             handler = ValidationHandler(
                 self.micro_batcher,
@@ -644,11 +725,15 @@ class App:
             jax.profiler.start_server(args.jax_profile_port)
             self._jax_profiler_on = True
         self._start_routing_calibration()
+        from .metrics.catalog import record_replica_up
+
+        record_replica_up()
         log.info(
             "gatekeeper-tpu started",
             extra={"kv": {
                 "operations": self.operations.assigned_string_list(),
                 "driver": args.driver,
+                "replica_id": replica_id(),
             }},
         )
 

@@ -208,6 +208,31 @@ AUDIT_SHARD_DISPATCH_M = Measure(
     "double-buffered placement pipeline",
     unit="s",
 )
+# ---- fleet serving + load-adaptive micro-batcher (ISSUE 7) ------------------
+# All four series carry the replica_id label (util.replica_id(); empty on
+# single-process deployments) so a scraped fleet's telemetry separates
+# per replica without relying on scrape-time instance labels.
+REPLICA_UP_M = Measure(
+    "replica_up",
+    "1 for a started gatekeeper process, labelled by its fleet "
+    "replica_id (empty outside a fleet)",
+)
+BATCH_TARGET_M = Measure(
+    "webhook_batch_target_size",
+    "The micro-batcher's current load-adapted target batch size "
+    "(1 = immediate dispatch at the latency floor)",
+)
+BATCH_DEADLINE_M = Measure(
+    "webhook_batch_deadline_ms",
+    "The micro-batcher's current load-adapted flush deadline: how long "
+    "the accumulation window stays open under observed concurrency",
+    unit="ms",
+)
+OFFERED_LOAD_M = Measure(
+    "webhook_offered_load_rps",
+    "Offered admission load the micro-batcher currently observes "
+    "(decayed arrival rate, requests/second)",
+)
 SLO_BURN_M = Measure(
     "slo_burn_rate",
     "Error-budget burn rate per SLO objective and trailing window "
@@ -333,6 +358,14 @@ def catalog_views():
              AGG_DISTRIBUTION, tag_keys=("path",), buckets=_STAGE_BUCKETS),
         View("audit_shard_dispatch_seconds", AUDIT_SHARD_DISPATCH_M,
              AGG_DISTRIBUTION, tag_keys=("path",), buckets=_STAGE_BUCKETS),
+        View("replica_up", REPLICA_UP_M, AGG_LAST_VALUE,
+             tag_keys=("replica_id",)),
+        View("webhook_batch_target_size", BATCH_TARGET_M, AGG_LAST_VALUE,
+             tag_keys=("replica_id",)),
+        View("webhook_batch_deadline_ms", BATCH_DEADLINE_M, AGG_LAST_VALUE,
+             tag_keys=("replica_id",)),
+        View("webhook_offered_load_rps", OFFERED_LOAD_M, AGG_LAST_VALUE,
+             tag_keys=("replica_id",)),
         View("slo_burn_rate", SLO_BURN_M, AGG_LAST_VALUE,
              tag_keys=("objective", "window")),
         View("slo_error_budget_remaining", SLO_BUDGET_M, AGG_LAST_VALUE,
@@ -590,6 +623,36 @@ def record_audit_shard(rows: int, pack_s: float, dispatch_s: float,
         reg.record(AUDIT_SHARD_PACK_M, pack_s, tags, exemplar_trace_id=tid)
         reg.record(AUDIT_SHARD_DISPATCH_M, dispatch_s, tags,
                    exemplar_trace_id=tid)
+    except Exception:  # pragma: no cover - telemetry never blocks eval
+        pass
+
+
+def _replica_tags() -> Dict[str, str]:
+    from ..util import replica_id
+
+    return {"replica_id": replica_id()}
+
+
+def record_replica_up():
+    """Stamp this process's replica identity (App.start; also the fleet
+    replica runtime).  Guarded like record_stage."""
+    try:
+        _global().record(REPLICA_UP_M, 1.0, _replica_tags())
+    except Exception:  # pragma: no cover - telemetry never blocks startup
+        pass
+
+
+def record_batcher_state(target_size: int, deadline_ms: float,
+                         offered_load_rps: float):
+    """The micro-batcher's current adaptation state (one record per
+    dispatch, NOT per request — the batcher throttles).  Guarded like
+    record_stage."""
+    try:
+        reg = _global()
+        tags = _replica_tags()
+        reg.record(BATCH_TARGET_M, float(target_size), tags)
+        reg.record(BATCH_DEADLINE_M, float(deadline_ms), tags)
+        reg.record(OFFERED_LOAD_M, float(offered_load_rps), tags)
     except Exception:  # pragma: no cover - telemetry never blocks eval
         pass
 

@@ -241,6 +241,14 @@ class SLOEngine:
                 {name for (name, _p) in self._alerts_active}
             ),
         }
+        # fleet identity: each replica runs its OWN engine over its own
+        # traffic, so /statusz + /debug/slo payloads from N replicas
+        # stay attributable when an aggregator merges them
+        from ..util import replica_id
+
+        rid = replica_id()
+        if rid:
+            out["replica_id"] = rid
         for key in newly:
             for cb in list(self._on_alert):
                 try:

@@ -392,6 +392,13 @@ def root_span(name: str, traceparent: Optional[str] = None,
     else:
         tr = Trace()
         sp = Span(name, tr, **attrs)
+    # fleet identity on every root span: /debug/traces entries from N
+    # replicas merged by an aggregator stay attributable (docs/fleet.md)
+    from ..util import replica_id
+
+    rid = replica_id()
+    if rid:
+        sp.attrs.setdefault("replica_id", rid)
     tr.root = sp
     return _SpanCtx(sp)
 

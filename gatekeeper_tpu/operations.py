@@ -50,6 +50,26 @@ class Operations:
             ops = self._assigned or set(ALL_OPERATIONS)
         return sorted(ops)
 
+    # ---- single-role helpers (fleet serving, docs/fleet.md) ----------------
+
+    def assigned_set(self) -> Set[str]:
+        """The effective operation set (empty assignment = ALL)."""
+        with self._lock:
+            return set(self._assigned or ALL_OPERATIONS)
+
+    def is_only(self, op: str) -> bool:
+        """True when this process serves exactly one role, `op` — the
+        fleet's webhook replicas assert this to prove no audit manager,
+        snapshot writer, or status writer rides along."""
+        return self.assigned_set() == {op}
+
+    def explicitly_assigned(self) -> bool:
+        """True when --operation was passed at least once (the process is
+        a deliberately single/limited-role fleet member, not a default
+        run-everything singleton)."""
+        with self._lock:
+            return bool(self._assigned)
+
 
 # process-global default, mirroring the reference's package-level singleton
 _default = Operations()

@@ -51,6 +51,13 @@ log = logging.getLogger("gatekeeper.aotcache")
 
 _dir: Optional[str] = None
 _lock = threading.Lock()
+# read-mostly consumer mode (docs/fleet.md trust model): fleet webhook
+# replicas SHARE the cache dir with the rest of the fleet.  They may add
+# entries (atomic rename, additive) but must never delete shared ones —
+# a replica on a newer code fingerprint sees every older build's seal
+# fail, and auto-dropping would strip the warmth the still-running old
+# replicas restore from.
+_read_mostly = False
 
 
 def _record_cache(cache: str, hit: bool):
@@ -73,8 +80,8 @@ def _record_compile(seconds: float):
         pass
 
 
-def enable(cache_dir: str) -> bool:
-    global _dir
+def enable(cache_dir: str, read_mostly: Optional[bool] = None) -> bool:
+    global _dir, _read_mostly
     try:
         from ..util import seal as _seal
 
@@ -83,6 +90,11 @@ def enable(cache_dir: str) -> bool:
         log.exception("aot cache dir unavailable: %s", cache_dir)
         return False
     _dir = cache_dir
+    if read_mostly is None:
+        read_mostly = os.environ.get("GK_AOT_READ_MOSTLY", "") not in (
+            "", "0", "false",
+        )
+    _read_mostly = bool(read_mostly)
     return True
 
 
@@ -177,7 +189,10 @@ def save(key: str, compiled) -> bool:
         pickle.dump((payload, in_tree, out_tree), buf,
                     protocol=pickle.HIGHEST_PROTOCOL)
         path = os.path.join(_dir, key + ".aot")
-        tmp = path + f".tmp.{os.getpid()}"
+        # pid AND thread id: two threads of one process saving the same
+        # key (e.g. review + audit shapes compiling concurrently) must
+        # not interleave writes into one tmp file
+        tmp = path + f".tmp.{os.getpid()}.{threading.get_ident()}"
         with open(tmp, "wb") as f:
             f.write(_seal_entry(buf.getvalue()))
         os.replace(tmp, path)  # atomic: concurrent writers race benignly
@@ -188,7 +203,11 @@ def save(key: str, compiled) -> bool:
 
 
 def drop(key: str) -> None:
-    if _dir is None:
+    """Remove one entry — unless this process is a read-mostly consumer
+    of a SHARED dir, where a locally-unusable entry (stale seal, host
+    mismatch) is someone else's warmth: it stays, and the local miss is
+    the whole cost."""
+    if _dir is None or _read_mostly:
         return
     try:
         os.remove(os.path.join(_dir, key + ".aot"))

@@ -330,6 +330,10 @@ class TpuDriver(InterpDriver):
         # measured routing cost model (calibrate_routing); None -> the
         # static DEVICE_MIN_CELLS prior decides interp-vs-device
         self._route_cal: Optional[Dict[str, float]] = None
+        # offered-load hint (reviews/s, monotonic stamp) from the
+        # micro-batcher: with it, routing prices sustainable THROUGHPUT
+        # under saturation instead of this batch's latency alone
+        self._offered_load: Optional[tuple] = None
         # incremental host-serving constraint side (ops/npside.py):
         # admission-sized batches evaluate the same VExpr IR in numpy —
         # no dispatch RTT, no compile, O(1) maintenance per mutation.
@@ -1588,13 +1592,22 @@ class TpuDriver(InterpDriver):
                 np_ms(1), np_ms(8), n_constraints, 8 * n_constraints,
             )
 
+        # warm first, then MEDIAN of the warm samples — the r05 curve
+        # misrouted N=50 to interp (6.28ms measured vs np's 2.11ms)
+        # because min() over three samples that include cold parser/
+        # freeze caches prices the interpreter at its best-case rate,
+        # which real unique-content requests do not pay.  Like the
+        # device probe above, the route should price the expectation;
+        # the np path keeps min() deliberately (its floor is what the
+        # route must not be biased away from).
+        self._interp_review_memo(cal_review())  # warm: parser/freeze/caches
         interp_ts = []
-        for _ in range(runs):
+        for _ in range(max(runs, 3) + 2):
             rv = cal_review()  # unique: the request memo cannot serve it
             t0 = _time.perf_counter()
             self._interp_review_memo(rv)
             interp_ts.append(_time.perf_counter() - t0)
-        interp_ms = float(min(interp_ts)) * 1e3
+        interp_ms = float(np.median(interp_ts)) * 1e3
         interp_cells_per_ms = n_constraints / max(interp_ms, 1e-3)
 
         cal = {
@@ -1613,12 +1626,72 @@ class TpuDriver(InterpDriver):
     # below this many cells the walk wins
     NP_MIN_CELLS = int(os.environ.get("GK_NP_MIN_CELLS", "24"))
 
-    def _route_eval(self, cells: int) -> str:
+    # a load hint older than this is stale (the batcher refreshes every
+    # dispatch; a gone batcher must not pin throughput routing forever)
+    LOAD_HINT_TTL_S = 5.0
+    # feasibility margin: a tier must sustain the offered load with this
+    # much headroom before latency-routing may pick it — running a tier
+    # at 100% of its measured capacity queues unboundedly
+    LOAD_HEADROOM = 1.25
+
+    def set_offered_load(self, rps: Optional[float]):
+        """Offered-load hint from the micro-batcher (reviews/s).  With a
+        fresh hint and a calibration, _route_eval prices SUSTAINABLE
+        throughput: the latency-optimal tier is only chosen while it can
+        actually carry the offered rate (docs/fleet.md)."""
+        import time as _time
+
+        if rps and rps > 0:
+            self._offered_load = (float(rps), _time.monotonic())
+        else:
+            self._offered_load = None
+
+    def _load_hint(self) -> Optional[float]:
+        h = self._offered_load
+        if h is None:
+            return None
+        import time as _time
+
+        rps, t = h
+        return rps if _time.monotonic() - t <= self.LOAD_HINT_TTL_S else None
+
+    def _tier_models(self, per_review_cells: int):
+        """[(tier, floor_ms, per_review_ms)] from the calibration — the
+        affine service model shared by latency routing, load-aware
+        routing, and the batcher's adaptation loop."""
+        cal = self._route_cal
+        if cal is None:
+            return []
+        out = [
+            ("interp", 0.0, per_review_cells / cal["interp_cells_per_ms"]),
+            ("device", cal["rtt_ms"],
+             per_review_cells / cal["device_cells_per_ms"]),
+        ]
+        if self.np_serve_enabled and "np_floor_ms" in cal:
+            out.append(
+                ("np", cal["np_floor_ms"],
+                 per_review_cells / cal["np_cells_per_ms"])
+            )
+        return out
+
+    # the largest batch the serving layer coalesces (MicroBatcher
+    # max_batch default): tier capacity is measured at this batch size
+    ROUTE_MAX_BATCH = 256.0
+
+    def _route_eval(self, cells: int, n_reviews: int = 1) -> str:
         """Predicted-cheapest path for a request of `cells` =
         reviews x constraints: "device" | "np" | "interp".
         DEVICE_MIN_CELLS = 0 always forces the device (tests rely on it);
         uncalibrated, the static DEVICE_MIN_CELLS / NP_MIN_CELLS priors
-        decide."""
+        decide.
+
+        With a fresh offered-load hint (set_offered_load) the choice is
+        LOAD-aware, not size-only: each tier's sustainable throughput is
+        mu = B / (floor + B*per_review_ms) at the max coalesced batch B;
+        tiers that cannot carry the offered rate (with headroom) are
+        excluded even when they'd win this batch's latency, and when no
+        tier sustains it the highest-throughput tier is chosen so the
+        queue drains fastest."""
         if self.DEVICE_MIN_CELLS == 0:
             return "device"
         cal = self._route_cal
@@ -1634,6 +1707,23 @@ class TpuDriver(InterpDriver):
             costs.append(
                 (cal["np_floor_ms"] + cells / cal["np_cells_per_ms"], "np")
             )
+        lam = self._load_hint()
+        if lam:
+            per_review = max(cells // max(n_reviews, 1), 1)
+            lam_pms = lam / 1e3  # reviews per ms
+            B = self.ROUTE_MAX_BATCH
+            mu = {
+                tier: B / max(floor + B * per_ms, 1e-9)
+                for tier, floor, per_ms in self._tier_models(per_review)
+            }
+            sustainable = [
+                (ms, tier) for ms, tier in costs
+                if mu.get(tier, 0.0) >= lam_pms * self.LOAD_HEADROOM
+            ]
+            if sustainable:
+                return min(sustainable)[1]
+            if mu:  # saturated everywhere: drain via max throughput
+                return max(mu.items(), key=lambda kv: kv[1])[0]
         return min(costs)[1]
 
 
@@ -1682,21 +1772,41 @@ class TpuDriver(InterpDriver):
                 served[i] = evaled[j]
         return [s if isinstance(s, tuple) else (s, None) for s in served]
 
+    def _n_constraints_total(self) -> int:
+        """Installed constraint count, cached per epoch (summing 500
+        kinds per admission is real).  Caller need not hold the lock."""
+        with self._lock:  # concurrent ingest may resize the dicts (RLock)
+            cached = self._n_constraints_cache
+            if cached is not None and cached[0] == self._cs_epoch:
+                return cached[1]
+            n_constraints = sum(
+                len(v) for v in self.constraints.values()
+            )
+            self._n_constraints_cache = (self._cs_epoch, n_constraints)
+            return n_constraints
+
+    def predicted_batch_ms(self, n_reviews: int) -> Optional[float]:
+        """Predicted service time (ms) of an n-review coalesced batch on
+        its cheapest tier — the micro-batcher's adaptation model.  None
+        until calibrate_routing has run."""
+        if self._route_cal is None:
+            return None
+        per_review = max(self._n_constraints_total(), 1)
+        models = self._tier_models(per_review)
+        if not models:
+            return None
+        return min(
+            floor + n_reviews * per_ms for _t, floor, per_ms in models
+        )
+
     def _review_batch_eval(self, reviews: List[dict], tracing: bool,
                            memo_reviews: Optional[list] = None):
         """Route and evaluate (no memo probe: review_batch already served
         the hits)."""
-        with self._lock:  # concurrent ingest may resize the dicts (RLock)
-            # cached per epoch: summing 500 kinds per admission is real
-            cached = self._n_constraints_cache
-            if cached is not None and cached[0] == self._cs_epoch:
-                n_constraints = cached[1]
-            else:
-                n_constraints = sum(
-                    len(v) for v in self.constraints.values()
-                )
-                self._n_constraints_cache = (self._cs_epoch, n_constraints)
-        route = self._route_eval(len(reviews) * max(n_constraints, 1))
+        n_constraints = self._n_constraints_total()
+        route = self._route_eval(
+            len(reviews) * max(n_constraints, 1), n_reviews=len(reviews)
+        )
         if route != "device" or (
             # async ingestion: while the background XLA compile for the
             # latest template/constraint epoch is in flight, admission

@@ -443,11 +443,20 @@ class SnapshotLoader:
 
     # ---- the whole restore --------------------------------------------------
 
-    def restore(self, client, kube, excluder=None) -> str:
+    def restore(self, client, kube, excluder=None, resync: bool = True) -> str:
         """Try every snapshot newest-first; returns the outcome string
         (restored / fallback / none) after recording it in metrics.
         Validation failures fall through to older snapshots; a failure
-        AFTER state installation wipes back to a clean cold start."""
+        AFTER state installation wipes back to a clean cold start.
+
+        ``resync=False`` skips step 4 (the resourceVersion reconcile
+        against the live API): fleet webhook replicas adopting a SHARED
+        warm snapshot pass this — their local store starts empty, so a
+        resync would read every restored row as deleted and tombstone
+        the pack they just adopted.  The watch replay still reconciles
+        the store afterwards (store RV dedup turns it into a delta
+        resync), and the pack they restored is read-mostly state they
+        do not own (docs/fleet.md)."""
         t0 = time.perf_counter()
         names = fmt.list_snapshots(self.root)
         if not names:
@@ -473,11 +482,14 @@ class SnapshotLoader:
                     with obstrace.span("snapshot.install",
                                        rows=state["n_rows"]):
                         self._install(client, state)
-                    with obstrace.span("snapshot.resync") as sp:
-                        stats = self._resync(
-                            client, kube, state, excluder=excluder
-                        )
-                        sp.set_attrs(**stats)
+                    if resync:
+                        with obstrace.span("snapshot.resync") as sp:
+                            stats = self._resync(
+                                client, kube, state, excluder=excluder
+                            )
+                            sp.set_attrs(**stats)
+                    else:
+                        stats = {"resync": "skipped"}
                     self.delta_restored = self._restore_delta(client, state)
                 except Exception:
                     # any failure past validation may have left partial
@@ -494,7 +506,12 @@ class SnapshotLoader:
                 live_rows = sum(
                     1 for p in state["row_path"] if p is not None
                 )
-                if live_rows and not stats["matched"]:
+                if not resync:
+                    # adopted wholesale (fleet shared-warmth path): the
+                    # snapshot IS the state; staleness is the watch
+                    # replay's problem, not a fallback condition
+                    outcome = "restored"
+                elif live_rows and not stats["matched"]:
                     # fully stale RVs: every row re-packs — safe, but
                     # cold-equivalent, so report it as the fallback it is
                     log.warning(

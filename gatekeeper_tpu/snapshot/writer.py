@@ -41,6 +41,52 @@ log = gklog.get("snapshot")
 
 DEFAULT_RETAIN = 3
 
+# advisory cross-process writer lock (fleet shared snapshot dirs,
+# docs/fleet.md): two audit-role processes pointed at one directory must
+# not interleave prunes with each other's renames.  POSIX-only; where
+# fcntl is unavailable the writer degrades to the single-process
+# behavior it always had.
+try:
+    import fcntl as _fcntl
+except ImportError:  # pragma: no cover - non-POSIX
+    _fcntl = None
+WRITE_LOCK = ".write.lock"
+
+
+class _WriterLock:
+    """Non-blocking exclusive flock on <root>/.write.lock; raises
+    SnapshotError when another process holds it (the Snapshotter treats
+    that as an ordinary skip and retries next cycle).  Readers never
+    take it — the atomic tmp-dir rename is what makes concurrent
+    restores safe."""
+
+    def __init__(self, root: str):
+        self._path = os.path.join(root, WRITE_LOCK)
+        self._fh = None
+
+    def __enter__(self):
+        if _fcntl is None:
+            return self
+        self._fh = open(self._path, "a+")
+        try:
+            _fcntl.flock(self._fh, _fcntl.LOCK_EX | _fcntl.LOCK_NB)
+        except OSError:
+            self._fh.close()
+            self._fh = None
+            raise SnapshotError(
+                "another process is writing to this snapshot dir"
+            )
+        return self
+
+    def __exit__(self, *exc):
+        if self._fh is not None:
+            try:
+                _fcntl.flock(self._fh, _fcntl.LOCK_UN)
+            finally:
+                self._fh.close()
+                self._fh = None
+        return False
+
 
 class SnapshotWriter:
     def __init__(self, root: str, retain: int = DEFAULT_RETAIN,
@@ -224,8 +270,14 @@ class SnapshotWriter:
         name = f"{fmt.SNAP_PREFIX}{int(time.time() * 1000):013d}-{os.getpid()}"  # wall-clock: ok (dir name)
         tmp = os.path.join(self.root, f"{fmt.TMP_PREFIX}{name}")
         final = os.path.join(self.root, name)
-        os.makedirs(tmp, mode=0o700)
+        # the on-disk phase is serialized ACROSS processes: a concurrent
+        # writer's prune must never sweep this writer's tmp dir or race
+        # its retention scan (readers stay lock-free — they only ever see
+        # complete, atomically-renamed snapshot dirs)
+        lock = _WriterLock(self.root)
+        lock.__enter__()
         try:
+            os.makedirs(tmp, mode=0o700)
             with open(os.path.join(tmp, fmt.INTERNER), "w") as f:
                 json.dump(state["interner"], f)
             with open(os.path.join(tmp, fmt.REGISTRY), "w") as f:
@@ -277,10 +329,12 @@ class SnapshotWriter:
                 )
             fmt.write_manifest(tmp)
             os.rename(tmp, final)
+            self._prune()
         except BaseException:
             shutil.rmtree(tmp, ignore_errors=True)
             raise
-        self._prune()
+        finally:
+            lock.__exit__()
         dur = time.perf_counter() - t0
         nbytes = fmt.dir_bytes(final)
         record_snapshot_write(dur, nbytes)

@@ -86,6 +86,46 @@ def get_namespace() -> str:
     return os.environ.get("POD_NAMESPACE", "gatekeeper-system")
 
 
+# ---- fleet replica identity (docs/fleet.md) -------------------------------
+#
+# One process = one serving replica.  The id is stamped into root spans,
+# the replica-labelled metrics series, the SLO engine's /statusz payload
+# and every "started" log line, so a fleet's telemetry separates by
+# replica without relying on scrape-time instance labels.  Empty means
+# "not part of a fleet" (single-process deployments stay label-free).
+
+_replica_id: Optional[str] = None
+
+
+def set_replica_id(rid: str) -> None:
+    global _replica_id
+    _replica_id = str(rid or "")
+
+
+def replica_id() -> str:
+    """The process's fleet replica id: --replica-id, else $GK_REPLICA_ID,
+    else empty."""
+    if _replica_id is not None:
+        return _replica_id
+    return os.environ.get("GK_REPLICA_ID", "")
+
+
+def close_listener(server, thread) -> None:
+    """Tear down a socketserver-based listener for an idempotent
+    ``start()``: ``shutdown()`` only when its serve_forever thread
+    actually runs (on a loop that never started it would block forever),
+    then close the socket.  Callers null their own references afterwards
+    — a double ``start()`` replaces the previous listener instead of
+    leaking its thread and socket (the WebhookServer / MetricsExporter
+    contract; used by HealthServer, ProfileServer and the fleet
+    FrontDoor)."""
+    if server is None:
+        return
+    if thread is not None and thread.is_alive():
+        server.shutdown()
+    server.server_close()
+
+
 def nested_get(obj: Any, *path: str, default: Any = None) -> Any:
     """unstructured.Nested* analogue: walk dict path, default on miss."""
     node = obj

@@ -23,6 +23,7 @@ from .. import logging as gklog
 from ..metrics.catalog import (
     WEBHOOK_QUEUE_M,
     record_batch_size,
+    record_batcher_state,
     record_stage,
 )
 from ..obs import trace as obstrace
@@ -77,17 +78,58 @@ class MicroBatcher:
     up to `window_s` so concurrent arrivals share one review_batch; and
     while a batch is evaluating, new arrivals accumulate naturally behind
     it, which is the real batching mechanism under sustained load.
+
+    LOAD-ADAPTIVE (docs/fleet.md): with a routing calibration on the
+    driver (TpuDriver.calibrate_routing — rtt/cells-per-ms, the
+    BENCH_r04/r05 `routing_calibration` model), the batcher continuously
+    adapts to the offered load it observes:
+
+    - it tracks a decayed arrival rate λ (reviews/s);
+    - the TARGET batch size is the batching equilibrium B = λ·T(B),
+      where T(B) is the model-predicted service time of a B-review
+      batch on its cheapest tier — low load fixes the target at 1
+      (immediate flush, the inline fast path keeps the p99 floor), high
+      load grows batches toward the throughput-optimal tier;
+    - the FLUSH DEADLINE is the time it takes λ to deliver the target
+      (capped by ``max_deadline_s``), so a lull never strands a partial
+      batch;
+    - λ is pushed to the driver (set_offered_load) each dispatch, which
+      makes the interp/np/device route choice load-aware instead of
+      size-only.
+
+    Without a calibration the adaptive controller stays dormant and the
+    original recent-concurrency window heuristic applies unchanged.
     """
 
-    def __init__(self, client, window_s: float = 0.002, max_batch: int = 256):
+    # adaptation cadence/shape knobs (class-level so tests can tune)
+    RATE_BUCKET_S = 0.25     # arrival-rate sampling bucket
+    RATE_ALPHA = 0.5         # EWMA blend per bucket
+    IDLE_RESET_S = 2.0       # no arrivals this long -> rate resets to 0
+    # dispatch headroom reserved when the adaptive window is clamped to
+    # a queued member's admission-deadline budget
+    DEADLINE_CLAMP_MARGIN_S = 0.002
+
+    def __init__(self, client, window_s: float = 0.002, max_batch: int = 256,
+                 adaptive: bool = True, max_deadline_s: float = 0.025):
         self._client = client
         self.window_s = window_s
         self.max_batch = max_batch
+        self.adaptive = adaptive
+        self.max_deadline_s = max_deadline_s
         self._pending: List[_Pending] = []
         self._cv = threading.Condition()
         self._inline = threading.Lock()  # at most one idle fast-path eval
         self._busy = False  # a batch is evaluating (pending already drained)
         self._stop = False
+        # arrival-rate tracking (its own tiny lock: the inline fast path
+        # must not contend on _cv just to count itself)
+        self._rate_lock = threading.Lock()
+        self._arrivals = 0
+        self._rate_t0 = time.monotonic()
+        self._load_rps = 0.0
+        # current adaptation state (read by tests, /debug spans, metrics)
+        self._target_batch = 1
+        self._deadline_s = 0.0
         self._thread = threading.Thread(
             target=self._run, name="microbatcher", daemon=True
         )
@@ -97,9 +139,99 @@ class MicroBatcher:
     def __getattr__(self, name):
         return getattr(self._client, name)
 
+    # ---- load-adaptive controller ---------------------------------------
+
+    def _note_arrival(self):
+        with self._rate_lock:
+            self._arrivals += 1
+
+    def offered_load_rps(self) -> float:
+        """Decayed arrival rate (reviews/s); rolls the sampling bucket as
+        a side effect.  An empty bucket decays the EWMA toward zero, so
+        a burst minutes ago never taxes today's lone request."""
+        now = time.monotonic()
+        with self._rate_lock:
+            dt = now - self._rate_t0
+            if dt >= self.RATE_BUCKET_S:
+                inst = self._arrivals / dt
+                if dt >= self.IDLE_RESET_S:
+                    # the bucket only rolls when _adapt runs, so a long
+                    # gap means the batcher sat idle: adopt the gap's
+                    # observed (near-zero) rate outright — one EWMA
+                    # blend would leave half of a minutes-old burst
+                    # taxing today's lone request with a deadline
+                    self._load_rps = inst
+                else:
+                    self._load_rps = (
+                        inst if self._load_rps == 0.0
+                        else (1.0 - self.RATE_ALPHA) * self._load_rps
+                        + self.RATE_ALPHA * inst
+                    )
+                if self._load_rps < 1e-3:
+                    self._load_rps = 0.0
+                self._arrivals = 0
+                self._rate_t0 = now
+            return self._load_rps
+
+    def _service_model(self):
+        """(predict, set_load) from the wrapped client's driver — None
+        pair when there is no calibrated TpuDriver underneath (tests,
+        interp deployments): the adaptive controller then stays dormant
+        and the static recent-concurrency heuristic applies."""
+        drv = getattr(self._client, "driver", None)
+        target = drv if drv is not None else self._client
+        return (
+            getattr(target, "predicted_batch_ms", None),
+            getattr(target, "set_offered_load", None),
+        )
+
+    def _adapt(self):
+        """(target_batch, deadline_s) for the next accumulation window.
+
+        Target is the batching equilibrium B = λ·T(B) under the driver's
+        calibrated service model T (fixed-point iterated, clamped to
+        [1, max_batch]): while one batch evaluates, λ·T(B) new arrivals
+        accumulate behind it, so dispatching exactly that many keeps the
+        queue stationary.  The deadline is the time λ needs to deliver
+        the target (capped), so a lull flushes a partial batch instead
+        of stranding it.  Low load collapses to (1, 0) — immediate
+        dispatch, the inline fast path keeps the sparse-traffic p99.
+        Pushes λ to the driver so routing is load-aware, and exports the
+        webhook_batch_* gauges."""
+        lam = self.offered_load_rps()
+        target, deadline = 1, 0.0
+        predict, set_load = self._service_model()
+        if self.adaptive and lam > 0.0 and predict is not None:
+            try:
+                if set_load is not None:
+                    set_load(lam)
+                lam_pms = lam / 1e3
+                b = 1.0
+                t_ms = None
+                for _ in range(4):  # fixed point; converges in 2-3 steps
+                    t_ms = predict(max(int(b), 1))
+                    if t_ms is None:
+                        break
+                    nb = min(max(lam_pms * t_ms, 1.0),
+                             float(self.max_batch))
+                    if abs(nb - b) < 0.5:
+                        b = nb
+                        break
+                    b = nb
+                if t_ms is not None:
+                    target = max(int(round(b)), 1)
+                    if target > 1:
+                        deadline = min(target / lam, self.max_deadline_s)
+            except Exception:  # the model must never stall dispatch
+                target, deadline = 1, 0.0
+        self._target_batch, self._deadline_s = target, deadline
+        record_batcher_state(target, deadline * 1e3, lam)
+        return target, deadline
+
     def review(self, obj, tracing: bool = False):
         if faults.ENABLED:
             faults.fire(faults.WEBHOOK_ENQUEUE)
+        self._note_arrival()
         if tracing:
             # traced requests are rare and want their own trace output;
             # bypass the batch
@@ -161,20 +293,59 @@ class MicroBatcher:
                     self._cv.wait(timeout=0.1)
                 if self._stop and not self._pending:
                     return
-                # open the accumulation window only under observed, RECENT
-                # concurrency (several already waiting, or the previous
-                # batch coalesced moments ago) — a sequential client
-                # issuing one request at a time must never pay the window,
-                # or the sparse-traffic p99 absorbs it wholesale; and a
-                # burst minutes ago must not tax today's lone request
-                recent = (
-                    _time.monotonic() - last_dispatch_end < 5 * self.window_s
-                )
-                concurrent = len(self._pending) > 1 or (
-                    last_batch_size > 1 and recent
-                )
-                if concurrent and len(self._pending) < self.max_batch:
-                    self._cv.wait(timeout=self.window_s)
+            # adapt OUTSIDE the cv: the service model takes the driver
+            # lock (predicted_batch_ms -> _n_constraints_total), and a
+            # long driver hold (audit sweep, snapshot capture) must not
+            # stall every enqueue behind the cv — producers only need
+            # the cv to append and notify
+            target, deadline = self._adapt()
+            with self._cv:
+                # load-adaptive accumulation (docs/fleet.md): with a
+                # calibrated service model and observed load, hold the
+                # window until the equilibrium target batch arrives or
+                # the adaptive deadline lapses (each arrival notifies the
+                # cv, so a filled target dispatches immediately)
+                goal = min(target, self.max_batch)
+                if target > 1 and len(self._pending) < goal:
+                    t_end = _time.monotonic() + deadline
+                    while (
+                        not self._stop and len(self._pending) < goal
+                    ):
+                        # a deadline-budgeted member must never be held
+                        # past its own budget by the adaptive window:
+                        # clamp to the earliest pending deadline (minus
+                        # a dispatch margin), recomputed each pass since
+                        # new arrivals may carry tighter budgets
+                        cut = t_end
+                        for p in self._pending:
+                            if p.deadline is not None:
+                                cut = min(
+                                    cut,
+                                    p.deadline
+                                    - self.DEADLINE_CLAMP_MARGIN_S,
+                                )
+                        remaining = cut - _time.monotonic()
+                        if remaining <= 0:
+                            break
+                        self._cv.wait(timeout=remaining)
+                else:
+                    # static heuristic (no calibration / low load): open
+                    # the window only under observed, RECENT concurrency
+                    # (several already waiting, or the previous batch
+                    # coalesced moments ago) — a sequential client
+                    # issuing one request at a time must never pay the
+                    # window, or the sparse-traffic p99 absorbs it
+                    # wholesale; and a burst minutes ago must not tax
+                    # today's lone request
+                    recent = (
+                        _time.monotonic() - last_dispatch_end
+                        < 5 * self.window_s
+                    )
+                    concurrent = len(self._pending) > 1 or (
+                        last_batch_size > 1 and recent
+                    )
+                    if concurrent and len(self._pending) < self.max_batch:
+                        self._cv.wait(timeout=self.window_s)
                 batch = self._pending[: self.max_batch]
                 self._pending = self._pending[self.max_batch:]
                 last_batch_size = len(batch)
@@ -212,8 +383,15 @@ class MicroBatcher:
                 record_batch_size(len(batch))
                 req_spans = [p.span for p in batch if p.span is not None]
                 if req_spans:  # un-traced batches skip span work entirely
+                    # adaptation state on the dispatch span, mirrored
+                    # into every member's trace: /debug/traces shows WHY
+                    # a given request waited (target it accumulated
+                    # toward, deadline, the load that set them)
                     bsp = obstrace.batch_span(
                         "webhook.batch", req_spans, batch_size=len(batch),
+                        batch_target=self._target_batch,
+                        batch_deadline_ms=round(self._deadline_s * 1e3, 3),
+                        offered_load_rps=round(self._load_rps, 1),
                     )
                     btoken = obstrace.CURRENT.set(bsp)
             try:
@@ -274,6 +452,14 @@ class MicroBatcher:
                 last_dispatch_end = _time.monotonic()
 
     def stop(self):
+        # clear the driver's load hint: a stopped batcher must not pin
+        # throughput routing for whoever evaluates next (tests, restarts)
+        try:
+            _predict, set_load = self._service_model()
+            if set_load is not None:
+                set_load(None)
+        except Exception:
+            pass
         # drain under the cv lock: a request appended concurrently either
         # lands before the drain (gets BatcherStopped here) or after _stop
         # is set (review() rejects it) — no pending can be left waiting on

@@ -52,6 +52,22 @@ import pytest  # noqa: E402
 
 
 @pytest.fixture(autouse=True)
+def _gk_logger_isolation():
+    """gklog.setup() (run by App startup) attaches a handler to the
+    'gatekeeper' logger and disables propagation — process-wide.  Restore
+    the logger after every test so an App-constructing test doesn't break
+    caplog-based assertions for the rest of the session."""
+    import logging as _logging
+
+    root = _logging.getLogger("gatekeeper")
+    level, handlers, propagate = root.level, root.handlers[:], root.propagate
+    yield
+    root.setLevel(level)
+    root.handlers[:] = handlers
+    root.propagate = propagate
+
+
+@pytest.fixture(autouse=True)
 def _no_fault_or_thread_leaks():
     """Fail any test that leaves the process-global fault plane enabled or
     leaks a non-daemon thread.  A leaked plane would inject faults into

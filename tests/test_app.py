@@ -26,13 +26,23 @@ def make_app(extra_flags=None, kube=None):
     return App(build_parser().parse_args(flags), kube=kube)
 
 
-def _post_admit(app, request):
-    body = json.dumps({"request": request}).encode()
+def _scheme_ctx(app):
+    """(scheme, ssl_context) for talking to the app's webhook: TLS when
+    cert rotation is live, plain HTTP where the `cryptography` package is
+    unavailable and App degraded with its explicit warning."""
+    if app.rotator is None:
+        return "http", None
     ctx = ssl.create_default_context()
     ctx.check_hostname = False
     ctx.verify_mode = ssl.CERT_NONE
+    return "https", ctx
+
+
+def _post_admit(app, request):
+    body = json.dumps({"request": request}).encode()
+    scheme, ctx = _scheme_ctx(app)
     r = urllib.request.Request(
-        f"https://127.0.0.1:{app.webhook_server.port}/v1/admit", data=body
+        f"{scheme}://127.0.0.1:{app.webhook_server.port}/v1/admit", data=body
     )
     with urllib.request.urlopen(r, context=ctx, timeout=10) as resp:
         return json.loads(resp.read())
@@ -87,11 +97,12 @@ class TestApp:
 
             # readiness
             assert app.tracker.wait_satisfied(timeout=5)
+            scheme, ctx = _scheme_ctx(app)
             with urllib.request.urlopen(
                 urllib.request.Request(
-                    f"https://127.0.0.1:{app.webhook_server.port}/readyz"
+                    f"{scheme}://127.0.0.1:{app.webhook_server.port}/readyz"
                 ),
-                context=ssl._create_unverified_context(),
+                context=ctx,
                 timeout=5,
             ) as r:
                 assert r.status == 200

@@ -20,6 +20,10 @@ import urllib.request
 import pytest
 import yaml
 
+# the HTTPS kube stub serves real TLS; without `cryptography` the cert
+# helpers cannot import — skip cleanly instead of erroring at collection
+pytest.importorskip("cryptography")
+
 from gatekeeper_tpu.certs.rotator import generate_ca, generate_server_cert
 from gatekeeper_tpu.kube.apiserver import KubeApiServer
 from gatekeeper_tpu.kube.http_client import HttpKube, KubeError

@@ -1,0 +1,155 @@
+"""Routing-model regression + load-aware route choice (ISSUE 7).
+
+The r05 curve misrouted N=50 to the interpreter (6.28ms measured vs
+np's 2.11ms) because the calibration priced the interpreter with min()
+over samples that included cold parser/freeze caches.  The fix measures
+warm samples and takes the median; the regression test here pins the
+route choice for every point of the RECORDED r05 curve against the
+RECORDED r05 calibration — the model must pick the tier that actually
+measured fastest, at every N.
+
+The load-aware extension (docs/fleet.md): with a fresh offered-load
+hint from the micro-batcher, tiers that cannot SUSTAIN the offered rate
+are excluded even when they win single-batch latency, and when nothing
+sustains it the highest-throughput tier drains the queue.
+"""
+
+import time
+
+import pytest
+
+from gatekeeper_tpu.ops.driver import TpuDriver
+
+# BENCH_r05.json routing_calibration — the recorded model
+R05_CAL = {
+    "rtt_ms": 192.724,
+    "device_cells_per_ms": 2.185,
+    "interp_cells_per_ms": 9.78,
+    "np_floor_ms": 1.615,
+    "np_cells_per_ms": 19.323,
+}
+
+# BENCH_r05.json curve_*_p50_ms — what each tier actually measured, and
+# therefore the tier the router should have picked
+R05_MEASURED = {
+    #  N: (interp_ms, np_ms, device_ms)
+    5: (0.608, 1.087, 159.41),
+    10: (1.192, 1.535, 141.531),
+    50: (6.28, 2.108, 134.664),      # the r05 misroute: was "interp"
+    100: (12.48, 1.929, 124.846),
+    200: (22.938, 2.704, 133.507),
+    1000: (118.841, 2.08, 125.88),
+    2000: (243.156, 3.026, 201.429),
+}
+
+
+def _driver_with(cal):
+    drv = TpuDriver()
+    drv._route_cal = dict(cal) if cal else None
+    assert drv.DEVICE_MIN_CELLS != 0, "route tests need the real prior"
+    return drv
+
+
+class TestR05CurveRegression:
+    def test_route_matches_the_measured_winner_at_every_n(self):
+        drv = _driver_with(R05_CAL)
+        for n, (interp_ms, np_ms, device_ms) in R05_MEASURED.items():
+            want = min(
+                [(interp_ms, "interp"), (np_ms, "np"),
+                 (device_ms, "device")]
+            )[1]
+            assert drv._route_eval(n) == want, (
+                f"N={n}: route {drv._route_eval(n)!r}, "
+                f"measured winner {want!r}"
+            )
+
+    def test_n50_is_np_not_interp(self):
+        """The specific r05 defect, pinned on its own."""
+        drv = _driver_with(R05_CAL)
+        assert drv._route_eval(50) == "np"
+
+
+LOAD_CAL = {
+    # per-review service with 10 cells/review:
+    #   interp: 10ms/review        -> mu @ B=256 =  100 rps
+    #   np:     0.5 + 1ms/review   -> mu @ B=256 ~  998 rps
+    #   device: 5 + 0.1ms/review   -> mu @ B=256 ~ 8366 rps
+    # single-review latency: np 1.5ms < device 5.1ms < interp 10ms
+    "rtt_ms": 5.0,
+    "device_cells_per_ms": 100.0,
+    "interp_cells_per_ms": 1.0,
+    "np_floor_ms": 0.5,
+    "np_cells_per_ms": 10.0,
+}
+CELLS = 10  # one review x 10 constraints
+
+
+class TestLoadAwareRouting:
+    def test_no_hint_routes_by_latency(self):
+        drv = _driver_with(LOAD_CAL)
+        assert drv._route_eval(CELLS) == "np"
+
+    def test_moderate_load_excludes_the_unsustainable_interpreter(self):
+        drv = _driver_with(LOAD_CAL)
+        drv.set_offered_load(100.0)  # interp mu=100 < 100*1.25
+        assert drv._route_eval(CELLS, n_reviews=1) == "np"
+
+    def test_high_load_overrides_latency_for_throughput(self):
+        drv = _driver_with(LOAD_CAL)
+        drv.set_offered_load(2000.0)  # np mu ~998 < 2500: excluded
+        assert drv._route_eval(CELLS, n_reviews=1) == "device"
+
+    def test_saturation_everywhere_picks_max_throughput(self):
+        drv = _driver_with(LOAD_CAL)
+        drv.set_offered_load(20000.0)  # above every tier's mu
+        assert drv._route_eval(CELLS, n_reviews=1) == "device"
+
+    def test_stale_hint_expires(self):
+        drv = _driver_with(LOAD_CAL)
+        drv.set_offered_load(2000.0)
+        rps, _t = drv._offered_load
+        drv._offered_load = (
+            rps, time.monotonic() - drv.LOAD_HINT_TTL_S - 1.0
+        )
+        # hint expired: back to latency routing
+        assert drv._route_eval(CELLS, n_reviews=1) == "np"
+
+    def test_clearing_the_hint_restores_latency_routing(self):
+        drv = _driver_with(LOAD_CAL)
+        drv.set_offered_load(2000.0)
+        assert drv._route_eval(CELLS, n_reviews=1) == "device"
+        drv.set_offered_load(None)
+        assert drv._route_eval(CELLS, n_reviews=1) == "np"
+        drv.set_offered_load(0.0)  # zero load == no hint
+        assert drv._offered_load is None
+
+    def test_batch_size_scales_per_review_cells(self):
+        """The load model prices PER-REVIEW service: a 64-review batch
+        of the same corpus must not look 64x heavier per review."""
+        drv = _driver_with(LOAD_CAL)
+        drv.set_offered_load(2000.0)
+        assert drv._route_eval(CELLS * 64, n_reviews=64) == "device"
+
+
+class TestPredictedBatchMs:
+    def test_none_without_calibration(self):
+        drv = _driver_with(None)
+        assert drv.predicted_batch_ms(8) is None
+
+    def test_cheapest_tier_minimum(self):
+        drv = _driver_with(LOAD_CAL)
+        # empty constraint registry -> 1 cell/review; affine minimum over
+        # tiers at B=1 and B=256 (np floor wins small, slope rules large)
+        t1 = drv.predicted_batch_ms(1)
+        t256 = drv.predicted_batch_ms(256)
+        assert t1 is not None and t256 is not None
+        assert t1 < t256
+        models = drv._tier_models(1)
+        assert t1 == pytest.approx(
+            min(floor + 1 * per for _t, floor, per in models)
+        )
+
+    def test_monotone_in_batch_size(self):
+        drv = _driver_with(LOAD_CAL)
+        xs = [drv.predicted_batch_ms(n) for n in (1, 4, 16, 64, 256)]
+        assert xs == sorted(xs)

@@ -1,0 +1,257 @@
+"""Concurrent multi-process snapshot + AOT-cache access (ISSUE 7,
+docs/fleet.md shared-warmth trust model).
+
+Fleet replicas share one snapshot dir and one AOT cache dir.  Readers
+never lock — the writer's write-temp-rename makes every visible snapshot
+complete — and writers serialize across processes on an advisory flock.
+Covered here:
+
+- two PROCESSES restoring the same sealed snapshot simultaneously agree
+  byte-for-byte (and with the writing process's own audit results);
+- a reader racing a writer's write/prune loop always restores a
+  complete snapshot;
+- a corrupted newest entry makes readers fall back (older snapshot)
+  WITHOUT poisoning the shared dir for the next reader;
+- the cross-process writer lock admits one writer and turns the loser's
+  attempt into an ordinary skip;
+- the AOT cache in read-mostly mode never deletes shared entries it
+  cannot verify (a mixed-version fleet must not strip the old build's
+  warmth), while the owning (audit) process still prunes.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from gatekeeper_tpu.snapshot import SnapshotLoader, Snapshotter
+from gatekeeper_tpu.snapshot import format as snapfmt
+from gatekeeper_tpu.snapshot.format import SnapshotError
+from gatekeeper_tpu.snapshot.writer import _WriterLock
+
+from .test_snapshot import audit_sig, build_cluster, fresh_client, make_client
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _can_spawn() -> bool:
+    try:
+        subprocess.run(
+            [sys.executable, "-c", "pass"], timeout=60, check=True,
+            capture_output=True,
+        )
+        return True
+    except Exception:
+        return False
+
+
+spawn_available = pytest.mark.skipif(
+    not _can_spawn(), reason="subprocess spawn unavailable"
+)
+
+_RESTORE_CHILD = """
+import json, sys
+from gatekeeper_tpu.kube.inmem import InMemoryKube
+from gatekeeper_tpu.snapshot import SnapshotLoader
+from tests.test_snapshot import fresh_client, audit_sig
+
+client = fresh_client()
+outcome = SnapshotLoader(sys.argv[1]).restore(
+    client, InMemoryKube(), resync=False
+)
+sig, totals = audit_sig(client)
+print(json.dumps({
+    "outcome": outcome,
+    "templates": client.templates(),
+    "sig": sig,
+}))
+"""
+
+
+@pytest.fixture()
+def snap_dir(tmp_path):
+    return str(tmp_path / "snapshots")
+
+
+class TestConcurrentProcessRestore:
+    @spawn_available
+    def test_two_processes_restore_the_same_snapshot(self, snap_dir):
+        kube = build_cluster(n=10)
+        client = make_client(kube)
+        want_sig, _totals = audit_sig(client)
+        assert Snapshotter(client, snap_dir, interval_s=0.0,
+                           capture_delta=False).write_once() is not None
+
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-c", _RESTORE_CHILD, snap_dir],
+                cwd=REPO, env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True,
+            )
+            for _ in range(2)
+        ]
+        outs = []
+        for p in procs:
+            out, err = p.communicate(timeout=300)
+            assert p.returncode == 0, f"reader died:\n{err[-2000:]}"
+            outs.append(json.loads(out.strip().splitlines()[-1]))
+        for got in outs:
+            assert got["outcome"] == "restored"
+            assert got["templates"] == ["K8sRequiredLabels"]
+            # audit over the restored pack reproduces the writer's own
+            # results exactly (lists arrive as JSON lists; normalize)
+            assert [list(x) for x in got["sig"]] == [
+                list(x) for x in want_sig
+            ]
+
+
+class TestReaderWriterRace:
+    def test_reader_races_write_and_prune(self, snap_dir):
+        """A restore running WHILE a writer loops write_once + prune must
+        always land on a complete, verifiable snapshot (the atomic
+        tmp-dir rename is the only thing readers rely on)."""
+        kube = build_cluster(n=6)
+        client = make_client(kube)
+        audit_sig(client)
+        snapper = Snapshotter(client, snap_dir, retain=2,
+                              capture_delta=False)
+        assert snapper.write_once() is not None  # one always present
+
+        stop = threading.Event()
+        write_errors = []
+
+        def writer():
+            while not stop.is_set():
+                snapper._last_write = 0.0  # defeat cadence
+                try:
+                    snapper.write_once()
+                except Exception as e:  # pragma: no cover - the assert
+                    write_errors.append(repr(e))
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        try:
+            from gatekeeper_tpu.kube.inmem import InMemoryKube
+
+            for _ in range(6):
+                reader = fresh_client()
+                outcome = SnapshotLoader(snap_dir).restore(
+                    reader, InMemoryKube(), resync=False
+                )
+                assert outcome == "restored"
+                assert reader.templates() == ["K8sRequiredLabels"]
+        finally:
+            stop.set()
+            t.join(timeout=30)
+        assert not write_errors
+
+
+class TestCorruptEntryFallback:
+    def test_corrupt_newest_snapshot_does_not_poison_the_dir(
+        self, snap_dir,
+    ):
+        kube = build_cluster(n=6)
+        client = make_client(kube)
+        audit_sig(client)
+        snapper = Snapshotter(client, snap_dir, capture_delta=False)
+        first = snapper.write_once()
+        assert first is not None
+        snapper._last_write = 0.0
+        second = snapper.write_once()
+        assert second is not None and second != first
+
+        manifest = os.path.join(second, "MANIFEST.json")
+        with open(manifest, "w") as f:
+            f.write("{not json")
+        listing = sorted(os.listdir(snap_dir))
+
+        from gatekeeper_tpu.kube.inmem import InMemoryKube
+
+        for _ in range(2):  # the SECOND reader sees the same dir
+            reader = fresh_client()
+            outcome = SnapshotLoader(snap_dir).restore(
+                reader, InMemoryKube(), resync=False
+            )
+            # fell back to the older snapshot — still a warm restore
+            assert outcome == "restored"
+            assert reader.templates() == ["K8sRequiredLabels"]
+            # read-mostly: the reader deleted nothing, wrote nothing
+            assert sorted(os.listdir(snap_dir)) == listing
+
+
+class TestCrossProcessWriterLock:
+    def test_second_writer_is_refused_while_held(self, tmp_path):
+        root = str(tmp_path)
+        with _WriterLock(root):
+            with pytest.raises(SnapshotError):
+                _WriterLock(root).__enter__()
+        # released: the next writer proceeds
+        with _WriterLock(root):
+            pass
+
+    def test_held_lock_turns_write_once_into_a_skip(self, snap_dir):
+        kube = build_cluster(n=3)
+        client = make_client(kube)
+        audit_sig(client)
+        snapper = Snapshotter(client, snap_dir, capture_delta=False)
+        assert snapper.write_once() is not None
+        before = snapfmt.list_snapshots(snap_dir)
+        with _WriterLock(snap_dir):
+            snapper._last_write = 0.0
+            assert snapper.write_once() is None  # skip, not a crash
+            assert snapper.last_error is not None
+        assert snapfmt.list_snapshots(snap_dir) == before
+        # lock released: writing resumes
+        snapper._last_write = 0.0
+        assert snapper.write_once() is not None
+
+
+class TestAotCacheSharedDir:
+    @pytest.fixture(autouse=True)
+    def _restore_module_state(self):
+        from gatekeeper_tpu.ops import aotcache
+
+        old_dir, old_rm = aotcache._dir, aotcache._read_mostly
+        yield
+        aotcache._dir, aotcache._read_mostly = old_dir, old_rm
+
+    def _seed_entry(self, aotcache, d, key="k1"):
+        aotcache.enable(d, read_mostly=False)
+        path = os.path.join(d, key + ".aot")
+        with open(path, "wb") as f:
+            f.write(b"x" * 80)  # malformed: fails the seal check
+        return path
+
+    def test_read_mostly_reader_never_deletes_shared_entries(
+        self, tmp_path,
+    ):
+        from gatekeeper_tpu.ops import aotcache
+
+        d = str(tmp_path / "aot")
+        path = self._seed_entry(aotcache, d)
+        aotcache.enable(d, read_mostly=True)
+        assert aotcache.load("k1") is None  # treated as a miss...
+        assert os.path.exists(path)         # ...but never pruned
+
+    def test_owning_process_still_prunes_bad_entries(self, tmp_path):
+        from gatekeeper_tpu.ops import aotcache
+
+        d = str(tmp_path / "aot")
+        path = self._seed_entry(aotcache, d)
+        aotcache.enable(d, read_mostly=False)
+        assert aotcache.load("k1") is None
+        assert not os.path.exists(path)  # the audit role prunes
+
+    def test_env_var_selects_read_mostly(self, tmp_path, monkeypatch):
+        from gatekeeper_tpu.ops import aotcache
+
+        monkeypatch.setenv("GK_AOT_READ_MOSTLY", "1")
+        assert aotcache.enable(str(tmp_path / "aot"))
+        assert aotcache._read_mostly is True
+        monkeypatch.setenv("GK_AOT_READ_MOSTLY", "0")
+        assert aotcache.enable(str(tmp_path / "aot"))
+        assert aotcache._read_mostly is False
