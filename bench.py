@@ -2203,6 +2203,335 @@ def bench_fleet() -> dict:
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_chaos_fleet() -> dict:
+    """Self-healing fleet under chaos (ISSUE 8, recorded as CHAOS_r08):
+    two supervised replicas restore one sealed snapshot behind the front
+    door; seeded fault points crash one replica (`fleet.replica_crash`,
+    an error-mode rule pulsed in-child -> hard exit rc 23) and wedge the
+    other (`fleet.replica_wedge`, a hang-mode rule parking its command
+    pipe) MID-LOAD, while a sequential client streams parity-checked
+    admissions through the door.  Recorded:
+
+      - failed admissions (non-200 through the door) — the acceptance
+        criterion is ZERO: the door's immediate ejection + bounded
+        retry covers every kill window;
+      - verdict parity vs a fresh interpreter oracle before/during/
+        after each failure (allow/deny + rendered message bytes);
+      - per-failure recovery: eject->readmit wall seconds and the
+        supervisor's warm spawn-to-ready (< 5s criterion);
+      - a zero-failure rolling restart (drain stats included);
+      - mesh degradation (subprocess, virtual 4-device mesh): a stalled
+        collective trips the watchdog -> breaker -> width 4 -> 2, with
+        byte-parity preserved at the narrower width.
+    """
+    import re as _re
+    import shutil
+    import tempfile
+
+    from gatekeeper_tpu.fleet import FrontDoor, ReplicaSupervisor
+    from gatekeeper_tpu.fleet.replica import spawn_replica
+    from gatekeeper_tpu.snapshot import Snapshotter
+    from gatekeeper_tpu.util.synthetic import (
+        build_driver,
+        build_oracle,
+        make_pods,
+    )
+
+    n_templates = int(os.environ.get("BENCH_CHAOS_TEMPLATES", "2"))
+    n_resources = int(os.environ.get("BENCH_CHAOS_RESOURCES", "64"))
+    duration_s = float(os.environ.get("BENCH_CHAOS_DURATION_S", "25"))
+    crash_after = int(os.environ.get("BENCH_CHAOS_CRASH_AFTER", "80"))
+    wedge_after = int(os.environ.get("BENCH_CHAOS_WEDGE_AFTER", "40"))
+
+    root = tempfile.mkdtemp(prefix="gk-chaos-fleet-")
+    snap_dir = os.path.join(root, "snap")
+    cache_dir = os.path.join(root, "cache")
+    os.makedirs(snap_dir)
+    os.makedirs(cache_dir)
+
+    client = build_driver(n_templates, n_resources)
+    client.audit_capped(50)
+    assert Snapshotter(client, snap_dir, interval_s=0.0).write_once()
+
+    n_corpus = min(n_resources, 48)
+    pods = make_pods(n_corpus, seed=31, violation_rate=0.4)
+    reqs = []
+    for i, p in enumerate(pods):
+        reqs.append({
+            "uid": f"chaos-{i}",
+            "kind": {"group": "", "version": "v1", "kind": "Pod"},
+            "name": p["metadata"]["name"],
+            "namespace": p["metadata"]["namespace"],
+            "operation": "CREATE",
+            "userInfo": {"username": "chaos-bench"},
+            "object": p,
+        })
+    oracle = build_oracle(n_templates, n_resources)
+    oracle_verdicts = []
+    for req in reqs:
+        results = oracle.review(
+            {k: req[k] for k in
+             ("kind", "name", "namespace", "operation", "object")}
+        ).results()
+        oracle_verdicts.append((not results, sorted(r.msg for r in results)))
+
+    base_env = {"JAX_PLATFORMS": "cpu"}
+    # the seeded fault specs ride into each child via GK_CHAOS
+    # (faults.install_from_spec); restarts come back CLEAN — the
+    # supervisor respawns with its own env
+    crash_env = dict(base_env, GK_CHAOS=json.dumps({
+        "seed": 8, "rules": [{
+            "point": "fleet.replica_crash", "mode": "error",
+            "after": crash_after, "count": 1,
+        }],
+    }))
+    wedge_env = dict(base_env, GK_CHAOS=json.dumps({
+        "seed": 8, "rules": [{
+            "point": "fleet.replica_wedge", "mode": "hang",
+            "hang_s": 120.0, "after": wedge_after, "count": 1,
+        }],
+    }))
+
+    events = []  # (t, replica_id, "eject"|"readmit")
+    door_box = {}
+
+    def on_change(rid, backend):
+        d = door_box.get("door")
+        events.append((time.monotonic(), rid,
+                       "eject" if backend is None else "readmit"))
+        if d is None:
+            return
+        if backend is None:
+            d.suspend(rid)
+        else:
+            d.set_backend(rid, backend["host"], backend["port"])
+
+    sup = ReplicaSupervisor(
+        snapshot_dir=snap_dir, cache_dir=cache_dir, env=base_env,
+        heartbeat_s=0.25, miss_threshold=2, backoff_base_s=0.1,
+        on_backend_change=on_change,
+    )
+    door = None
+    try:
+        # chaos-armed initial spawns, adopted under supervision (the
+        # supervisor's own restarts use the clean env)
+        h_wedge = spawn_replica("r0", snap_dir, cache_dir, env=wedge_env)
+        h_crash = spawn_replica("r1", snap_dir, cache_dir, env=crash_env)
+        for h in (h_wedge, h_crash):
+            assert h.ready.get("restore_outcome") == "restored", h.ready
+            sup.adopt(h)
+        sup.start_monitor()
+        door = FrontDoor(
+            [h_wedge.backend(), h_crash.backend()], probe_interval_s=0.1
+        ).start()
+        door_box["door"] = door
+        log(f"chaos_fleet: r0(wedge@~{wedge_after} pings) "
+            f"r1(crash@~{crash_after} pulses) streaming {duration_s}s")
+
+        import http.client as _httpc
+
+        def post(body):
+            c = _httpc.HTTPConnection("127.0.0.1", door.port, timeout=30)
+            try:
+                c.request("POST", "/v1/admit", body=body,
+                          headers={"Content-Type": "application/json"})
+                r = c.getresponse()
+                return r.status, r.read()
+            finally:
+                c.close()
+
+        total = failed = divergences = 0
+        t_start = time.monotonic()
+        i = 0
+        while time.monotonic() - t_start < duration_s:
+            req = reqs[i % len(reqs)]
+            body = json.dumps({"request": req}).encode()
+            try:
+                st, data = post(body)
+            except Exception:
+                st, data = 0, b""
+            total += 1
+            if st != 200:
+                failed += 1
+            else:
+                out = json.loads(data)["response"]
+                allowed = out["allowed"]
+                msgs = sorted(
+                    _re.sub(r"^\[denied by [^\]]+\] ", "", m)
+                    for m in (out.get("status") or {}).get(
+                        "message", "").split("\n") if m
+                ) if not allowed else []
+                o_allowed, o_msgs = oracle_verdicts[i % len(reqs)]
+                if allowed != o_allowed or (
+                    not allowed and msgs != o_msgs
+                ):
+                    divergences += 1
+            i += 1
+            time.sleep(0.002)  # pace: the stream must span both faults
+
+        # both chaos victims must have been restarted warm by now
+        recovery = {}
+        for rid in ("r0", "r1"):
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                st = sup.status()[rid]
+                if st["state"] == "running" and st["restarts"] >= 1:
+                    break
+                time.sleep(0.1)
+            st = sup.status()[rid]
+            ejects = [t for t, r, k in events if r == rid and k == "eject"]
+            readmits = [t for t, r, k in events
+                        if r == rid and k == "readmit" and t > (
+                            ejects[0] if ejects else 0)]
+            recovery[rid] = {
+                "state": st["state"],
+                "restarts": st["restarts"],
+                "last_exit_rc": st["last_exit_rc"],
+                "spawn_to_ready_s": st["last_restart_s"],
+                "eject_to_readmit_s": round(
+                    readmits[0] - ejects[0], 3
+                ) if ejects and readmits else None,
+            }
+        new_handles = {h.replica_id: h for h in sup.handles()}
+        restore_outcomes = {
+            rid: h.ready.get("restore_outcome")
+            for rid, h in new_handles.items()
+        }
+
+        # zero-failure rolling restart with drain stats (the upgrade path)
+        rolled = sup.rolling_restart(drain_deadline_ms=500.0)
+        roll_ok = all(r.get("ok") for r in rolled.values())
+
+        stats = door.stats()
+        log(f"chaos_fleet: {total} reqs, {failed} failed, "
+            f"{divergences} divergences, recovery={recovery}, "
+            f"door retries={stats['retries']}")
+
+        mesh = _chaos_mesh_stall()
+        log(f"chaos_fleet: mesh stall {mesh}")
+
+        ok = (
+            failed == 0 and divergences == 0
+            and all(r["state"] == "running" and r["restarts"] >= 1
+                    for r in recovery.values())
+            and all((r["spawn_to_ready_s"] or 99) < 5.0
+                    for r in recovery.values())
+            and all(v == "restored" for v in restore_outcomes.values())
+            and mesh.get("parity_during") and mesh.get("parity_after")
+            and mesh.get("width_after") == 2
+        )
+        out = {
+            "metric": (
+                "chaos fleet: failed admissions with one replica crashed "
+                "+ one wedged mid-load (2 supervised replicas)"
+            ),
+            "value": float(failed),
+            "unit": "failed_admissions",
+            "vs_baseline": 0,
+            "chaos_ok": ok,
+            "chaos_requests": total,
+            "chaos_failed_admissions": failed,
+            "chaos_verdict_divergences": divergences,
+            "chaos_recovery": recovery,
+            "chaos_restore_outcomes": restore_outcomes,
+            "chaos_rolling_restart": {
+                rid: {"ok": r.get("ok"),
+                      "drain_ms": (r.get("drain") or {}).get("drain_ms"),
+                      "drained": (r.get("drain") or {}).get("drained"),
+                      "restart_s": r.get("restart_s")}
+                for rid, r in rolled.items()
+            },
+            "chaos_rolling_ok": roll_ok,
+            "chaos_frontdoor": stats,
+            "chaos_mesh_stall": mesh,
+            "chaos_config": {
+                "templates": n_templates, "resources": n_resources,
+                "duration_s": duration_s, "crash_after": crash_after,
+                "wedge_after": wedge_after,
+            },
+        }
+        record = {k: v for k, v in out.items()
+                  if k not in ("metric", "value", "unit", "vs_baseline")}
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "CHAOS_r08.json")
+        with open(path, "w") as f:
+            json.dump(record, f, indent=2, sort_keys=True)
+            f.write("\n")
+        log(f"chaos_fleet recorded: {path}")
+        return out
+    finally:
+        if door is not None:
+            door.stop()
+        sup.stop()
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def _chaos_mesh_stall() -> dict:
+    """Mesh-degradation leg of the chaos bench (subprocess on a virtual
+    4-device CPU mesh, like mesh_curve): a seeded `mesh.dispatch_stall`
+    hang wedges the sharded sweep's collective; the watchdog abandons
+    it, the breaker serves interpreter-parity verdicts, the sweep
+    re-shards 4 -> 2, and the rebased width-2 sweep stays byte-parity
+    with the interpreter oracle."""
+    import subprocess
+
+    code = r"""
+import json, sys, time
+sys.path.insert(0, ".")
+from gatekeeper_tpu import faults
+from gatekeeper_tpu.faults import FaultRule
+from gatekeeper_tpu.parallel.mesh import DISPATCH_LOCK
+from gatekeeper_tpu.util.synthetic import (
+    audit_result_sig as sig, build_driver, build_oracle,
+)
+
+N_T, N_R, CAP = 8, 512, 4096
+oracle = build_oracle(N_T, N_R)
+oracle_r, oracle_t, _ = oracle.driver.audit_capped(CAP)
+want = (sig(oracle_r), oracle_t)
+
+client = build_driver(N_T, N_R)
+drv = client.driver
+drv.mesh_watchdog_s = 0.5
+drv.set_mesh(True, width=4)
+
+plane = faults.install(seed=8)
+plane.add("mesh.dispatch_stall",
+          FaultRule(mode="hang", hang_s=30.0, count=1))
+got_r, got_t, _ = drv.audit_capped(CAP)
+parity_during = (sig(got_r), got_t) == want
+breaker_state = drv.breaker.state
+width_after = drv.mesh_layout()
+stalls = DISPATCH_LOCK.revocations
+
+plane.release_hangs()
+time.sleep(0.5)          # the abandoned dispatch finishes alone
+plane.clear("mesh.dispatch_stall")
+drv.mesh_watchdog_s = 120.0   # the width-2 rebase compiles in-region
+probe_ok = drv.breaker.probe_now()
+got_r, got_t, _ = drv.audit_capped(CAP)
+parity_after = (sig(got_r), got_t) == want
+stats = dict(drv.last_sweep_stats)
+faults.uninstall()
+print(json.dumps({
+    "parity_during": parity_during, "parity_after": parity_after,
+    "breaker_during": breaker_state, "probe_recovered": probe_ok,
+    "width_before": 4, "width_after": width_after,
+    "gate_revocations": stalls,
+    "rebase_shards": stats.get("shards"),
+}))
+"""
+    from gatekeeper_tpu.parallel.mesh import virtual_mesh_env
+
+    env = virtual_mesh_env(4)
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=900)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"chaos mesh subprocess failed: {proc.stderr[-2000:]}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
 CONFIGS = {
     "synthetic": bench_synthetic,
     "latency": bench_latency,
@@ -2219,6 +2548,7 @@ CONFIGS = {
     "mesh_curve": bench_mesh_curve,
     "multihost": bench_multihost,
     "fleet": bench_fleet,
+    "chaos_fleet": bench_chaos_fleet,
 }
 
 # secondary configs folded into the default run, with the extra-key name
@@ -2240,6 +2570,7 @@ _FOLDED = [
     ("mesh_curve", "mesh_curve_parity"),
     ("multihost", "multihost_sweep_s"),
     ("fleet", "fleet_reviews_per_s"),
+    ("chaos_fleet", "chaos_failed_admissions"),
 ]
 
 

@@ -29,10 +29,21 @@ WEBHOOK_ENQUEUE = "webhook.enqueue"   # webhook/server.py batch queue
 SNAPSHOT_WRITE = "snapshot.write"     # snapshot/writer.py persist path
 SNAPSHOT_LOAD = "snapshot.load"       # snapshot/loader.py validate+restore
 SNAPSHOT_RESYNC = "snapshot.resync"   # snapshot/loader.py kube delta resync
+SNAPSHOT_CORRUPT = "snapshot.corrupt"  # snapshot/loader.py post-seal payload
+#                                       validation (error -> quarantine)
+# fleet self-healing seams (fleet/replica.py child runtime; the
+# supervisor's chaos drives these through the GK_CHAOS child spec)
+REPLICA_CRASH = "fleet.replica_crash"  # replica chaos pulse: error = the
+#                                        child hard-exits (rc 23)
+REPLICA_WEDGE = "fleet.replica_wedge"  # replica command loop: hang = the
+#                                        child stops answering its pipe
+MESH_DISPATCH_STALL = "mesh.dispatch_stall"  # ops/driver.py mesh-collective
+#                                        enqueue (hang = stuck rendezvous)
 
 ALL_POINTS = (
     KUBE_SEND, KUBE_RECV, WATCH_DELIVER, TPU_COMPILE, TPU_DISPATCH,
     WEBHOOK_ENQUEUE, SNAPSHOT_WRITE, SNAPSHOT_LOAD, SNAPSHOT_RESYNC,
+    SNAPSHOT_CORRUPT, REPLICA_CRASH, REPLICA_WEDGE, MESH_DISPATCH_STALL,
 )
 
 # ---- the process-global plane ----------------------------------------------
@@ -73,6 +84,24 @@ def fire(point: str, **ctx):
         p.fire(point, **ctx)
 
 
+def install_from_spec(spec: dict) -> FaultPlane:
+    """Enable injection from a JSON-able spec — the cross-process chaos
+    channel (a parent puts the spec in the GK_CHAOS env var; the fleet
+    replica runtime installs it at entry)::
+
+        {"seed": 7, "rules": [{"point": "fleet.replica_crash",
+                               "mode": "error", "after": 20, "count": 1}]}
+
+    Rule fields map 1:1 onto FaultRule; unknown fields are rejected by
+    the dataclass so a typo'd spec fails loudly at install time."""
+    plane = install(seed=int(spec.get("seed", 0)))
+    for r in spec.get("rules", ()):
+        r = dict(r)
+        point = r.pop("point")
+        plane.add(point, FaultRule(**r))
+    return plane
+
+
 __all__ = [
     "ALL_POINTS",
     "ENABLED",
@@ -84,6 +113,10 @@ __all__ = [
     "KUBE_RECV",
     "KUBE_SEND",
     "LATENCY",
+    "MESH_DISPATCH_STALL",
+    "REPLICA_CRASH",
+    "REPLICA_WEDGE",
+    "SNAPSHOT_CORRUPT",
     "SNAPSHOT_LOAD",
     "SNAPSHOT_RESYNC",
     "SNAPSHOT_WRITE",
@@ -94,5 +127,6 @@ __all__ = [
     "fire",
     "get_plane",
     "install",
+    "install_from_spec",
     "uninstall",
 ]

@@ -15,9 +15,14 @@ Pieces:
   parent-side spawn/ready/stop helpers used by ``bench.py fleet`` and
   ``tools/check_fleet_parity.py``);
 - :mod:`frontdoor` — a stdlib HTTP front door (round-robin or
-  least-inflight) for benching and parity checks; production fleets
-  use a Service/LB, this one exists so the repo can DRIVE and PROVE
-  the topology end to end.
+  least-inflight, with health-based ejection, probing readmission and
+  a bounded single retry) for benching and parity checks; production
+  fleets use a Service/LB, this one exists so the repo can DRIVE and
+  PROVE the topology end to end;
+- :mod:`supervisor` — replica supervision (exit/wedge detection, warm
+  restarts with capped backoff, flap quarantine, graceful drain and
+  zero-failed-admission rolling restarts; ISSUE 8,
+  docs/failure-modes.md fleet failure matrix).
 
 Trust model: replicas share the snapshot + AOT directories read-mostly
 (atomic-rename snapshots, flock-serialized writers, sealed entries
@@ -29,5 +34,12 @@ payload.
 
 from .frontdoor import FrontDoor
 from .replica import ReplicaHandle, spawn_replica, spawn_fleet
+from .supervisor import ReplicaSupervisor
 
-__all__ = ["FrontDoor", "ReplicaHandle", "spawn_replica", "spawn_fleet"]
+__all__ = [
+    "FrontDoor",
+    "ReplicaHandle",
+    "ReplicaSupervisor",
+    "spawn_replica",
+    "spawn_fleet",
+]

@@ -2,9 +2,9 @@
 
 Production fleets sit behind a Kubernetes Service/LB; this front door
 exists so the repo can drive and prove the fleet topology end to end
-(bench.py fleet, tools/check_fleet_parity.py) with nothing but the
-standard library.  It forwards POST bodies (admission reviews) to one
-of N backends, chosen by
+(bench.py fleet/chaos_fleet, tools/check_fleet_parity.py,
+tools/check_self_heal.py) with nothing but the standard library.  It
+forwards POST bodies (admission reviews) to one of N backends, chosen by
 
 - ``round_robin`` — strict rotation, or
 - ``least_inflight`` (default) — the backend with the fewest requests
@@ -12,11 +12,30 @@ of N backends, chosen by
   request costs this tracks per-replica service speed without any
   backend-side signal.
 
-Per-thread persistent connections to each backend (the apiserver's
-webhook client behaves the same way); a backend that fails to answer is
-marked, its connection dropped, and the request retried once on the
-next choice so a dead replica degrades capacity rather than failing
-admissions.  Per-backend served/error/inflight counters are exposed on
+Resilience (docs/failure-modes.md fleet failure matrix):
+
+- **bounded single retry** — a request whose backend fails at the
+  connection level (refused, reset, died mid-response) is retried
+  exactly once, onto a *different* live backend; a second failure is an
+  explicit 502 (the apiserver's failurePolicy decides — never a
+  fabricated verdict, never an unbounded retry storm).
+- **health-based ejection** — a connection-REFUSED backend (nothing
+  listening: the replica is dead) is ejected immediately; other
+  failures eject after ``EJECT_ERROR_STREAK`` consecutive errors.
+  Ejected backends take no traffic.
+- **probing readmission** — a background prober GETs each ejected
+  backend's ``/readyz`` on a short cadence and readmits on the first
+  success, so a restarted replica rejoins without operator action.
+  ``/readyz`` (not ``/healthz``): a DRAINING replica keeps ``/healthz``
+  at 200 by design but reports ``/readyz`` 503 — probing liveness would
+  readmit a suspended backend mid-drain and route admissions into its
+  503s.
+- **backend swap** — ``set_backend(replica_id, host, port)`` re-points
+  a named backend (the supervisor calls it after restarting a replica
+  on a fresh ephemeral port) and readmits it; ``suspend(replica_id)``
+  ejects administratively (the drain step of a rolling restart).
+
+Per-backend served/error/inflight/ejected counters are exposed on
 ``/fleetz`` and via :meth:`FrontDoor.stats`.
 """
 
@@ -26,6 +45,7 @@ import http.client
 import itertools
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional, Sequence, Tuple
 
@@ -44,7 +64,8 @@ _FORWARD_HEADERS = ("Content-Type", "traceparent")
 
 class Backend:
     __slots__ = ("host", "port", "replica_id", "inflight", "served",
-                 "errors", "consecutive_errors", "lock")
+                 "errors", "consecutive_errors", "ejected", "ejected_at",
+                 "readmissions", "lock")
 
     def __init__(self, host: str, port: int, replica_id: str = ""):
         self.host = host
@@ -54,6 +75,9 @@ class Backend:
         self.served = 0
         self.errors = 0
         self.consecutive_errors = 0
+        self.ejected = False
+        self.ejected_at = 0.0
+        self.readmissions = 0
         self.lock = threading.Lock()
 
 
@@ -61,13 +85,26 @@ class FrontDoor:
     # /healthz counts a backend live until it fails this many requests
     # in a row with no success in between
     LIVE_ERROR_STREAK = 3
+    # non-refused failures eject after this many consecutive errors
+    # (refused connections eject immediately: nothing is listening)
+    EJECT_ERROR_STREAK = 3
+    # readmission probe cadence for ejected backends
+    PROBE_INTERVAL_S = 0.25
+    PROBE_TIMEOUT_S = 2.0
+    # bounded retry: one extra attempt on a DIFFERENT backend per request
+    RETRY_LIMIT = 1
 
     def __init__(self, backends: Sequence[Tuple[str, int]] | Sequence[dict],
-                 port: int = 0, policy: str = LEAST_INFLIGHT):
+                 port: int = 0, policy: str = LEAST_INFLIGHT,
+                 probe_interval_s: Optional[float] = None):
         if policy not in (ROUND_ROBIN, LEAST_INFLIGHT):
             raise ValueError(f"unknown front-door policy: {policy!r}")
         self.policy = policy
         self.port = port
+        self.probe_interval_s = (
+            probe_interval_s if probe_interval_s is not None
+            else self.PROBE_INTERVAL_S
+        )
         self.backends: List[Backend] = []
         for b in backends:
             if isinstance(b, dict):
@@ -84,14 +121,28 @@ class FrontDoor:
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._local = threading.local()  # per-thread backend connections
+        self._mu = threading.Lock()      # guards backend list mutation
+        self._prober: Optional[threading.Thread] = None
+        self._prober_stop = threading.Event()
+        self.retries = 0                 # requests salvaged by the retry
 
     # ---- choice ----------------------------------------------------------
 
     def _choose(self, exclude: Optional[set] = None) -> Optional[Backend]:
+        with self._mu:
+            candidates = list(self.backends)
         live = [
-            (i, b) for i, b in enumerate(self.backends)
-            if not exclude or i not in exclude
+            (i, b) for i, b in enumerate(candidates)
+            if (not exclude or i not in exclude) and not b.ejected
         ]
+        if not live:
+            # every non-excluded backend is ejected: try one anyway
+            # (fail-static) rather than 502ing while a backend may have
+            # just come back — its success readmits it on the spot
+            live = [
+                (i, b) for i, b in enumerate(candidates)
+                if not exclude or i not in exclude
+            ]
         if not live:
             return None
         start = next(self._rr) % len(live)
@@ -100,6 +151,83 @@ class FrontDoor:
         # least inflight, rotation as tiebreak so equal backends share
         rotated = live[start:] + live[:start]
         return min(rotated, key=lambda ib: ib[1].inflight)[1]
+
+    # ---- ejection / readmission ------------------------------------------
+
+    def _eject(self, backend: Backend, why: str):
+        with backend.lock:
+            if backend.ejected:
+                return
+            backend.ejected = True
+            backend.ejected_at = time.monotonic()
+        log.warning("backend %s ejected (%s); probing for readmission",
+                    backend.replica_id, why)
+
+    def _readmit(self, backend: Backend, why: str):
+        with backend.lock:
+            if not backend.ejected:
+                return
+            backend.ejected = False
+            backend.consecutive_errors = 0
+            backend.readmissions += 1
+        log.info("backend %s readmitted (%s)", backend.replica_id, why)
+
+    def suspend(self, replica_id: str) -> bool:
+        """Administrative ejection (the supervisor's drain/restart step):
+        the backend takes no NEW traffic until set_backend or a probe
+        readmits it.  The prober keeps running, so a suspend that was
+        never followed by a swap self-heals once the replica answers."""
+        b = self._find(replica_id)
+        if b is None:
+            return False
+        self._eject(b, "suspended")
+        return True
+
+    def set_backend(self, replica_id: str, host: str, port: int) -> bool:
+        """Re-point a named backend (a supervised replica restarted on a
+        fresh ephemeral port) and readmit it.  Per-thread connections to
+        the old port die on their next use and re-establish against the
+        new one (the error path drops them)."""
+        b = self._find(replica_id)
+        if b is None:
+            return False
+        with self._mu, b.lock:
+            b.host = host
+            b.port = int(port)
+            b.ejected = False
+            b.consecutive_errors = 0
+        log.info("backend %s re-pointed to %s:%d", replica_id, host, port)
+        return True
+
+    def _find(self, replica_id: str) -> Optional[Backend]:
+        with self._mu:
+            for b in self.backends:
+                if b.replica_id == replica_id:
+                    return b
+        return None
+
+    def _probe_loop(self):
+        """Readmission prober: one /readyz GET per ejected backend per
+        interval; the first success readmits.  Readiness, not liveness:
+        a draining (or warming) replica answers /healthz 200 but /readyz
+        503, and readmitting it would route admissions into its 503s.
+        Daemon, stopped by stop()."""
+        while not self._prober_stop.wait(self.probe_interval_s):
+            with self._mu:
+                ejected = [b for b in self.backends if b.ejected]
+            for b in ejected:
+                try:
+                    conn = http.client.HTTPConnection(
+                        b.host, b.port, timeout=self.PROBE_TIMEOUT_S
+                    )
+                    conn.request("GET", "/readyz")
+                    resp = conn.getresponse()
+                    resp.read()
+                    conn.close()
+                    if resp.status == 200:
+                        self._readmit(b, "readiness probe succeeded")
+                except Exception:
+                    pass  # still down; next interval probes again
 
     # ---- forwarding ------------------------------------------------------
 
@@ -128,16 +256,21 @@ class FrontDoor:
 
     def forward(self, method: str, path: str, body: bytes,
                 headers: dict) -> Tuple[int, dict, bytes, str]:
-        """-> (status, response_headers, body, replica_id).  Tries up to
-        len(backends) distinct backends; raises ConnectionError when all
-        fail (the caller answers 502 — never a silent allow)."""
+        """-> (status, response_headers, body, replica_id).  One attempt
+        plus at most RETRY_LIMIT retries, each on a DIFFERENT backend;
+        raises ConnectionError when they all fail (the caller answers
+        502 — never a silent allow)."""
         tried: set = set()
         last_exc: Optional[Exception] = None
-        for _ in range(len(self.backends)):
+        for attempt in range(1 + self.RETRY_LIMIT):
             backend = self._choose(exclude=tried)
             if backend is None:
                 break
-            idx = self.backends.index(backend)
+            with self._mu:
+                try:
+                    idx = self.backends.index(backend)
+                except ValueError:
+                    continue  # raced a backend-list mutation; re-choose
             tried.add(idx)
             with backend.lock:
                 backend.inflight += 1
@@ -150,6 +283,13 @@ class FrontDoor:
                     backend.inflight -= 1
                     backend.served += 1
                     backend.consecutive_errors = 0
+                if backend.ejected and resp.status != 503:
+                    # the fail-static path above proved it live again
+                    # (a 503 is a draining/not-ready replica answering
+                    # honestly — it must NOT re-enter rotation)
+                    self._readmit(backend, "served while ejected")
+                if attempt > 0:
+                    self.retries += 1
                 return resp.status, dict(resp.getheaders()), data, \
                     backend.replica_id
             except Exception as e:
@@ -159,8 +299,19 @@ class FrontDoor:
                     backend.inflight -= 1
                     backend.errors += 1
                     backend.consecutive_errors += 1
-                log.warning("backend %s failed (%s: %s); trying next",
-                            backend.replica_id, type(e).__name__, e)
+                    streak = backend.consecutive_errors
+                if isinstance(e, ConnectionRefusedError):
+                    # nothing listening: the replica is DEAD, not slow —
+                    # eject now, don't tax the next streak's requests
+                    self._eject(backend, "connection refused")
+                elif streak >= self.EJECT_ERROR_STREAK:
+                    self._eject(backend, f"{streak} consecutive errors")
+                log.warning(
+                    "backend %s failed (%s: %s); %s", backend.replica_id,
+                    type(e).__name__, e,
+                    "retrying on a different backend"
+                    if attempt < self.RETRY_LIMIT else "retry budget spent",
+                )
         raise ConnectionError(
             f"no fleet backend answered: {last_exc!r}"
         )
@@ -170,6 +321,7 @@ class FrontDoor:
     def stats(self) -> dict:
         return {
             "policy": self.policy,
+            "retries": self.retries,
             "backends": [
                 {
                     "replica_id": b.replica_id,
@@ -178,6 +330,8 @@ class FrontDoor:
                     "served": b.served,
                     "errors": b.errors,
                     "consecutive_errors": b.consecutive_errors,
+                    "ejected": b.ejected,
+                    "readmissions": b.readmissions,
                 }
                 for b in self.backends
             ],
@@ -214,11 +368,12 @@ class FrontDoor:
                 if self.path == "/healthz":
                     # liveness must be RECENT: a backend that once
                     # served but now fails every request is dead, so
-                    # the predicate is the current error streak, not a
-                    # sticky served counter
+                    # the predicate is ejection + the current error
+                    # streak, not a sticky served counter
                     live = sum(
                         1 for b in outer.backends
-                        if b.consecutive_errors < outer.LIVE_ERROR_STREAK
+                        if not b.ejected
+                        and b.consecutive_errors < outer.LIVE_ERROR_STREAK
                     )
                     self._send(200 if live else 503, "text/plain",
                                b"ok" if live else b"no backends")
@@ -258,9 +413,18 @@ class FrontDoor:
             target=self._server.serve_forever, name="frontdoor", daemon=True
         )
         self._thread.start()
+        self._prober_stop.clear()
+        self._prober = threading.Thread(
+            target=self._probe_loop, name="frontdoor-probe", daemon=True
+        )
+        self._prober.start()
         return self
 
     def stop(self):
+        self._prober_stop.set()
+        if self._prober is not None:
+            self._prober.join(timeout=5.0)
+            self._prober = None
         if self._server is not None:
             self._server.shutdown()
             self._server.server_close()

@@ -15,6 +15,20 @@ JSON line on stdout::
 and serves until stdin closes (the parent dropping its pipe is the stop
 signal — no PID files, no signal races) or SIGTERM.
 
+Self-healing additions (ISSUE 8, docs/failure-modes.md fleet matrix):
+the command loop answers ``{"cmd": "ping"}`` (the supervisor's
+command-pipe liveness heartbeat) and ``{"cmd": "drain", "deadline_ms"}``
+(graceful drain: stop accepting admissions, flush the micro-batcher
+within the budget, report ``drained``).  Commands carrying an ``"id"``
+get it echoed as ``"reply_to"`` so the parent can demux concurrent
+waiters (a supervisor heartbeat must not steal a bench stream's reply).
+A ``GK_CHAOS`` env var (JSON ``faults.install_from_spec`` spec) installs
+a seeded fault plane at entry; the ``fleet.replica_crash`` point is
+pulsed on a background thread (an error-mode rule hard-exits the child,
+rc 23) and ``fleet.replica_wedge`` fires in the command loop (a
+hang-mode rule stops the pipe answering — exactly what a wedged replica
+looks like to the supervisor).
+
 ``ready_s`` is measured in-process from runtime entry to the first
 admission answered end to end over HTTP — the "warm replica is
 device-ready in seconds" number the fleet bench records; the parent
@@ -30,9 +44,11 @@ fleet`` and ``tools/check_fleet_parity.py``.
 from __future__ import annotations
 
 import argparse
+import itertools
 import json
 import os
 import queue
+import signal
 import subprocess
 import sys
 import threading
@@ -198,9 +214,70 @@ def _stream_bench(app, n: int, chunk: int, replica_id: str) -> Dict:
     }
 
 
+CRASH_EXIT_CODE = 23  # a chaos-injected hard exit, distinguishable from 0/1
+_CHAOS_PULSE_S = 0.05  # fleet.replica_crash evaluation cadence
+
+
+def _install_chaos() -> None:
+    """Install the seeded fault plane from the GK_CHAOS env spec (set by
+    the supervisor / chaos bench) and start the crash pulse: the
+    `fleet.replica_crash` point is evaluated every pulse, so an
+    error-mode rule with `after=N` hard-exits the child ~N*pulse seconds
+    in — mid-load, deterministically in arrival count."""
+    spec = os.environ.get("GK_CHAOS", "")
+    if not spec:
+        return
+    from .. import faults
+
+    faults.install_from_spec(json.loads(spec))
+
+    def pulse():
+        from .. import faults as _f
+
+        while True:
+            time.sleep(_CHAOS_PULSE_S)
+            try:
+                if _f.ENABLED:
+                    _f.fire(_f.REPLICA_CRASH)
+            except Exception:
+                sys.stderr.write("chaos: replica crash injected\n")
+                sys.stderr.flush()
+                os._exit(CRASH_EXIT_CODE)
+
+    threading.Thread(target=pulse, name="gk-chaos-pulse",
+                     daemon=True).start()
+
+
+def _reply(cmd: dict, payload: dict) -> None:
+    """One JSON reply line, correlated to its command when the parent
+    tagged it (ReplicaHandle.command always does)."""
+    if isinstance(cmd, dict) and "id" in cmd:
+        payload = {**payload, "reply_to": cmd["id"]}
+    print(json.dumps(payload), flush=True)
+
+
+def _handle_drain(app, cmd: dict, replica_id: str) -> dict:
+    """Graceful drain (docs/fleet.md): stop accepting NEW admissions
+    (503 on POST, /readyz not-ready), then flush everything already in
+    the micro-batcher within the deadline budget.  In-flight requests
+    keep their own admission deadline budgets — the drain budget bounds
+    the flush wait, never extends any request."""
+    deadline_s = float(cmd.get("deadline_ms", 1000.0)) / 1e3
+    app.webhook_server.drain()
+    mb = app.micro_batcher
+    if mb is not None and hasattr(mb, "drain"):
+        stats = mb.drain(deadline_s)
+    else:
+        stats = {"pending_start": 0, "drained": True, "overran": False,
+                 "drain_ms": 0.0}
+    return {"event": "drained", "replica_id": replica_id,
+            "deadline_ms": round(deadline_s * 1e3, 3), **stats}
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     t0 = time.monotonic()
     args = _child_parser().parse_args(argv)
+    _install_chaos()
     from ..kube.inmem import InMemoryKube
     from ..main import App, build_parser
 
@@ -251,8 +328,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         # Lines on stdin are JSON commands (bench.py fleet drives the
         # in-process throughput stream this way); unknown lines are
         # ignored so a plain `echo | replica` still just serves.
+        from .. import faults as _faults
+
         try:
             for line in sys.stdin:
+                if _faults.ENABLED:
+                    try:
+                        # hang-mode rules wedge the command loop HERE: the
+                        # pipe stops answering while the HTTP side keeps
+                        # serving — the supervisor's command-pipe liveness
+                        # is what must catch it
+                        _faults.fire(_faults.REPLICA_WEDGE)
+                    except Exception:
+                        pass  # error-mode rules: drop this command only
                 line = line.strip()
                 if not line:
                     continue
@@ -260,13 +348,22 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     cmd = json.loads(line)
                 except ValueError:
                     continue
-                if isinstance(cmd, dict) and cmd.get("cmd") == "stream":
-                    print(json.dumps(_stream_bench(
+                if not isinstance(cmd, dict):
+                    continue
+                op = cmd.get("cmd")
+                if op == "stream":
+                    _reply(cmd, _stream_bench(
                         app,
                         n=int(cmd.get("n", 100_000)),
                         chunk=int(cmd.get("chunk", 8192)),
                         replica_id=args.replica_id,
-                    )), flush=True)
+                    ))
+                elif op == "ping":
+                    _reply(cmd, {"event": "pong",
+                                 "replica_id": args.replica_id,
+                                 "draining": app.webhook_server._draining})
+                elif op == "drain":
+                    _reply(cmd, _handle_drain(app, cmd, args.replica_id))
         except (KeyboardInterrupt, ValueError):
             pass
         return 0
@@ -297,22 +394,59 @@ def _spawn_proc(replica_id: str, snapshot_dir: str, cache_dir: str,
         cmd, cwd=REPO_ROOT, env=child_env,
         stdin=subprocess.PIPE, stdout=subprocess.PIPE,
         stderr=subprocess.PIPE, text=True,
+        # own process group (session): the supervisor's SIGTERM/atexit
+        # cleanup kills the GROUP, so a replica's own children (jax
+        # compile helpers, profilers) can never outlive a dead parent
+        start_new_session=True,
     )
 
 
-def _attach_pipes(proc: subprocess.Popen, replica_id: str):
+class _Pipes:
+    """Shared state between a replica's pipe reader threads and every
+    parent-side waiter: the general message queue (ready lines and other
+    uncorrelated output), a per-command-id reply demux, and the bounded
+    stderr tail."""
+
+    def __init__(self):
+        self.msgs: queue.Queue = queue.Queue()
+        self.stderr_tail: deque = deque(maxlen=400)
+        self.waiters: Dict[str, queue.Queue] = {}
+        self.waiters_lock = threading.Lock()
+
+    def route(self, msg: dict):
+        rt = msg.get("reply_to")
+        if rt is not None:
+            with self.waiters_lock:
+                q = self.waiters.get(rt)
+            if q is not None:
+                q.put(msg)
+                return
+        self.msgs.put(msg)
+
+    def eof(self):
+        """Child stdout closed: every current AND future waiter must see
+        it — command() re-checks liveness, so no waiter parks forever."""
+        self.msgs.put(_EOF)
+        with self.waiters_lock:
+            for q in self.waiters.values():
+                q.put(_EOF)
+
+
+def _attach_pipes(proc: subprocess.Popen, replica_id: str) -> _Pipes:
     """Reader threads own BOTH child pipes from the moment of spawn:
 
     - stdout: parsed JSON dicts land on a queue the parent reads with a
       real timeout — a bare ``readline()`` would block past any deadline
       on a wedged child, and mixing ``select()`` with buffered readline
-      misses replies already sitting in the text-wrapper buffer;
+      misses replies already sitting in the text-wrapper buffer.
+      Replies carrying ``reply_to`` route to that command's registered
+      waiter, so concurrent command() calls (a supervisor heartbeat
+      racing a bench stream) never steal each other's replies;
     - stderr: drained continuously into a bounded tail — a chatty child
       (WARNING logs under co-tenant load) would otherwise fill the 64KB
       pipe and deadlock mid-command; the tail feeds error messages.
     """
-    msgs: queue.Queue = queue.Queue()
-    stderr_tail: deque = deque(maxlen=400)
+    pipes = _Pipes()
 
     def _read_stdout():
         try:
@@ -322,15 +456,15 @@ def _attach_pipes(proc: subprocess.Popen, replica_id: str):
                 except ValueError:
                     continue  # stray log line on stdout
                 if isinstance(msg, dict):
-                    msgs.put(msg)
+                    pipes.route(msg)
         except Exception:
             pass
-        msgs.put(_EOF)
+        pipes.eof()
 
     def _read_stderr():
         try:
             for line in proc.stderr:
-                stderr_tail.append(line)
+                pipes.stderr_tail.append(line)
         except Exception:
             pass
 
@@ -338,15 +472,14 @@ def _attach_pipes(proc: subprocess.Popen, replica_id: str):
         threading.Thread(
             target=target, name=f"replica-{replica_id}-{name}", daemon=True,
         ).start()
-    return msgs, stderr_tail
+    return pipes
 
 
 def _stderr_str(stderr_tail: deque) -> str:
     return "".join(stderr_tail)[-2000:]
 
 
-def _wait_ready(proc: subprocess.Popen, replica_id: str,
-                msgs: queue.Queue, stderr_tail: deque,
+def _wait_ready(proc: subprocess.Popen, replica_id: str, pipes: _Pipes,
                 t0: float, timeout_s: float) -> Dict:
     """Block until the child's ready line; on timeout KILL the child so
     a wedged spawn never leaks, on early exit report rc + stderr tail."""
@@ -358,17 +491,17 @@ def _wait_ready(proc: subprocess.Popen, replica_id: str,
             proc.wait(timeout=10)
             raise TimeoutError(
                 f"replica {replica_id} never announced ready; stderr "
-                f"tail:\n{_stderr_str(stderr_tail)}"
+                f"tail:\n{_stderr_str(pipes.stderr_tail)}"
             )
         try:
-            msg = msgs.get(timeout=min(remaining, 1.0))
+            msg = pipes.msgs.get(timeout=min(remaining, 1.0))
         except queue.Empty:
             continue
         if msg is _EOF:
             proc.wait(timeout=10)
             raise RuntimeError(
                 f"replica {replica_id} exited rc={proc.returncode} before "
-                f"ready; stderr tail:\n{_stderr_str(stderr_tail)}"
+                f"ready; stderr tail:\n{_stderr_str(pipes.stderr_tail)}"
             )
         if msg.get("event") == "ready":
             return msg
@@ -376,8 +509,7 @@ def _wait_ready(proc: subprocess.Popen, replica_id: str,
 
 class ReplicaHandle:
     def __init__(self, proc: subprocess.Popen, replica_id: str,
-                 ready: Dict, spawn_s: float,
-                 msgs: queue.Queue, stderr_tail: deque):
+                 ready: Dict, spawn_s: float, pipes: _Pipes):
         self.proc = proc
         self.replica_id = replica_id
         self.ready = ready          # the child's announced ready line
@@ -385,8 +517,13 @@ class ReplicaHandle:
         self.ready_s: float = float(ready["ready_s"])  # in-process
         self.spawn_s = spawn_s      # parent wall: Popen -> ready line
         self.host = "127.0.0.1"
-        self._msgs = msgs
-        self._stderr_tail = stderr_tail
+        self._pipes = pipes
+        self._stderr_tail = pipes.stderr_tail
+        self._cmd_counter = itertools.count()
+        # commands currently awaiting replies: the supervisor skips its
+        # pipe-liveness ping while a long command (a bench stream) holds
+        # the child's single-threaded command loop
+        self.inflight_commands = 0
 
     def backend(self) -> Dict:
         return {"host": self.host, "port": self.port,
@@ -394,28 +531,65 @@ class ReplicaHandle:
 
     def command(self, cmd: Dict, timeout_s: float = 600.0) -> Dict:
         """Send one JSON command line to the child and return its JSON
-        reply (the reader thread skips stray stdout lines; the queue
-        read enforces the timeout even when the child emits nothing)."""
-        self.proc.stdin.write(json.dumps(cmd) + "\n")
-        self.proc.stdin.flush()
-        deadline = time.monotonic() + timeout_s
-        while True:
-            remaining = deadline - time.monotonic()
-            if remaining <= 0:
-                raise TimeoutError(
-                    f"replica {self.replica_id} command timed out: {cmd}"
-                )
+        reply.  Each command carries a unique id the child echoes as
+        reply_to; the reader thread routes the reply to THIS call's
+        queue, so concurrent commands (supervisor heartbeat + bench
+        stream) cannot steal each other's replies, and the queue read
+        enforces the timeout even when the child emits nothing."""
+        cid = f"{self.replica_id}-{next(self._cmd_counter)}"
+        cmd = {**cmd, "id": cid}
+        replies: queue.Queue = queue.Queue()
+        with self._pipes.waiters_lock:
+            self._pipes.waiters[cid] = replies
+        self.inflight_commands += 1
+        try:
             try:
-                msg = self._msgs.get(timeout=remaining)
-            except queue.Empty:
-                continue
-            if msg is _EOF:
+                self.proc.stdin.write(json.dumps(cmd) + "\n")
+                self.proc.stdin.flush()
+            except (OSError, ValueError) as e:
                 raise RuntimeError(
-                    f"replica {self.replica_id} died mid-command "
-                    f"(rc={self.proc.poll()}); stderr tail:\n"
+                    f"replica {self.replica_id} pipe closed "
+                    f"(rc={self.proc.poll()}): {e}; stderr tail:\n"
                     f"{_stderr_str(self._stderr_tail)}"
                 )
-            return msg
+            deadline = time.monotonic() + timeout_s
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"replica {self.replica_id} command timed out: "
+                        f"{cmd}"
+                    )
+                try:
+                    msg = replies.get(timeout=remaining)
+                except queue.Empty:
+                    continue
+                if msg is _EOF:
+                    raise RuntimeError(
+                        f"replica {self.replica_id} died mid-command "
+                        f"(rc={self.proc.poll()}); stderr tail:\n"
+                        f"{_stderr_str(self._stderr_tail)}"
+                    )
+                return msg
+        finally:
+            self.inflight_commands -= 1
+            with self._pipes.waiters_lock:
+                self._pipes.waiters.pop(cid, None)
+
+    def kill(self):
+        """Hard-kill the replica's whole process group (it was spawned
+        with start_new_session, so pgid == child pid)."""
+        try:
+            os.killpg(self.proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            try:
+                self.proc.kill()
+            except Exception:
+                pass
+        try:
+            self.proc.wait(timeout=10)
+        except Exception:
+            pass
 
     def stop(self, timeout_s: float = 15.0):
         if self.proc.poll() is None:
@@ -426,8 +600,7 @@ class ReplicaHandle:
             try:
                 self.proc.wait(timeout=timeout_s)
             except subprocess.TimeoutExpired:
-                self.proc.kill()
-                self.proc.wait(timeout=5.0)
+                self.kill()
 
 
 def spawn_replica(replica_id: str, snapshot_dir: str = "",
@@ -438,10 +611,10 @@ def spawn_replica(replica_id: str, snapshot_dir: str = "",
     with the child's stderr tail on failure)."""
     t0 = time.monotonic()
     proc = _spawn_proc(replica_id, snapshot_dir, cache_dir, extra_flags, env)
-    msgs, stderr_tail = _attach_pipes(proc, replica_id)
-    ready = _wait_ready(proc, replica_id, msgs, stderr_tail, t0, timeout_s)
+    pipes = _attach_pipes(proc, replica_id)
+    ready = _wait_ready(proc, replica_id, pipes, t0, timeout_s)
     return ReplicaHandle(proc, replica_id, ready,
-                         round(time.monotonic() - t0, 3), msgs, stderr_tail)
+                         round(time.monotonic() - t0, 3), pipes)
 
 
 def spawn_fleet(n: int, snapshot_dir: str = "", cache_dir: str = "",
@@ -469,14 +642,12 @@ def spawn_fleet(n: int, snapshot_dir: str = "", cache_dir: str = "",
                 proc = _spawn_proc(
                     rid, snapshot_dir, cache_dir, extra_flags, env
                 )
-                procs.append((rid, t0, proc, *_attach_pipes(proc, rid)))
-            for rid, t0, proc, msgs, stderr_tail in procs:
-                ready = _wait_ready(
-                    proc, rid, msgs, stderr_tail, t0, timeout_s
-                )
+                procs.append((rid, t0, proc, _attach_pipes(proc, rid)))
+            for rid, t0, proc, pipes in procs:
+                ready = _wait_ready(proc, rid, pipes, t0, timeout_s)
                 handles.append(ReplicaHandle(
                     proc, rid, ready, round(time.monotonic() - t0, 3),
-                    msgs, stderr_tail,
+                    pipes,
                 ))
     except BaseException:
         # kill EVERY spawned child, wrapped in a handle or not — a

@@ -160,6 +160,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--breaker-cooldown-s", type=float, default=5.0,
                    help="seconds the tripped breaker waits before running "
                         "half-open recovery probes")
+    p.add_argument("--mesh-watchdog-s", type=float,
+                   default=float(os.environ.get("GK_MESH_WATCHDOG_S", "30")),
+                   help="budget for one mesh-collective audit dispatch; a "
+                        "dispatch exceeding it is abandoned, the breaker "
+                        "trips, and the sweep re-shards one step narrower "
+                        "(0 disables the watchdog; docs/failure-modes.md)")
     # observability (docs/tracing.md): always-on tracing knobs
     p.add_argument("--trace-buffer-size", type=int, default=256,
                    help="completed traces retained for /debug/traces")
@@ -476,6 +482,7 @@ class App:
                 breaker_threshold=getattr(
                     args, "breaker_failure_threshold", None),
                 breaker_cooldown_s=getattr(args, "breaker_cooldown_s", None),
+                mesh_watchdog_s=getattr(args, "mesh_watchdog_s", None),
             )
         else:
             driver = InterpDriver()

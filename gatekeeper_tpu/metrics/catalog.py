@@ -150,7 +150,8 @@ SNAPSHOT_BYTES_M = Measure(
 SNAPSHOT_RESTORE_M = Measure(
     "snapshot_restore_outcome",
     "Startup snapshot restore attempts by outcome (restored, fallback, "
-    "none, disabled)",
+    "none, disabled), plus one 'quarantined' sample per snapshot a "
+    "restore moved aside into .quarantine/ after failed validation",
 )
 # ---- cost attribution + SLO engine (ISSUE 5) --------------------------------
 # The cost_* gauges are refreshed from the cost ledger's decaying window
@@ -247,6 +248,28 @@ AUDIT_AGE_M = Measure(
     "Seconds since the last successful audit sweep finished (since "
     "process start when none has completed)",
     unit="s",
+)
+# ---- self-healing fleet (ISSUE 8) -------------------------------------------
+REPLICA_RESTARTS_M = Measure(
+    "fleet_replica_restarts",
+    "Supervisor-initiated replica restarts by replica_id and reason "
+    "(crash, wedge, rolling)",
+)
+REPLICA_STATE_M = Measure(
+    "fleet_replica_state",
+    "Supervised replica state (0 running, 1 restarting, 2 quarantined, "
+    "3 draining, 4 stopped), per replica_id",
+)
+MESH_STALL_M = Measure(
+    "mesh_dispatch_stalls",
+    "Mesh-collective dispatches abandoned by the dispatch watchdog "
+    "(each trips the breaker and re-shards the sweep narrower)",
+)
+MESH_WIDTH_M = Measure(
+    "mesh_sweep_width",
+    "Row-sharding width currently serving device audit sweeps "
+    "(1 = the single-device path; drops when a dispatch stall degrades "
+    "the mesh)",
 )
 
 # bucket boundaries copied from the reference's view.Distribution calls
@@ -371,6 +394,12 @@ def catalog_views():
         View("slo_error_budget_remaining", SLO_BUDGET_M, AGG_LAST_VALUE,
              tag_keys=("objective",)),
         View("audit_last_run_age_s", AUDIT_AGE_M, AGG_LAST_VALUE),
+        View("fleet_replica_restarts_total", REPLICA_RESTARTS_M, AGG_COUNT,
+             tag_keys=("replica_id", "reason")),
+        View("fleet_replica_state", REPLICA_STATE_M, AGG_LAST_VALUE,
+             tag_keys=("replica_id",)),
+        View("mesh_dispatch_stalls_total", MESH_STALL_M, AGG_COUNT),
+        View("mesh_sweep_width", MESH_WIDTH_M, AGG_LAST_VALUE),
     ]
 
 
@@ -653,6 +682,46 @@ def record_batcher_state(target_size: int, deadline_ms: float,
         reg.record(BATCH_TARGET_M, float(target_size), tags)
         reg.record(BATCH_DEADLINE_M, float(deadline_ms), tags)
         reg.record(OFFERED_LOAD_M, float(offered_load_rps), tags)
+    except Exception:  # pragma: no cover - telemetry never blocks eval
+        pass
+
+
+def record_replica_restart(replica_id: str, reason: str):
+    """One supervisor-initiated replica restart (reason: crash, wedge,
+    rolling).  Guarded like record_stage."""
+    try:
+        _global().record(
+            REPLICA_RESTARTS_M, 1.0,
+            {"replica_id": replica_id, "reason": reason},
+        )
+    except Exception:  # pragma: no cover - telemetry never blocks healing
+        pass
+
+
+def record_replica_state(replica_id: str, state_code: int):
+    """The supervisor's current view of one replica (0 running,
+    1 restarting, 2 quarantined, 3 draining, 4 stopped)."""
+    try:
+        _global().record(
+            REPLICA_STATE_M, float(state_code), {"replica_id": replica_id}
+        )
+    except Exception:  # pragma: no cover - telemetry never blocks healing
+        pass
+
+
+def record_mesh_stall():
+    """One mesh-collective dispatch abandoned by the watchdog."""
+    try:
+        _global().record(MESH_STALL_M, 1.0)
+    except Exception:  # pragma: no cover - telemetry never blocks eval
+        pass
+
+
+def record_mesh_width(width: int):
+    """The sweep sharding width now serving device audits (set_mesh /
+    degradation)."""
+    try:
+        _global().record(MESH_WIDTH_M, float(width))
     except Exception:  # pragma: no cover - telemetry never blocks eval
         pass
 

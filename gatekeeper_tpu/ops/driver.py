@@ -21,6 +21,7 @@ from __future__ import annotations
 import copy
 import logging
 import os
+import threading as _threading_mod
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -188,6 +189,7 @@ class TpuDriver(InterpDriver):
         async_compile: Optional[bool] = None,
         breaker_threshold: Optional[int] = None,
         breaker_cooldown_s: Optional[float] = None,
+        mesh_watchdog_s: Optional[float] = None,
     ):
         super().__init__(target)
         # eager native build/load: the g++ compile must happen here, not
@@ -373,6 +375,24 @@ class TpuDriver(InterpDriver):
             probe_fn=self._breaker_probe,
             on_transition=self._on_breaker_transition,
         )
+        # mesh dispatch watchdog (docs/failure-modes.md): a stuck mesh
+        # collective otherwise wedges the sweep thread AND the dispatch
+        # gate forever (the breaker trips on exceptions, not on hangs).
+        # With a budget set, guarded mesh-audit dispatches run under a
+        # bounded join; a timeout raises MeshDispatchStall, which trips
+        # the breaker and re-shards the sweep narrower (set_mesh), the
+        # abandoned dispatch's gate generation revoked.  0/None disables
+        # (the default: no extra thread on the sweep path).  The budget
+        # must cover a COLD SPMD trace+compile, not just the dispatch —
+        # the first sweep at a new topology compiles inside the guarded
+        # region (this jax cannot pre-populate the jit call cache from
+        # lower().compile()) — hence the tens-of-seconds production
+        # default (main.py --mesh-watchdog-s).
+        if mesh_watchdog_s is None:
+            mesh_watchdog_s = float(
+                os.environ.get("GK_MESH_WATCHDOG_S", "0") or 0
+            )
+        self.mesh_watchdog_s = mesh_watchdog_s
 
     # ---- lifecycle --------------------------------------------------------
 
@@ -840,6 +860,11 @@ class TpuDriver(InterpDriver):
             self._delta_jit_key = None
             self._fused_audit_mesh = None
             self._fused_audit_mesh_key = None
+        from ..metrics.catalog import record_mesh_width
+
+        # outside the driver lock (the gauge is advisory); mesh_layout()
+        # resolves the new topology, initializing it on first use
+        record_mesh_width(self.mesh_layout() if enabled else 1)
 
     def mesh_layout(self) -> int:
         """The row-sharding width serving production sweeps: device count
@@ -848,6 +873,124 @@ class TpuDriver(InterpDriver):
         the basis (width drift invalidation, gatekeeper_tpu/snapshot/)."""
         mesh = self._mesh()
         return 1 if mesh is None else int(mesh.devices.size)
+
+    def _guarded_mesh_dispatch(self, mesh, thunk, enter: bool = True):
+        """Run one mesh-collective enqueue under the dispatch gate with
+        the stall watchdog (docs/failure-modes.md).  Without a watchdog
+        budget this is exactly `with DISPATCH_LOCK, mesh: thunk()`.  With
+        one, the guarded enqueue runs on a worker thread the caller joins
+        with the budget; a timeout (the gate never freed, or the enqueue
+        itself wedged — a stuck collective rendezvous) revokes the gate's
+        generation (abandoning the wedged holder so narrower-topology
+        dispatches can proceed) and raises MeshDispatchStall, which the
+        audit paths convert into breaker trip + re-shard.
+
+        Cost model: each guarded dispatch pays one worker-thread spawn
+        (microseconds against a sweep's ms-to-s dispatch), and an
+        ABANDONED worker necessarily pins its operand buffers until the
+        wedged collective ever returns — they are live inputs of the
+        in-flight call, not freeable from outside.  Acceptable because
+        abandonment coincides with the breaker tripping and the mesh
+        narrowing: the degraded state the pinned memory rides out."""
+        from ..parallel.mesh import DISPATCH_LOCK, MeshDispatchStall
+
+        import contextlib
+
+        # `enter` mirrors each pre-watchdog call site exactly: the fused
+        # audit dispatch ran inside `with mesh:`, the delta dispatch did
+        # not (its executable was traced without the ambient mesh, and
+        # entering it here would miss the background-warmed jit cache)
+        mesh_ctx = mesh if enter else contextlib.nullcontext()
+        timeout = self.mesh_watchdog_s or 0.0
+        if timeout <= 0:
+            with DISPATCH_LOCK, mesh_ctx:
+                if faults.ENABLED:
+                    faults.fire(faults.MESH_DISPATCH_STALL)
+                return thunk()
+
+        def _stall(where: str) -> MeshDispatchStall:
+            DISPATCH_LOCK.revoke()
+            from ..metrics.catalog import record_mesh_stall
+
+            record_mesh_stall()
+            log.warning(
+                "mesh dispatch watchdog: %s exceeded %.3fs at width %d",
+                where, timeout, self.mesh_layout(),
+            )
+            return MeshDispatchStall(
+                f"mesh dispatch {where} exceeded the {timeout:.3f}s "
+                f"watchdog budget"
+            )
+
+        token = DISPATCH_LOCK.acquire(timeout=timeout)
+        if token is None:
+            # a previous dispatch is wedged holding the gate
+            raise _stall("gate wait")
+        done = _threading_mod.Event()
+        box: dict = {}
+
+        def run():
+            try:
+                with mesh_ctx:
+                    if faults.ENABLED:
+                        faults.fire(faults.MESH_DISPATCH_STALL)
+                    box["out"] = thunk()
+            except BaseException as e:  # surfaced on the caller's side
+                box["err"] = e
+            finally:
+                done.set()
+                # released from the worker: a late (post-revoke) release
+                # of an abandoned generation is harmless by design
+                DISPATCH_LOCK.release(token)
+
+        t = _threading_mod.Thread(
+            target=run, name="gk-mesh-dispatch", daemon=True
+        )
+        t.start()
+        if not done.wait(timeout):
+            raise _stall("collective enqueue")
+        if "err" in box:
+            raise box["err"]
+        return box["out"]
+
+    def _record_device_failure(self, e: BaseException):
+        """Feed one device-path failure to the breaker.  A MeshDispatchStall
+        is decisive — a wedged collective will wedge every subsequent mesh
+        dispatch too, so it trips the breaker immediately (no
+        threshold-counting through repeated watchdog budgets) and
+        re-shards the sweep narrower; the rebasing full sweep runs at the
+        new width once the breaker's recovery probe closes it."""
+        from ..parallel.mesh import MeshDispatchStall
+
+        self.breaker.record_failure(e)
+        if isinstance(e, MeshDispatchStall):
+            self.breaker.trip()
+            try:
+                self.degrade_mesh()
+            except Exception:
+                log.exception("mesh degradation after a stall failed")
+
+    def degrade_mesh(self) -> int:
+        """Re-shard the audit sweep one step narrower after a stalled
+        collective: width w -> w // 2, bottoming out at the single-device
+        path.  set_mesh() drops every topology-keyed cache including the
+        delta basis, so the next device sweep is one full dispatch that
+        rebases the incremental state — parity preserved by construction
+        (the narrower sweep computes the identical [C, R] masks).
+        Returns the new width (1 = single-device)."""
+        width = self.mesh_layout()
+        new = width // 2
+        if new >= 2:
+            self.set_mesh(True, width=new)
+        else:
+            new = 1
+            self.set_mesh(True, width=1)
+        log.warning(
+            "mesh degraded after dispatch stall: width %d -> %d%s",
+            width, new,
+            " (single-device path)" if new == 1 else "",
+        )
+        return new
 
     def _dispatch(self, fn, rv_arrays, cp_arrays, cols, group_params, rows,
                   cs_key=None):
@@ -2597,16 +2740,14 @@ class TpuDriver(InterpDriver):
             # by a jitted scatter of just the dirty rows — re-placing the
             # full row pack across N shards every sweep was the measured
             # ~4x sharded-path overhead (r4 verdict weak #5)
-            from ..parallel.mesh import DISPATCH_LOCK
-
             rv_p, cols_p = self._audit_device_inputs_mesh(mesh)
             cs_p, gp_p = self._constraint_device_side(
                 cp.arrays, group_params, None, mesh
             )
-            with DISPATCH_LOCK, mesh:
-                mask_dev, packed_dev = self._fused_audit_mesh_fn(K, mesh)(
-                    rv_p, cs_p, cols_p, gp_p
-                )
+            fn_mesh = self._fused_audit_mesh_fn(K, mesh)
+            mask_dev, packed_dev = self._guarded_mesh_dispatch(
+                mesh, lambda: fn_mesh(rv_p, cs_p, cols_p, gp_p)
+            )
             mask_src = MaskSource.resolved(mask_dev)
             # warm the mesh-specialized delta executable off the sweep
             # path (the mask is already resolved; only the trace/compile
@@ -2741,7 +2882,7 @@ class TpuDriver(InterpDriver):
         try:
             out = self._audit_device(tracing)
         except Exception as e:
-            self.breaker.record_failure(e)
+            self._record_device_failure(e)
             log.warning(
                 "device audit failed (%s: %s); serving from the "
                 "interpreter tier", type(e).__name__, e,
@@ -3053,13 +3194,15 @@ class TpuDriver(InterpDriver):
         # [C_total, 2d] from the device; crow folds pad rows out so the
         # incremental state stays per ordered constraint
         if mesh is not None:
-            from ..parallel.mesh import DISPATCH_LOCK
-
-            with DISPATCH_LOCK:
-                both_dev = self._delta_dispatch_fn(mesh)(
-                    st.mask_src.get(), rows_pad, rv_slice, cs_d,
-                    cols_slice, gp_d
-                )
+            delta_fn = self._delta_dispatch_fn(mesh)
+            mask_in = st.mask_src.get()
+            both_dev = self._guarded_mesh_dispatch(
+                mesh,
+                lambda: delta_fn(
+                    mask_in, rows_pad, rv_slice, cs_d, cols_slice, gp_d
+                ),
+                enter=False,
+            )
         else:
             both_dev = self._delta_dispatch_fn(mesh)(
                 st.mask_src.get(), rows_pad, rv_slice, cs_d, cols_slice,
@@ -3130,7 +3273,7 @@ class TpuDriver(InterpDriver):
         try:
             out = self._audit_capped_device(cap, tracing)
         except Exception as e:
-            self.breaker.record_failure(e)
+            self._record_device_failure(e)
             log.warning(
                 "device capped audit failed (%s: %s); serving from the "
                 "interpreter tier", type(e).__name__, e,

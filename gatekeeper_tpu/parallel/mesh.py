@@ -30,7 +30,113 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-# Process-wide mesh-collective dispatch lock.  Two collective-bearing
+class MeshDispatchStall(RuntimeError):
+    """A mesh-collective dispatch exceeded the watchdog budget (either the
+    gate never freed — a previous dispatch is wedged holding it — or the
+    guarded enqueue itself never returned).  The driver treats it as a
+    backend failure: trips the breaker and re-shards the sweep narrower
+    (docs/failure-modes.md, fleet failure matrix)."""
+
+
+class DispatchGate:
+    """The mesh-collective dispatch serializer, revocable.
+
+    Lock semantics are the original DISPATCH_LOCK's: hold it across every
+    collective-bearing enqueue so per-device launch order stays globally
+    consistent (an inconsistent interleave deadlocks the AllReduce
+    rendezvous — see the PR 6 notes below).  On top of a plain lock it
+    adds what the dispatch watchdog needs:
+
+    - ``acquire(timeout)`` returns a token (or None on timeout) so a
+      bounded wait can distinguish "busy" from "wedged";
+    - ``revoke()`` abandons the current holder: the gate swaps in a fresh
+      generation, so after a stuck dispatch is written off, subsequent
+      (narrower-topology) dispatches proceed instead of queueing forever
+      behind a thread that will never release.  A waiter that was already
+      blocked on the OLD generation when it was revoked re-checks the
+      generation after acquiring and migrates to the current one — it can
+      never end up holding an abandoned lock while a new-generation
+      holder dispatches concurrently (that interleave is exactly the
+      rendezvous deadlock the gate exists to prevent).  The abandoned
+      holder's own eventual release is then harmless.
+
+    Plain ``with DISPATCH_LOCK:`` keeps working (blocking acquire of the
+    current generation), so every pre-existing dispatch site is
+    unchanged.
+    """
+
+    def __init__(self):
+        self._mu = _threading.Lock()        # guards the generation swap
+        self._lock = _threading.Lock()      # the actual gate
+        self._gen = 0
+        self._tokens = _threading.local()   # per-thread ctx-manager stack
+        self.revocations = 0                # observability (tests, stats)
+
+    def _current(self):
+        with self._mu:
+            return self._lock, self._gen
+
+    def acquire(self, timeout: Optional[float] = None):
+        """-> opaque token for release(), or None when `timeout` elapsed.
+
+        Generation-checked: if a revoke() landed while we waited, the
+        lock we just acquired is the ABANDONED one — release it and
+        re-acquire the current generation (within the same deadline for
+        timed acquires).  Without this, a waiter woken by the wedged
+        holder's late release would dispatch its collective under the
+        old lock, unserialized against new-generation dispatches."""
+        deadline = (
+            _time.monotonic() + timeout if timeout is not None else None
+        )
+        while True:
+            lock, gen = self._current()
+            if deadline is None:
+                got = lock.acquire()
+            else:
+                remaining = deadline - _time.monotonic()
+                got = remaining > 0 and lock.acquire(timeout=remaining)
+            if not got:
+                return None
+            with self._mu:
+                if gen == self._gen:
+                    return (lock, gen)
+            # revoked while we waited: this lock is abandoned — drop it
+            # and serialize against the CURRENT generation instead
+            lock.release()
+
+    def release(self, token):
+        """Idempotent for abandoned holders: releasing a revoked
+        generation's lock is safe (nothing acquires it again)."""
+        lock, _gen = token
+        try:
+            lock.release()
+        except RuntimeError:
+            pass  # already released (defensive; should not happen)
+
+    def revoke(self):
+        """Abandon the current holder: fresh lock, new generation."""
+        with self._mu:
+            self._lock = _threading.Lock()
+            self._gen += 1
+            self.revocations += 1
+
+    def locked(self) -> bool:
+        return self._current()[0].locked()
+
+    def __enter__(self):
+        token = self.acquire()
+        stack = getattr(self._tokens, "stack", None)
+        if stack is None:
+            stack = self._tokens.stack = []
+        stack.append(token)
+        return self
+
+    def __exit__(self, *exc):
+        self.release(self._tokens.stack.pop())
+        return False
+
+
+# Process-wide mesh-collective dispatch gate.  Two collective-bearing
 # SPMD executables enqueued concurrently from different threads can
 # interleave their per-device launch order (A before B on one device,
 # B before A on another) and deadlock the cross-device rendezvous —
@@ -41,7 +147,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 # Hold it across the enqueue (the jitted call), not the result fetch:
 # per-device execution is in-order, so a globally consistent enqueue
 # order suffices, and device work still overlaps the host.
-DISPATCH_LOCK = _threading.Lock()
+DISPATCH_LOCK = DispatchGate()
 
 
 def audit_mesh(n_devices: Optional[int] = None) -> Mesh:

@@ -45,6 +45,10 @@ PAYLOAD_FILES = (INTERNER, REGISTRY, PACK, ARRAYS, INVENTORY)
 
 SNAP_PREFIX = "snap-"
 TMP_PREFIX = ".tmp-"
+# snapshots that failed validation are renamed under here (dot-prefixed:
+# excluded from list_snapshots and the writer's prune) instead of being
+# re-validated — and re-failed — on every subsequent restart
+QUARANTINE_DIR = ".quarantine"
 
 
 class SnapshotError(Exception):

@@ -39,6 +39,11 @@ Outcomes (snapshot_restore_outcome_total{outcome}):
              failure forced a state wipe, or the RVs were fully stale
              (every row re-packs: cold-equivalent work, done safely)
   none     — no snapshot on disk (ordinary cold start)
+
+plus one `quarantined` sample per snapshot that FAILED validation and
+was renamed aside into `<root>/.quarantine/` (docs/failure-modes.md):
+a corrupt snapshot is inspected once, never re-validated — and
+re-failed — on every subsequent restart.
 """
 
 from __future__ import annotations
@@ -46,7 +51,7 @@ from __future__ import annotations
 import json
 import os
 import time
-from typing import Any, Dict, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
@@ -70,8 +75,15 @@ def _load_json(snap_dir: str, name: str):
 
 
 class SnapshotLoader:
-    def __init__(self, root: str):
+    def __init__(self, root: str, quarantine: Optional[bool] = None):
         self.root = root
+        # quarantine policy: None (default) follows ownership — the
+        # resync=True restore path is the dir's OWNER (single-process or
+        # the audit role) and moves validation-failed snapshots aside;
+        # the resync=False path is a read-mostly fleet consumer of a
+        # SHARED dir and must never mutate warmth it does not own
+        # (docs/fleet.md trust model, tests/test_snapshot_concurrent.py)
+        self.quarantine = quarantine
         # filled by restore(): resync statistics for logs/bench, and
         # whether the incremental-sweep basis was installed
         self.stats: Dict[str, Any] = {}
@@ -81,6 +93,14 @@ class SnapshotLoader:
 
     def _read(self, snap_dir: str) -> Dict[str, Any]:
         fmt.read_manifest(snap_dir)  # hmac + fingerprint + checksums
+        if faults.ENABLED:
+            # post-seal payload-validation seam: an error-mode rule models
+            # a snapshot whose sealed bytes fail structural validation —
+            # the quarantine path, not the try-the-next-snapshot path
+            try:
+                faults.fire(faults.SNAPSHOT_CORRUPT)
+            except Exception as e:
+                raise SnapshotError(f"injected corruption: {e}")
         interner = _load_json(snap_dir, fmt.INTERNER)
         registry = _load_json(snap_dir, fmt.REGISTRY)
         pack = _load_json(snap_dir, fmt.PACK)
@@ -464,6 +484,8 @@ class SnapshotLoader:
             self.stats = {}
             return "none"
         outcome = "fallback"
+        quarantine = self.quarantine if self.quarantine is not None \
+            else resync
         with obstrace.root_span("snapshot.restore", snapshots=len(names)):
             for name in names:
                 snap_dir = os.path.join(self.root, name)
@@ -474,9 +496,13 @@ class SnapshotLoader:
                         state = self._read(snap_dir)
                 except SnapshotError as e:
                     log.warning("snapshot %s rejected: %s", name, e)
+                    if quarantine:
+                        self._quarantine(snap_dir, name, str(e))
                     continue
-                except Exception:
+                except Exception as e:
                     log.exception("snapshot %s unreadable", name)
+                    if quarantine:
+                        self._quarantine(snap_dir, name, repr(e))
                     continue
                 try:
                     with obstrace.span("snapshot.install",
@@ -532,6 +558,38 @@ class SnapshotLoader:
         record_snapshot_load(time.perf_counter() - t0)
         record_snapshot_outcome(outcome)
         return outcome
+
+    def _quarantine(self, snap_dir: str, name: str, reason: str):
+        """Move a snapshot that failed validation aside into
+        `<root>/.quarantine/<name>` so it is inspected once and never
+        re-validated (and re-failed) on every subsequent restart — a
+        corrupt newest snapshot otherwise taxes every restore attempt
+        forever.  One `snapshot_restore_outcome_total{outcome=
+        "quarantined"}` sample per moved snapshot; a failed rename is
+        logged and swallowed (quarantine is hygiene, never a reason to
+        fail the restore that already fell past this snapshot)."""
+        qroot = os.path.join(self.root, fmt.QUARANTINE_DIR)
+        try:
+            os.makedirs(qroot, exist_ok=True)
+            dst = os.path.join(qroot, name)
+            if os.path.exists(dst):
+                # a same-named quarantined dir already exists (clock
+                # reuse): keep both, suffixed by arrival order
+                n = 1
+                while os.path.exists(f"{dst}.{n}"):
+                    n += 1
+                dst = f"{dst}.{n}"
+            os.rename(snap_dir, dst)
+        except OSError:
+            log.exception("failed to quarantine snapshot %s", name)
+            return
+        record_snapshot_outcome("quarantined")
+        gklog.log_event(
+            log, "snapshot quarantined",
+            **{gklog.EVENT_TYPE: "snapshot_quarantined",
+               "snapshot_dir": snap_dir, "quarantined_to": dst,
+               "reason": reason[:500]},
+        )
 
     @staticmethod
     def _wipe(client):

@@ -451,6 +451,40 @@ class MicroBatcher:
                 self._busy = False
                 last_dispatch_end = _time.monotonic()
 
+    def drain(self, deadline_s: float) -> dict:
+        """Flush the queue for a graceful shutdown (docs/fleet.md drain
+        protocol): wait until every already-enqueued request has been
+        dispatched AND answered (or refused by its own admission budget —
+        each queued member's deadline still bounds it individually), up
+        to `deadline_s`.  The batcher keeps running — new arrivals during
+        the drain are NOT rejected here; stopping intake is the server's
+        job (WebhookServer.drain), sequenced by the supervisor before
+        this flush.  Returns {"pending_start", "drained", "overran",
+        "drain_ms"}; never blocks past the deadline."""
+        t0 = time.monotonic()
+        deadline = t0 + max(0.0, deadline_s)
+        with self._cv:
+            pending_start = len(self._pending)
+        while time.monotonic() < deadline:
+            with self._cv:
+                if not self._pending and not self._busy:
+                    break
+                # each arrival/dispatch notifies the cv; cap the wait so
+                # a missed notify cannot overrun the budget
+                self._cv.wait(
+                    timeout=min(0.005, max(0.0,
+                                           deadline - time.monotonic()))
+                )
+        with self._cv:
+            leftover = len(self._pending) or (1 if self._busy else 0)
+        dur = time.monotonic() - t0
+        return {
+            "pending_start": pending_start,
+            "drained": leftover == 0,
+            "overran": leftover > 0,
+            "drain_ms": round(dur * 1e3, 3),
+        }
+
     def stop(self):
         # clear the driver's load hint: a stopped batcher must not pin
         # throughput routing for whoever evaluates next (tests, restarts)
@@ -510,6 +544,12 @@ class WebhookServer:
         self._thread: Optional[threading.Thread] = None
         self._ssl_context: Optional[ssl.SSLContext] = None
         self._stopping = False
+        # graceful drain (docs/fleet.md): a draining server answers 503 to
+        # NEW admission requests (the front door/LB has already stopped
+        # routing here; stragglers must fail over, not land new work) while
+        # in-flight evaluation finishes under its own deadline budgets.
+        # Health endpoints keep answering; /readyz reports not-ready.
+        self._draining = False
 
     def _status_snapshot(self) -> Optional[dict]:
         if self.health_status is None:
@@ -545,6 +585,7 @@ class WebhookServer:
             self._gc_stop.set()
             self._gc_stop = None
         self._stopping = False  # a stopped server may be restarted
+        self._draining = False
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -611,6 +652,11 @@ class WebhookServer:
                     # state machine, trip counts, time degraded)
                     self._send_json(200, outer._status_snapshot() or {})
                 elif self.path == "/readyz":
+                    if outer._draining:
+                        # draining is an orderly not-ready: LB health
+                        # checks pull the backend while /healthz stays ok
+                        self._send_text(503, "draining")
+                        return
                     ready = (
                         outer.readiness_check() if outer.readiness_check else True
                     )
@@ -730,6 +776,13 @@ class WebhookServer:
                     return
                 if self._stopped():
                     return
+                if outer._draining:
+                    # explicit refusal, never a fabricated verdict: the
+                    # caller (front door / apiserver) fails over to a
+                    # live replica or applies its failurePolicy
+                    self.close_connection = True
+                    self._send_text(503, "draining")
+                    return
                 if self.path not in ("/v1/admit", "/v1/admitlabel"):
                     self._send_text(404, "not found")
                     return
@@ -806,6 +859,14 @@ class WebhookServer:
                 gc.collect()
 
         threading.Thread(target=_sweep, name="webhook-gc", daemon=True).start()
+
+    def drain(self, draining: bool = True):
+        """Enter (or leave) draining: new admission POSTs answer 503 and
+        /readyz reports not-ready, while /healthz and the debug surface
+        keep serving.  The supervisor's graceful-drain sequence is
+        eject-from-front-door -> server.drain() -> batcher.drain(budget)
+        -> stop() (docs/fleet.md)."""
+        self._draining = bool(draining)
 
     def stop(self):
         if getattr(self, "_gc_stop", None) is not None:

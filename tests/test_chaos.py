@@ -774,3 +774,418 @@ class TestSnapshotChaos:
         # and the retry (fault exhausted) succeeds
         snapper._last_write = 0.0
         assert snapper.write_once() is not None
+
+
+class TestGracefulDrain:
+    """ISSUE 8: the drain protocol's two halves — the micro-batcher
+    flush bounded by its deadline budget, and the server-side intake
+    stop (docs/fleet.md)."""
+
+    def test_drain_under_deadline_budget_never_exceeds_it(
+        self, fault_plane
+    ):
+        """ISSUE 8 satellite: a graceful drain with a 10ms deadline
+        budget returns within it (plus scheduler slack) even when the
+        in-flight batch is wedged on a 2s injected hang — the drain
+        reports `overran`, it never waits the hang out."""
+        client, driver = tpu_client()
+        mb = MicroBatcher(client, window_s=0.01)
+        fault_plane.add(
+            faults.TPU_DISPATCH, FaultRule(mode="hang", hang_s=2.0)
+        )
+        result = {}
+
+        def call():
+            try:
+                result["r"] = mb.review(AugmentedReview(
+                    admission_request=ns_review("drain-hang")
+                ))
+            except Exception as e:
+                result["r"] = e
+
+        try:
+            mb._busy = True  # steer the request into the queue
+            t = threading.Thread(target=call)
+            t.start()
+            assert wait_until(lambda: len(mb._pending) == 1)
+            mb._busy = False
+            # the batch loop picks it up and wedges inside the dispatch
+            assert wait_until(
+                lambda: mb._busy and not mb._pending, timeout_s=5.0
+            ), "batch loop never picked up the wedged request"
+            t0 = time.monotonic()
+            stats = mb.drain(0.010)
+            dur = time.monotonic() - t0
+            assert dur <= 0.010 + 0.1, (
+                f"drain took {dur:.3f}s against a 10ms budget"
+            )
+            assert stats["overran"] is True
+            assert stats["drained"] is False
+        finally:
+            fault_plane.release_hangs()
+            t.join(timeout=5.0)
+            mb.stop()
+
+    def test_drain_of_idle_batcher_returns_immediately(self):
+        client, driver = tpu_client()
+        mb = MicroBatcher(client, window_s=0.01)
+        try:
+            stats = mb.drain(0.010)
+            assert stats == {
+                "pending_start": 0, "drained": True, "overran": False,
+                "drain_ms": stats["drain_ms"],
+            }
+            assert stats["drain_ms"] <= 10.0
+        finally:
+            mb.stop()
+
+    def test_draining_server_refuses_new_admissions_explicitly(self):
+        """The drain protocol's intake side: a draining server answers
+        503 (the front door fails over), /readyz goes not-ready, and
+        /healthz stays 200 — then drain(False) restores service."""
+        import urllib.error
+        import urllib.request
+
+        from gatekeeper_tpu.webhook import ValidationHandler, WebhookServer
+
+        client = interp_client()
+        handler = ValidationHandler(client, kube=InMemoryKube())
+        srv = WebhookServer(handler, port=0)
+        srv.start()
+        try:
+            body = json.dumps({"request": ns_review("pre-drain")}).encode()
+
+            def post():
+                r = urllib.request.Request(
+                    f"http://127.0.0.1:{srv.port}/v1/admit", data=body,
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(r, timeout=5) as resp:
+                    return resp.status, json.loads(resp.read())
+
+            def get(path):
+                try:
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{srv.port}{path}", timeout=5
+                    ) as r:
+                        return r.status, r.read()
+                except urllib.error.HTTPError as e:
+                    return e.code, e.read()
+
+            assert post()[0] == 200
+            srv.drain()
+            code, _ = get("/readyz")
+            assert code == 503
+            assert get("/healthz")[0] == 200
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                post()
+            assert ei.value.code == 503
+            assert b"draining" in ei.value.read()
+            srv.drain(False)
+            assert post()[0] == 200
+            assert get("/readyz")[0] == 200
+        finally:
+            srv.stop()
+
+
+class TestMeshDispatchStall:
+    """ISSUE 8: a wedged mesh collective must not hold the sweep (or the
+    dispatch gate) forever — the watchdog abandons it, trips the breaker
+    (interpreter-identical verdicts meanwhile), and re-shards the sweep
+    one step narrower; the rebasing full sweep at the new width stays
+    byte-parity with the oracle."""
+
+    def _populate(self, *clients, n=6):
+        for c in clients:
+            for i in range(n):
+                labels = {"gatekeeper": "on"} if i % 2 else {}
+                c.add_data({"apiVersion": "v1", "kind": "Namespace",
+                            "metadata": {"name": f"m-{i}",
+                                         "labels": labels}})
+
+    def _audit_sig(self, client):
+        resp, totals = client.audit_capped(20)
+        return sorted(r.msg for r in resp.results()), totals
+
+    def test_stall_trips_breaker_and_narrows_mesh(self, fault_plane):
+        from gatekeeper_tpu.parallel.mesh import DISPATCH_LOCK
+
+        driver = TpuDriver(
+            breaker_threshold=3, breaker_cooldown_s=30.0,
+            mesh_watchdog_s=0.25,
+        )
+        driver.DEVICE_MIN_CELLS = 0
+        client = Client(driver=driver)
+        client.add_template(TEMPLATE)
+        client.add_constraint(CONSTRAINT)
+        oracle = interp_client()
+        self._populate(client, oracle)
+        driver.set_mesh(True, width=4)
+        want = self._audit_sig(oracle)
+
+        revocations_before = DISPATCH_LOCK.revocations
+        # the collective wedges (bounded, releasable) INSIDE the gate —
+        # exactly what a stuck AllReduce rendezvous looks like
+        fault_plane.add(
+            faults.MESH_DISPATCH_STALL,
+            FaultRule(mode="hang", hang_s=10.0, count=1),
+        )
+        got = self._audit_sig(client)
+        assert got == want, "stalled sweep must still answer (interp tier)"
+        assert driver.breaker.state == OPEN, driver.breaker.status()
+        assert driver.mesh_layout() == 2, (
+            "stall must re-shard the sweep one step narrower"
+        )
+        assert DISPATCH_LOCK.revocations == revocations_before + 1
+        from gatekeeper_tpu.metrics.views import global_registry
+
+        assert global_registry().view_rows(
+            "mesh_dispatch_stalls_total"
+        ).get(()) >= 1.0
+        assert global_registry().view_rows(
+            "mesh_sweep_width"
+        ).get(()) == 2.0
+
+        # while degraded every sweep is interpreter-identical
+        assert self._audit_sig(client) == want
+
+        # unwedge the abandoned dispatch and let it finish ALONE before
+        # any new device work (enqueue-order discipline)
+        fault_plane.release_hangs()
+        time.sleep(0.3)
+        fault_plane.clear(faults.MESH_DISPATCH_STALL)
+        # the first width-2 dispatch pays the SPMD trace+compile INSIDE
+        # the guarded region (this jax cannot pre-populate the jit cache
+        # from lower().compile()), so the recovery phase needs a budget
+        # that covers a cold compile — exactly why the production
+        # default is 30s, not sub-second
+        driver.mesh_watchdog_s = 60.0
+        assert driver.breaker.probe_now(), driver.breaker.status()
+        # the next device sweep runs at the narrower width and rebases
+        # via one full dispatch — parity preserved
+        assert self._audit_sig(client) == want
+        stats = driver.last_sweep_stats
+        assert stats.get("shards") == 2.0, stats
+        assert not stats.get("cached")
+
+    def test_second_stall_degrades_to_single_device(self, fault_plane):
+        driver = TpuDriver(
+            breaker_threshold=3, breaker_cooldown_s=30.0,
+            mesh_watchdog_s=0.25,
+        )
+        driver.DEVICE_MIN_CELLS = 0
+        client = Client(driver=driver)
+        client.add_template(TEMPLATE)
+        client.add_constraint(CONSTRAINT)
+        oracle = interp_client()
+        self._populate(client, oracle)
+        want = self._audit_sig(oracle)
+        driver.set_mesh(True, width=2)
+        fault_plane.add(
+            faults.MESH_DISPATCH_STALL,
+            FaultRule(mode="hang", hang_s=10.0, count=1),
+        )
+        assert self._audit_sig(client) == want
+        assert driver.mesh_layout() == 1, (
+            "width 2 degrades to the single-device path"
+        )
+        fault_plane.release_hangs()
+        time.sleep(0.3)
+        fault_plane.clear(faults.MESH_DISPATCH_STALL)
+        assert driver.breaker.probe_now()
+        assert self._audit_sig(client) == want
+        assert driver.last_sweep_stats.get("shards") == 1.0
+
+    def test_watchdog_disabled_by_default(self):
+        driver = TpuDriver()
+        assert driver.mesh_watchdog_s == 0.0
+
+
+class TestSnapshotQuarantine:
+    """ISSUE 8 satellite: a snapshot that fails validation is moved
+    aside into .quarantine/ EXACTLY once (with the outcome counter
+    incremented), the cold path proceeds, and the next restart never
+    re-validates it.  Read-mostly consumers (resync=False) never touch
+    the shared dir."""
+
+    def _written(self, snap_dir, n=6):
+        kube = build_cluster(n=n)
+        client = make_client(kube)
+        sig, _ = audit_sig(client)
+        assert Snapshotter(
+            client, str(snap_dir), capture_delta=False
+        ).write_once() is not None
+        return kube, sig
+
+    def _corrupt(self, snap_dir, fname, mutate):
+        snap = os.path.join(
+            str(snap_dir), snapfmt.list_snapshots(str(snap_dir))[0]
+        )
+        mutate(os.path.join(snap, fname))
+
+    def _assert_quarantined_once(self, snap_dir, kube, cold_sig):
+        qdir = os.path.join(str(snap_dir), snapfmt.QUARANTINE_DIR)
+        before_q = outcome_counts().get("quarantined", 0)
+        client = fresh_client()
+        outcome = SnapshotLoader(str(snap_dir)).restore(client, kube)
+        assert outcome == "fallback"
+        assert outcome_counts().get("quarantined", 0) == before_q + 1
+        # moved aside: the snapshot root holds no snap-* dirs anymore,
+        # the quarantine dir holds exactly one
+        assert snapfmt.list_snapshots(str(snap_dir)) == []
+        assert len(os.listdir(qdir)) == 1
+        # cold start proceeds to the oracle's verdicts
+        client.add_template(SNAP_TEMPLATE)
+        client.add_constraint(SNAP_CONSTRAINT)
+        for obj in kube.list(("", "v1", "Namespace")):
+            client.add_data(obj)
+        sig, _ = audit_sig(client)
+        assert sig == cold_sig
+        # exactly once: the NEXT restore sees a clean (empty) root —
+        # outcome none, no second quarantine sample
+        second = SnapshotLoader(str(snap_dir)).restore(
+            fresh_client(), kube
+        )
+        assert second == "none"
+        assert outcome_counts().get("quarantined", 0) == before_q + 1
+        assert len(os.listdir(qdir)) == 1
+
+    def test_corrupt_manifest_is_quarantined_once(self, tmp_path):
+        kube, sig = self._written(tmp_path)
+
+        def mutate(path):
+            blob = open(path).read()
+            open(path, "w").write(blob.replace('"schema": 1', '"schema": 9'))
+
+        self._corrupt(tmp_path, snapfmt.MANIFEST, mutate)
+        self._assert_quarantined_once(tmp_path, kube, sig)
+
+    def test_truncated_arrays_are_quarantined_once(self, tmp_path):
+        kube, sig = self._written(tmp_path)
+
+        def mutate(path):
+            blob = open(path, "rb").read()
+            open(path, "wb").write(blob[: max(1, len(blob) // 3)])
+
+        self._corrupt(tmp_path, snapfmt.ARRAYS, mutate)
+        self._assert_quarantined_once(tmp_path, kube, sig)
+
+    def test_wrong_hmac_key_is_quarantined_once(self, tmp_path):
+        kube, sig = self._written(tmp_path)
+
+        def mutate(path):
+            manifest = json.load(open(path))
+            manifest["hmac"] = "f" * 64
+            json.dump(manifest, open(path, "w"))
+
+        self._corrupt(tmp_path, snapfmt.MANIFEST, mutate)
+        self._assert_quarantined_once(tmp_path, kube, sig)
+
+    def test_injected_corruption_point_quarantines(
+        self, tmp_path, fault_plane
+    ):
+        """The seeded snapshot.corrupt fault point: post-seal payload
+        validation fails -> the quarantine path, deterministically."""
+        kube, sig = self._written(tmp_path)
+        fault_plane.add(
+            faults.SNAPSHOT_CORRUPT, FaultRule(mode="error", count=1)
+        )
+        self._assert_quarantined_once(tmp_path, kube, sig)
+
+    def test_readmostly_consumer_never_quarantines(self, tmp_path):
+        """A fleet replica adopting a SHARED dir (resync=False) must not
+        move other processes' warmth aside, however corrupt — the dir's
+        owner (the audit role) does that."""
+        kube, _sig = self._written(tmp_path)
+
+        def mutate(path):
+            open(path, "w").write("{not json")
+
+        self._corrupt(tmp_path, snapfmt.MANIFEST, mutate)
+        listing = sorted(os.listdir(str(tmp_path)))
+        before_q = outcome_counts().get("quarantined", 0)
+        outcome = SnapshotLoader(str(tmp_path)).restore(
+            fresh_client(), InMemoryKube(), resync=False
+        )
+        assert outcome == "fallback"
+        assert sorted(os.listdir(str(tmp_path))) == listing
+        assert outcome_counts().get("quarantined", 0) == before_q
+
+    def test_older_snapshot_still_restores_after_quarantine(self, tmp_path):
+        """Corrupt NEWEST + valid older: the owner quarantines the bad
+        one and warm-restores from the older — quarantine never costs
+        warmth that exists."""
+        kube = build_cluster(n=6)
+        client = make_client(kube)
+        audit_sig(client)
+        snapper = Snapshotter(client, str(tmp_path), capture_delta=False)
+        first = snapper.write_once()
+        snapper._last_write = 0.0
+        second = snapper.write_once()
+        assert first and second and first != second
+        with open(os.path.join(second, snapfmt.MANIFEST), "w") as f:
+            f.write("{not json")
+        before_q = outcome_counts().get("quarantined", 0)
+        outcome = SnapshotLoader(str(tmp_path)).restore(
+            fresh_client(), kube
+        )
+        assert outcome == "restored"
+        assert outcome_counts().get("quarantined", 0) == before_q + 1
+        assert len(snapfmt.list_snapshots(str(tmp_path))) == 1
+
+
+class TestDispatchGate:
+    """The revocable mesh dispatch gate (parallel/mesh.py): revoke()
+    unblocks the fleet from a wedged holder, and a waiter that was
+    already parked on the revoked generation MIGRATES to the current one
+    instead of dispatching under the abandoned lock (which would
+    unserialize it against new-generation holders)."""
+
+    def test_revoke_frees_new_acquirers_while_holder_wedged(self):
+        from gatekeeper_tpu.parallel.mesh import DispatchGate
+
+        gate = DispatchGate()
+        held = gate.acquire()
+        assert held is not None
+        assert gate.acquire(timeout=0.05) is None  # busy
+        gate.revoke()
+        fresh = gate.acquire(timeout=1.0)
+        assert fresh is not None, "revoked gate must admit new holders"
+        gate.release(fresh)
+        gate.release(held)  # the abandoned holder's late release: no-op
+
+    def test_pre_revoke_waiter_migrates_to_current_generation(self):
+        from gatekeeper_tpu.parallel.mesh import DispatchGate
+
+        gate = DispatchGate()
+        wedged = gate.acquire()
+        order = []
+        waiter_in = threading.Event()
+
+        def old_gen_waiter():
+            waiter_in.set()
+            tok = gate.acquire()  # parks on the soon-revoked generation
+            order.append("waiter")
+            gate.release(tok)
+
+        t = threading.Thread(target=old_gen_waiter, daemon=True)
+        t.start()
+        assert waiter_in.wait(2.0)
+        time.sleep(0.05)  # let it block on the old lock
+        gate.revoke()
+        new_holder = gate.acquire(timeout=1.0)
+        assert new_holder is not None
+        # the wedged holder unsticks and releases the OLD lock: the
+        # waiter wakes, must NOT proceed (stale generation) while the
+        # new generation is held
+        gate.release(wedged)
+        time.sleep(0.15)
+        assert order == [], (
+            "waiter ran under the abandoned generation, unserialized "
+            "against the new-generation holder"
+        )
+        order.append("new-holder-done")
+        gate.release(new_holder)
+        t.join(timeout=2.0)
+        assert not t.is_alive()
+        assert order == ["new-holder-done", "waiter"]

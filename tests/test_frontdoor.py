@@ -1,0 +1,248 @@
+"""Front-door resilience (ISSUE 8): the bounded single retry onto a
+different live backend, health-based ejection, probing readmission, and
+the supervisor's backend-swap hook.  All against stub HTTP backends —
+no replica spawn, so this runs everywhere tier-1 does."""
+
+import http.client
+import json
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from gatekeeper_tpu.fleet.frontdoor import ROUND_ROBIN, FrontDoor
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class _Stub:
+    """Minimal backend: answers POSTs with its own name and /healthz ok."""
+
+    def __init__(self, name: str, port: int = 0):
+        self.name = name
+        outer = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _reply(self, code, body: bytes):
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                self._reply(200, b"ok")
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(n)
+                self._reply(
+                    200, json.dumps({"served_by": outer.name}).encode()
+                )
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", port), H)
+        self.port = self.server.server_address[1]
+        threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        ).start()
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def _post(port: int, body: bytes = b"{}"):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        conn.request("POST", "/v1/admit", body=body,
+                     headers={"Content-Type": "application/json"})
+        r = conn.getresponse()
+        return r.status, dict(r.getheaders()), r.read()
+    finally:
+        conn.close()
+
+
+def wait_until(cond, timeout_s=5.0, step_s=0.02):
+    end = time.monotonic() + timeout_s
+    while time.monotonic() < end:
+        if cond():
+            return True
+        time.sleep(step_s)
+    return cond()
+
+
+@pytest.fixture()
+def live_backend():
+    stub = _Stub("live")
+    yield stub
+    stub.stop()
+
+
+class TestBoundedRetry:
+    def test_refused_backend_retries_once_onto_live(self, live_backend):
+        """The satellite regression: a refused backend connection must be
+        retried (exactly once) on a DIFFERENT live backend — never a 502
+        while a live backend exists."""
+        dead_port = _free_port()
+        door = FrontDoor(
+            [{"host": "127.0.0.1", "port": dead_port, "replica_id": "dead"},
+             {"host": "127.0.0.1", "port": live_backend.port,
+              "replica_id": "live"}],
+            policy=ROUND_ROBIN, probe_interval_s=3600.0,
+        ).start()
+        try:
+            for _ in range(6):
+                st, hd, body = _post(door.port)
+                assert st == 200
+                assert json.loads(body)["served_by"] == "live"
+                assert hd.get("X-GK-Replica") == "live"
+            stats = door.stats()
+            by_id = {b["replica_id"]: b for b in stats["backends"]}
+            assert by_id["live"]["served"] == 6
+            # the refused backend was ejected on its FIRST refusal, so
+            # later requests never even tried it
+            assert by_id["dead"]["ejected"] is True
+            assert by_id["dead"]["errors"] <= 2
+            assert stats["retries"] >= 1
+        finally:
+            door.stop()
+
+    def test_all_backends_down_is_an_explicit_502(self):
+        door = FrontDoor(
+            [("127.0.0.1", _free_port()), ("127.0.0.1", _free_port())],
+            probe_interval_s=3600.0,
+        ).start()
+        try:
+            st, _hd, body = _post(door.port)
+            assert st == 502
+            assert b"no fleet backend answered" in body
+        finally:
+            door.stop()
+
+    def test_retry_is_bounded_to_one(self, live_backend):
+        """Three dead backends + one live under round robin: a request
+        whose first AND second choices are dead must 502 (the retry
+        budget is one), until ejection converges the live set."""
+        door = FrontDoor(
+            [{"host": "127.0.0.1", "port": _free_port(),
+              "replica_id": f"dead{i}"} for i in range(3)]
+            + [{"host": "127.0.0.1", "port": live_backend.port,
+                "replica_id": "live"}],
+            policy=ROUND_ROBIN, probe_interval_s=3600.0,
+        ).start()
+        try:
+            codes = [_post(door.port)[0] for _ in range(8)]
+            assert 502 in codes or all(c == 200 for c in codes)
+            # ejection converges: once the dead trio is ejected, every
+            # request lands on the live backend directly
+            assert wait_until(lambda: all(
+                b["ejected"] for b in door.stats()["backends"]
+                if b["replica_id"].startswith("dead")
+            ))
+            assert all(_post(door.port)[0] == 200 for _ in range(4))
+        finally:
+            door.stop()
+
+
+class TestEjectionReadmission:
+    def test_dead_backend_readmitted_when_it_returns(self):
+        port = _free_port()
+        live = _Stub("a")
+        door = FrontDoor(
+            [{"host": "127.0.0.1", "port": port, "replica_id": "flappy"},
+             {"host": "127.0.0.1", "port": live.port, "replica_id": "a"}],
+            policy=ROUND_ROBIN, probe_interval_s=0.05,
+        ).start()
+        try:
+            _post(door.port)  # trips the refused->eject path
+            assert wait_until(
+                lambda: door.stats()["backends"][0]["ejected"]
+            )
+            # the replica comes back on the SAME port: the prober readmits
+            revived = _Stub("flappy", port=port)
+            try:
+                assert wait_until(
+                    lambda: not door.stats()["backends"][0]["ejected"]
+                ), "prober never readmitted the revived backend"
+                served = {
+                    json.loads(_post(door.port)[2])["served_by"]
+                    for _ in range(8)
+                }
+                assert served == {"flappy", "a"}
+            finally:
+                revived.stop()
+        finally:
+            door.stop()
+            live.stop()
+
+    def test_set_backend_repoints_and_readmits(self, live_backend):
+        """The supervisor's restart hook: the replica comes back on a
+        fresh ephemeral port; set_backend re-points the named entry."""
+        door = FrontDoor(
+            [{"host": "127.0.0.1", "port": _free_port(),
+              "replica_id": "r0"}],
+            probe_interval_s=3600.0,
+        ).start()
+        try:
+            assert _post(door.port)[0] == 502
+            assert door.set_backend(
+                "r0", "127.0.0.1", live_backend.port) is True
+            st, _hd, body = _post(door.port)
+            assert st == 200
+            assert json.loads(body)["served_by"] == "live"
+            b = door.stats()["backends"][0]
+            assert b["port"] == live_backend.port
+            assert b["ejected"] is False
+            assert door.set_backend("nope", "127.0.0.1", 1) is False
+        finally:
+            door.stop()
+
+    def test_suspend_takes_backend_out_of_rotation(self, live_backend):
+        second = _Stub("b")
+        door = FrontDoor(
+            [{"host": "127.0.0.1", "port": live_backend.port,
+              "replica_id": "live"},
+             {"host": "127.0.0.1", "port": second.port,
+              "replica_id": "b"}],
+            policy=ROUND_ROBIN, probe_interval_s=3600.0,
+        ).start()
+        try:
+            assert door.suspend("b") is True
+            served = {
+                json.loads(_post(door.port)[2])["served_by"]
+                for _ in range(6)
+            }
+            assert served == {"live"}
+            assert door.suspend("ghost") is False
+        finally:
+            door.stop()
+            second.stop()
+
+    def test_healthz_counts_ejected_backends_dead(self):
+        door = FrontDoor(
+            [("127.0.0.1", _free_port())], probe_interval_s=3600.0,
+        ).start()
+        try:
+            _post(door.port)  # refused -> ejected
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", door.port, timeout=5)
+            conn.request("GET", "/healthz")
+            resp = conn.getresponse()
+            assert resp.status == 503
+            resp.read()
+            conn.close()
+        finally:
+            door.stop()

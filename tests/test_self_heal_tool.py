@@ -1,0 +1,43 @@
+"""Tier-1 wiring for tools/check_self_heal.py: two supervised replicas
+behind the front door survive a mid-stream SIGKILL with zero failed
+admissions and zero verdict divergence, and the victim auto-restarts
+warm from the shared snapshot.  Skips cleanly where subprocess spawn is
+unavailable (same contract as test_fleet_parity_tool)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+import check_self_heal as chk  # noqa: E402
+
+from .test_snapshot_concurrent import spawn_available
+
+
+@spawn_available
+def test_fleet_self_heals_under_kill():
+    assert chk.run_checks() == []
+
+
+def test_verdict_checker_flags_divergence():
+    problems = []
+    chk._check_verdict(
+        0,
+        b'{"response": {"allowed": false, '
+        b'"status": {"message": "[denied by a] wrong", "code": 403}}}',
+        [(False, ["right"])],
+        problems,
+    )
+    assert problems and "diverged" in problems[0]
+
+
+def test_verdict_checker_accepts_match():
+    problems = []
+    chk._check_verdict(
+        0,
+        b'{"response": {"allowed": false, '
+        b'"status": {"message": "[denied by a] right", "code": 403}}}',
+        [(False, ["right"])],
+        problems,
+    )
+    assert problems == []
