@@ -29,5 +29,11 @@ uninstall:
 	kubectl delete -f deploy/gatekeeper.yaml --ignore-not-found
 
 .PHONY: lint
-lint:
+lint:  ## gklint invariants + observability/parity conformance checks
 	python -m compileall -q gatekeeper_tpu
+	python tools/gklint.py gatekeeper_tpu/
+	python tools/check_observability.py
+
+.PHONY: lint-baseline
+lint-baseline:  ## accept current gklint findings into .gklint-baseline.json
+	python tools/gklint.py --write-baseline

@@ -593,7 +593,9 @@ def bench_render() -> dict:
                 if key and key[0] in out:
                     out[key[0]] += v
         except Exception:
-            pass
+            # best-effort bench telemetry: a registry shape change costs
+            # the tier breakdown, not the run — but say so in the record
+            out["error"] = "render_cells_total unavailable"
         return out
 
     c.review(req(make_pods(1, seed=9, violation_rate=1.0)[0], 1))  # warm
@@ -2096,7 +2098,11 @@ def bench_fleet() -> dict:
         for t in clients:
             t.start()
         for t in clients:
-            t.join()
+            # bounded: a wedged driver must fail the bench, not hang it
+            t.join(timeout=600.0)
+            if t.is_alive():
+                raise RuntimeError("bench latency client wedged (no "
+                                   "result within 600s)")
         http_wall = time.perf_counter() - tt0
         http_rps = len(threads_out) / http_wall if threads_out else 0.0
 
@@ -2138,7 +2144,11 @@ def bench_fleet() -> dict:
             for t in streams:
                 t.start()
             for t in streams:
-                t.join()
+                # bounded: a wedged replica stream fails the round loudly
+                t.join(timeout=600.0)
+                if t.is_alive():
+                    raise RuntimeError("fleet stream thread wedged (no "
+                                       "completion within 600s)")
             # the combined rate is measured over the union of the
             # replicas' TIMED windows (child-reported wall stamps,
             # warmup excluded) — the parent's own wall would bill each

@@ -37,6 +37,7 @@ from ..obs import trace as obstrace
 from ..process.excluder import AUDIT, Excluder
 from ..target.target import AugmentedUnstructured
 from ..util import KNOWN_ENFORCEMENT_ACTIONS, get_enforcement_action
+from ..util import join_thread
 
 log = gklog.get("audit")
 
@@ -150,6 +151,11 @@ class AuditManager:
     # ---- loop (manager.go:406-431) ----------------------------------------
 
     def start(self):
+        # idempotent: a second start() must not spawn a second audit loop
+        # (two concurrent sweeps would race the driver and double every
+        # status write) nor orphan the first thread
+        if self._thread is not None and self._thread.is_alive():
+            return
         self._stop.clear()
         self._thread = threading.Thread(
             target=self._loop, name="audit", daemon=True
@@ -159,7 +165,7 @@ class AuditManager:
     def stop(self):
         self._stop.set()
         if self._thread:
-            self._thread.join(timeout=2.0)
+            join_thread(self._thread, 2.0, "audit loop")
             self._thread = None
 
     def _loop(self):

@@ -23,6 +23,7 @@ from cryptography.x509.oid import NameOID
 
 from .. import logging as gklog
 from ..kube.inmem import InMemoryKube, NotFound
+from ..util import join_thread
 
 log = gklog.get("cert-rotation")
 
@@ -165,7 +166,10 @@ class CertRotator:
         for k, v in (secret.get("data") or {}).items():
             try:
                 out[k] = base64.b64decode(v).decode()
-            except Exception:
+            except (TypeError, ValueError):
+                # not base64 / not utf-8: skip the one bad key, keep the
+                # rest of the secret usable (UnicodeDecodeError and
+                # binascii.Error are ValueError subclasses)
                 continue
         out.update(secret.get("stringData") or {})
         return out
@@ -298,5 +302,5 @@ class CertRotator:
     def stop(self):
         self._stop.set()
         if self._thread:
-            self._thread.join(timeout=2.0)
+            join_thread(self._thread, 2.0, "cert rotator loop")
             self._thread = None

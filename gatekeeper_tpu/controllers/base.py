@@ -17,6 +17,7 @@ from typing import Optional, Tuple
 from .. import logging as gklog
 from ..kube.inmem import WatchEvent
 from ..watch.manager import ControllerSwitch, Registrar
+from ..util import join_thread
 
 GVK = Tuple[str, str, str]
 
@@ -61,6 +62,11 @@ class Controller:
 
     def start(self):
         assert self.registrar is not None, f"{self.name}: no registrar bound"
+        # idempotent: a double start must not leak a second worker loop
+        # draining the same registrar queue (events would split between
+        # the two at random)
+        if self._thread is not None and self._thread.is_alive():
+            return
         self._stop.clear()
         self._thread = threading.Thread(
             target=self._run, daemon=True, name=f"ctrl-{self.name}"
@@ -78,7 +84,7 @@ class Controller:
     def stop(self):
         self._stop.set()
         if self._thread:
-            self._thread.join(timeout=2.0)
+            join_thread(self._thread, 2.0, f"controller {self.name}")
             self._thread = None
 
     def drain(self, timeout: float = 5.0):

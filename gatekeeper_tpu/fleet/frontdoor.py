@@ -50,7 +50,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional, Sequence, Tuple
 
 from .. import logging as gklog
-from ..util import close_listener
+from ..util import close_listener, join_thread
 
 log = gklog.get("fleet.frontdoor")
 
@@ -226,7 +226,7 @@ class FrontDoor:
                     conn.close()
                     if resp.status == 200:
                         self._readmit(b, "readiness probe succeeded")
-                except Exception:
+                except (OSError, http.client.HTTPException):
                     pass  # still down; next interval probes again
 
     # ---- forwarding ------------------------------------------------------
@@ -251,8 +251,8 @@ class FrontDoor:
             if conn is not None:
                 try:
                     conn.close()
-                except Exception:
-                    pass
+                except OSError:
+                    pass  # dropping a dead connection; close is best-effort
 
     def forward(self, method: str, path: str, body: bytes,
                 headers: dict) -> Tuple[int, dict, bytes, str]:
@@ -423,7 +423,7 @@ class FrontDoor:
     def stop(self):
         self._prober_stop.set()
         if self._prober is not None:
-            self._prober.join(timeout=5.0)
+            join_thread(self._prober, 5.0, "front-door prober")
             self._prober = None
         if self._server is not None:
             self._server.shutdown()

@@ -56,6 +56,10 @@ import time
 from collections import deque
 from typing import Dict, List, Optional, Sequence
 
+import logging
+
+log = logging.getLogger("gatekeeper.fleet.replica")
+
 REPO_ROOT = os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))))
 
@@ -106,7 +110,10 @@ def _seed_namespaces(app) -> int:
             })
             n += 1
         except Exception:
-            pass  # already present
+            # already present (Conflict from the in-memory store, a 409
+            # from an HTTP kube) — anything else is still non-fatal for
+            # serving, but must not vanish silently
+            log.debug("namespace seed skipped for %r", ns, exc_info=True)
     return n
 
 
@@ -339,6 +346,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         # serving — the supervisor's command-pipe liveness
                         # is what must catch it
                         _faults.fire(_faults.REPLICA_WEDGE)
+                    # gklint: disable=swallowed-exception -- the injected
+                    # error IS the simulated failure: dropping exactly one
+                    # command is the chaos contract (docs/failure-modes.md)
                     except Exception:
                         pass  # error-mode rules: drop this command only
                 line = line.strip()
@@ -457,16 +467,16 @@ def _attach_pipes(proc: subprocess.Popen, replica_id: str) -> _Pipes:
                     continue  # stray log line on stdout
                 if isinstance(msg, dict):
                     pipes.route(msg)
-        except Exception:
-            pass
+        except (OSError, ValueError):
+            pass  # pipe torn down mid-read (child died / parent closing)
         pipes.eof()
 
     def _read_stderr():
         try:
             for line in proc.stderr:
                 pipes.stderr_tail.append(line)
-        except Exception:
-            pass
+        except (OSError, ValueError):
+            pass  # pipe torn down mid-read (child died / parent closing)
 
     for target, name in ((_read_stdout, "out"), (_read_stderr, "err")):
         threading.Thread(
@@ -584,19 +594,22 @@ class ReplicaHandle:
         except (ProcessLookupError, PermissionError):
             try:
                 self.proc.kill()
-            except Exception:
-                pass
+            except OSError:
+                pass  # already gone
         try:
             self.proc.wait(timeout=10)
-        except Exception:
-            pass
+        except subprocess.TimeoutExpired:
+            # SIGKILL that a process survives 10s is an operator problem
+            # (unkillable D-state), never a silent one
+            log.warning("replica %s did not exit within 10s of SIGKILL",
+                        self.replica_id)
 
     def stop(self, timeout_s: float = 15.0):
         if self.proc.poll() is None:
             try:
                 self.proc.stdin.close()  # the lifetime signal
-            except Exception:
-                pass
+            except (OSError, ValueError):
+                pass  # pipe already closed by a dead child
             try:
                 self.proc.wait(timeout=timeout_s)
             except subprocess.TimeoutExpired:

@@ -55,6 +55,7 @@ from .. import logging as gklog
 from ..metrics.catalog import record_replica_restart, record_replica_state
 from ..syncutil import Backoff
 from .replica import ReplicaHandle, spawn_replica
+from ..util import join_thread
 
 log = gklog.get("fleet.supervisor")
 
@@ -510,7 +511,7 @@ class ReplicaSupervisor:
         escalating to the process-group kill)."""
         self._stop.set()
         if self._monitor is not None:
-            self._monitor.join(timeout=10.0)
+            join_thread(self._monitor, 10.0, "replica supervisor monitor")
             self._monitor = None
         with self._mu:
             slots = list(self._slots.values())

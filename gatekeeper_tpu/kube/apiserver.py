@@ -288,8 +288,8 @@ class KubeApiServer:
             try:
                 self._send(h, 500, _status_doc(
                     500, "InternalError", f"{type(exc).__name__}: {exc}"))
-            except Exception:
-                pass
+            except OSError:
+                pass  # client already hung up; nothing left to tell it
 
     def _send(self, h, code: int, doc: dict):
         payload = json.dumps(doc).encode()
@@ -595,8 +595,8 @@ class KubeApiServer:
             self._unsubscribe(info.gvk, q)
             try:
                 write_chunk(b"")  # terminating chunk
-            except Exception:
-                pass
+            except OSError:
+                pass  # watcher already disconnected mid-stream
 
 
 class _GoneError(Exception):

@@ -201,8 +201,8 @@ class HttpKube:
         if conn is not None:
             try:
                 conn.close()
-            except Exception:
-                pass
+            except OSError:
+                pass  # dropping a broken connection; close is best-effort
             self._local.conn = None
 
     def _request(self, method: str, path: str,
@@ -499,8 +499,8 @@ class HttpWatcher:
 
             try:
                 sock.shutdown(_socket.SHUT_RDWR)
-            except Exception:
-                pass
+            except OSError:
+                pass  # already closed by the peer; shutdown is the nudge
         self.queue.put(None)
 
     # -- producer side --
@@ -575,8 +575,8 @@ class HttpWatcher:
             self._sock = None
             try:
                 conn.sock and conn.sock.close()
-            except Exception:
-                pass
+            except OSError:
+                pass  # stream teardown of an already-dead socket
 
     def _handle(self, ev: dict):
 

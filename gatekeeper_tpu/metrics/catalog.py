@@ -572,6 +572,31 @@ def _global() -> Registry:
     return registry
 
 
+#: site -> count of telemetry recordings swallowed by the record_* guards.
+#: Swallowing is the contract (a metrics-layer defect must never fail the
+#: evaluation being measured) but the swallow itself must be observable:
+#: the first drop per site logs with the traceback, the rest only count.
+RECORD_DROPS: Dict[str, int] = {}
+
+
+def record_dropped(site: str) -> None:
+    """Account one swallowed telemetry recording (see RECORD_DROPS)."""
+    try:
+        n = RECORD_DROPS.get(site, 0) + 1
+        RECORD_DROPS[site] = n
+        if n == 1:
+            import logging
+
+            logging.getLogger("gatekeeper.metrics").warning(
+                "telemetry recording failed at %s (guarded by contract; "
+                "further drops only counted)", site, exc_info=True,
+            )
+    # the drop ACCOUNTING itself must never raise back into the hot path
+    # gklint: disable=swallowed-exception -- last-ditch guard under a guard
+    except Exception:
+        pass
+
+
 def record_stage(measure: Measure, seconds: float,
                  tags: Optional[Dict[str, str]] = None):
     """One stage-duration sample into the new per-stage histograms
@@ -584,15 +609,15 @@ def record_stage(measure: Measure, seconds: float,
             measure, seconds, tags,
             exemplar_trace_id=_current_trace_id(),
         )
-    except Exception:  # pragma: no cover - telemetry never blocks eval
-        pass
+    except Exception:  # telemetry never blocks eval
+        record_dropped("record_stage")
 
 
 def record_batch_size(n: int):
     try:
         _global().record(BATCH_SIZE_M, float(n))
-    except Exception:  # pragma: no cover - telemetry never blocks eval
-        pass
+    except Exception:  # telemetry never blocks eval
+        record_dropped("record_batch_size")
 
 
 def record_snapshot_write(seconds: float, nbytes: int):
@@ -602,15 +627,15 @@ def record_snapshot_write(seconds: float, nbytes: int):
         reg = _global()
         reg.record(SNAPSHOT_WRITE_M, seconds)
         reg.record(SNAPSHOT_BYTES_M, float(nbytes))
-    except Exception:  # pragma: no cover - telemetry never blocks eval
-        pass
+    except Exception:  # telemetry never blocks eval
+        record_dropped("record_snapshot_write")
 
 
 def record_snapshot_load(seconds: float):
     try:
         _global().record(SNAPSHOT_LOAD_M, seconds)
-    except Exception:  # pragma: no cover - telemetry never blocks eval
-        pass
+    except Exception:  # telemetry never blocks eval
+        record_dropped("record_snapshot_load")
 
 
 def record_snapshot_outcome(outcome: str):
@@ -618,8 +643,8 @@ def record_snapshot_outcome(outcome: str):
     disabled)."""
     try:
         _global().record(SNAPSHOT_RESTORE_M, 1.0, {"outcome": outcome})
-    except Exception:  # pragma: no cover - telemetry never blocks eval
-        pass
+    except Exception:  # telemetry never blocks eval
+        record_dropped("record_snapshot_outcome")
 
 
 def record_render_cells(counts: Dict[str, int]):
@@ -634,8 +659,8 @@ def record_render_cells(counts: Dict[str, int]):
                 reg.record(
                     RENDER_CELLS_M, float(n), {"plan": tier}, count=n
                 )
-    except Exception:  # pragma: no cover - telemetry never blocks eval
-        pass
+    except Exception:  # telemetry never blocks eval
+        record_dropped("record_render_cells")
 
 
 def record_audit_shard(rows: int, pack_s: float, dispatch_s: float,
@@ -652,8 +677,8 @@ def record_audit_shard(rows: int, pack_s: float, dispatch_s: float,
         reg.record(AUDIT_SHARD_PACK_M, pack_s, tags, exemplar_trace_id=tid)
         reg.record(AUDIT_SHARD_DISPATCH_M, dispatch_s, tags,
                    exemplar_trace_id=tid)
-    except Exception:  # pragma: no cover - telemetry never blocks eval
-        pass
+    except Exception:  # telemetry never blocks eval
+        record_dropped("record_audit_shard")
 
 
 def _replica_tags() -> Dict[str, str]:
@@ -667,8 +692,8 @@ def record_replica_up():
     replica runtime).  Guarded like record_stage."""
     try:
         _global().record(REPLICA_UP_M, 1.0, _replica_tags())
-    except Exception:  # pragma: no cover - telemetry never blocks startup
-        pass
+    except Exception:  # telemetry never blocks startup
+        record_dropped("record_replica_up")
 
 
 def record_batcher_state(target_size: int, deadline_ms: float,
@@ -682,8 +707,8 @@ def record_batcher_state(target_size: int, deadline_ms: float,
         reg.record(BATCH_TARGET_M, float(target_size), tags)
         reg.record(BATCH_DEADLINE_M, float(deadline_ms), tags)
         reg.record(OFFERED_LOAD_M, float(offered_load_rps), tags)
-    except Exception:  # pragma: no cover - telemetry never blocks eval
-        pass
+    except Exception:  # telemetry never blocks eval
+        record_dropped("record_batcher_state")
 
 
 def record_replica_restart(replica_id: str, reason: str):
@@ -694,8 +719,8 @@ def record_replica_restart(replica_id: str, reason: str):
             REPLICA_RESTARTS_M, 1.0,
             {"replica_id": replica_id, "reason": reason},
         )
-    except Exception:  # pragma: no cover - telemetry never blocks healing
-        pass
+    except Exception:  # telemetry never blocks healing
+        record_dropped("record_replica_restart")
 
 
 def record_replica_state(replica_id: str, state_code: int):
@@ -705,16 +730,16 @@ def record_replica_state(replica_id: str, state_code: int):
         _global().record(
             REPLICA_STATE_M, float(state_code), {"replica_id": replica_id}
         )
-    except Exception:  # pragma: no cover - telemetry never blocks healing
-        pass
+    except Exception:  # telemetry never blocks healing
+        record_dropped("record_replica_state")
 
 
 def record_mesh_stall():
     """One mesh-collective dispatch abandoned by the watchdog."""
     try:
         _global().record(MESH_STALL_M, 1.0)
-    except Exception:  # pragma: no cover - telemetry never blocks eval
-        pass
+    except Exception:  # telemetry never blocks eval
+        record_dropped("record_mesh_stall")
 
 
 def record_mesh_width(width: int):
@@ -722,8 +747,8 @@ def record_mesh_width(width: int):
     degradation)."""
     try:
         _global().record(MESH_WIDTH_M, float(width))
-    except Exception:  # pragma: no cover - telemetry never blocks eval
-        pass
+    except Exception:  # telemetry never blocks eval
+        record_dropped("record_mesh_width")
 
 
 def record_cache(cache: str, hit: bool, n: int = 1):
@@ -737,5 +762,5 @@ def record_cache(cache: str, hit: bool, n: int = 1):
             {"cache": cache, "outcome": "hit" if hit else "miss"},
             count=n,
         )
-    except Exception:  # pragma: no cover - telemetry never blocks eval
-        pass
+    except Exception:  # telemetry never blocks eval
+        record_dropped("record_cache")

@@ -185,7 +185,12 @@ class _Handler(BaseHTTPRequestHandler):
             try:
                 hook(self.registry)
             except Exception:  # a hook defect must never break the scrape
-                pass
+                from .catalog import record_dropped
+
+                record_dropped(
+                    "collect_hook:"
+                    + getattr(hook, "__name__", repr(hook))
+                )
         render, ctype = negotiate(self.headers.get("Accept"))
         self._send(200, ctype, render(self.registry).encode())
 

@@ -61,6 +61,10 @@ def load():
         if mode == "0":
             return None
         try:
+            # gklint: disable=blocking-under-lock -- the lock EXISTS to
+            # serialize the one-time native-extension compile; concurrent
+            # first callers must wait for the single build, and every
+            # later call is a cached-path no-op
             so = build()
             spec = importlib.util.spec_from_file_location("_gknative", so)
             mod = importlib.util.module_from_spec(spec)

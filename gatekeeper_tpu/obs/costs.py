@@ -45,6 +45,8 @@ import time
 from collections import deque
 from typing import Dict, Iterable, List, Optional, Tuple
 
+from ..metrics.catalog import record_dropped as _record_dropped
+
 # the internal overflow key; exported as the "other" rollup
 OTHER = "other"
 
@@ -422,16 +424,16 @@ def record_dispatch(kind_constraints: Dict[str, int], device_s: float,
                     rows: int, path: str = "review"):
     try:
         _LEDGER.record_dispatch(kind_constraints, device_s, rows, path)
-    except Exception:  # pragma: no cover - telemetry never blocks eval
-        pass
+    except Exception:  # telemetry never blocks eval
+        _record_dropped("costs.record_dispatch")
 
 
 def record_render(entries: Iterable[Tuple], plan_s: float = 0.0,
                   interp_s: float = 0.0):
     try:
         _LEDGER.record_render(entries, plan_s, interp_s)
-    except Exception:  # pragma: no cover - telemetry never blocks eval
-        pass
+    except Exception:  # telemetry never blocks eval
+        _record_dropped("costs.record_render")
 
 
 def collect_hook(registry):
@@ -439,5 +441,5 @@ def collect_hook(registry):
     never break the /metrics scrape)."""
     try:
         _LEDGER.collect(registry)
-    except Exception:  # pragma: no cover - telemetry never blocks scrape
-        pass
+    except Exception:  # telemetry never blocks scrape
+        _record_dropped("costs.collect_hook")

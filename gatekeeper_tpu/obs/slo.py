@@ -38,6 +38,8 @@ import time
 from collections import deque
 from typing import Callable, Dict, List, Optional
 
+from ..metrics.catalog import record_dropped as _record_dropped
+
 # window name -> seconds; PAIRS are (name, short, long, threshold)
 WINDOWS: Dict[str, float] = {
     "5m": 300.0, "30m": 1800.0, "1h": 3600.0, "6h": 21600.0,
@@ -254,7 +256,16 @@ class SLOEngine:
                 try:
                     cb(*key)
                 except Exception:
-                    pass  # a consumer defect must not break evaluation
+                    # a consumer defect must not break evaluation — but an
+                    # alert that silently went nowhere is an incident
+                    # nobody paged on; alerts are edge-triggered so this
+                    # cannot spam
+                    import logging
+
+                    logging.getLogger("gatekeeper.slo").warning(
+                        "SLO alert consumer failed for %s", key,
+                        exc_info=True,
+                    )
         return out
 
     def degraded(self) -> bool:
@@ -367,19 +378,19 @@ def observe_admission(status: str, duration_s: float):
             ADMISSION_LATENCY, duration_s <= _ENGINE.admission_threshold_s
         )
         _ENGINE.record(FAIL_CLOSED_ERRORS, status != "error")
-    except Exception:  # pragma: no cover - telemetry never blocks eval
-        pass
+    except Exception:  # telemetry never blocks eval
+        _record_dropped("slo.observe_admission")
 
 
 def observe_audit_run():
     try:
         _ENGINE.observe_audit_run()
-    except Exception:  # pragma: no cover - telemetry never blocks audit
-        pass
+    except Exception:  # telemetry never blocks audit
+        _record_dropped("slo.observe_audit_run")
 
 
 def collect_hook(registry):
     try:
         _ENGINE.collect(registry)
-    except Exception:  # pragma: no cover - telemetry never blocks scrape
-        pass
+    except Exception:  # telemetry never blocks scrape
+        _record_dropped("slo.collect_hook")

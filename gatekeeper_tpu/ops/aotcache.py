@@ -68,7 +68,7 @@ def _record_cache(cache: str, hit: bool):
 
         record_cache(cache, hit)
     except Exception:  # pragma: no cover - metrics must never block eval
-        pass
+        log.debug("cache metric recording failed", exc_info=True)
 
 
 def _record_compile(seconds: float):
@@ -77,7 +77,7 @@ def _record_compile(seconds: float):
 
         record_stage(COMPILE_M, seconds)
     except Exception:  # pragma: no cover
-        pass
+        log.debug("compile metric recording failed", exc_info=True)
 
 
 def enable(cache_dir: str, read_mostly: Optional[bool] = None) -> bool:

@@ -142,7 +142,16 @@ class CircuitBreaker:
             try:
                 hook(old, new)
             except Exception:
-                pass
+                # a transition-hook defect must not wedge the breaker's
+                # state machine, but losing a degradation signal (mesh
+                # re-shard, SLO trip) silently would be worse — log it;
+                # transitions are rare so this cannot spam
+                import logging
+
+                logging.getLogger("gatekeeper.breaker").warning(
+                    "breaker transition hook failed (%s -> %s)", old, new,
+                    exc_info=True,
+                )
 
     def trip(self):
         """Force the breaker open (tests / admin)."""

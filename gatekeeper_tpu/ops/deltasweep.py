@@ -41,6 +41,7 @@ class NeedsFullSweep(Exception):
 import atexit as _atexit
 import threading as _threading
 import weakref as _weakref
+from ..util import join_thread
 
 _BG_THREADS = _weakref.WeakSet()
 
@@ -61,7 +62,7 @@ def _join_bg_threads():
     # tears down.
     BG_STOP.set()
     for t in list(_BG_THREADS):
-        t.join(timeout=120.0)
+        join_thread(t, 120.0, "background mask resolution")
 
 
 def spawn_bg(name: str, target):
@@ -154,7 +155,14 @@ class MaskSource:
                 try:
                     after(val)
                 except Exception:
-                    pass
+                    # the after-hook warms downstream executables; a
+                    # defect there costs the warm start, not correctness
+                    # — but it must be visible when it happens
+                    import logging
+
+                    logging.getLogger("gatekeeper.deltasweep").warning(
+                        "mask prefetch after-hook failed", exc_info=True,
+                    )
 
         spawn_bg("gk-mask-prefetch", run)
 

@@ -443,7 +443,8 @@ class TpuDriver(InterpDriver):
 
             record_breaker(self.breaker.status())
         except Exception:
-            pass
+            log.debug("breaker state metric recording failed",
+                      exc_info=True)
 
     def breaker_status(self) -> dict:
         """Health-endpoint view of the degradation ladder."""
@@ -2559,7 +2560,8 @@ class TpuDriver(InterpDriver):
             try:
                 _scatter_rows(placed, rows, host_rows)
             except Exception:  # pragma: no cover - warm-up is best-effort
-                pass
+                log.debug("scatter warm-up failed; first churn patch "
+                          "pays the compile instead", exc_info=True)
 
         from .deltasweep import spawn_bg
 
@@ -2907,6 +2909,11 @@ class TpuDriver(InterpDriver):
         # an interpreter sweep of the whole inventory (advisor r2)
         self._wait_ready_for_audit()
         with self._lock:
+            # gklint: disable=blocking-under-lock -- the audit sweep is
+            # the exclusive device owner by design: the driver lock holds
+            # for the [C,R] dispatch+fetch so admissions route to the
+            # np/interp tier instead of interleaving device work; a
+            # wedged dispatch is bounded by the mesh watchdog
             reviews, ordered, mask = self._audit_masks()
             if not reviews:
                 return [], ("" if tracing else None)
@@ -3050,6 +3057,12 @@ class TpuDriver(InterpDriver):
                 # call still recompiles) — a bounded one-time stall the
                 # foreground delta sweep would otherwise pay itself.
                 with DISPATCH_LOCK:
+                    # gklint: disable=blocking-under-lock -- PR 6 design:
+                    # the background warm must drain INSIDE the gate so
+                    # its collective launch order can never interleave
+                    # with a foreground sweep (the AllReduce rendezvous
+                    # deadlock this gate exists to prevent); the stall is
+                    # one bounded cold compile
                     delta_jit(
                         m, rows_pad, rv_slice, cs_d, cols_slice, gp_d
                     ).block_until_ready()
@@ -3296,6 +3309,9 @@ class TpuDriver(InterpDriver):
             for _attempt in (0, 1):
                 got = self._try_delta(K)
                 if got is None:
+                    # gklint: disable=blocking-under-lock -- same audit
+                    # exclusive-device-ownership contract as
+                    # _audit_device above (watchdog-bounded)
                     sweep = self._audit_sweep(K)
                     if sweep is None:
                         # same contract as InterpDriver: every registered

@@ -109,6 +109,9 @@ class ValueMap:
                     if v is not None:
                         vals[i] = float(v)
                         ok[i] = 1
+                # gklint: disable=swallowed-exception -- by contract a
+                # per-value extractor failure means "feature absent":
+                # ok[i] stays 0 and the kernel masks the cell out
                 except Exception:
                     pass
             self._vals, self._ok = vals, ok

@@ -40,6 +40,7 @@ interpreter both as renderer and as the device-mask exactness filter.
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 from dataclasses import dataclass, field
@@ -1271,6 +1272,9 @@ class RenderPool:
     _started = 0
 
     MIN_CELLS = int(os.environ.get("GK_RENDER_POOL_MIN", "16"))
+    # how long one interpreter cell may run before the coordinator starts
+    # logging that it is stuck (it keeps waiting — see map_ordered)
+    STUCK_CELL_WARN_S = float(os.environ.get("GK_RENDER_STUCK_WARN_S", "30"))
     WORKERS = max(1, int(os.environ.get(
         "GK_RENDER_WORKERS", str(min(4, os.cpu_count() or 1))
     )))
@@ -1321,7 +1325,32 @@ class RenderPool:
             tasks.append((slot, done))
         out = []
         for slot, done in tasks:
-            done.wait()
+            # the coordinator may be holding the driver lock (webhook
+            # deny-path rendering) — parking unboundedly on one wedged
+            # cell would wedge every admission behind it silently.  The
+            # cell is an interpreter evaluation, normally microseconds;
+            # keep waiting (killing a slow-but-progressing render would
+            # break result completeness) but make a stuck one loud.
+            # repo convention: <=0 means the warning is OFF (plain wait)
+            # — never a zero-timeout busy-spin; and clamp tiny values so
+            # a misconfigured threshold cannot log per-millisecond
+            warn_s = cls.STUCK_CELL_WARN_S
+            if warn_s <= 0:
+                # warning OFF — still never a bare unbounded wait (the
+                # analyzer's blocking-under-lock rule would rightly
+                # flag it through the driver-lock callers): poll on a
+                # long bound, silently
+                while not done.wait(timeout=3600.0):
+                    pass
+            else:
+                warn_s = max(1.0, warn_s)
+                waited = 0.0
+                while not done.wait(timeout=warn_s):
+                    waited += warn_s
+                    logging.getLogger("gatekeeper.renderplan").warning(
+                        "render cell stuck for %.0fs in the interpreter "
+                        "pool (driver lock may be held upstream)", waited,
+                    )
             if slot[1] is not None:
                 raise slot[1]
             out.append(slot[0])

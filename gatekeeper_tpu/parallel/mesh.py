@@ -28,6 +28,7 @@ from typing import Callable, Optional
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from ..util import join_thread
 
 
 class MeshDispatchStall(RuntimeError):
@@ -372,7 +373,7 @@ def pipelined_shard_commit(
                         record_shard(shard, hi - lo, pt0, pt1, ct0, ct1)
             finally:
                 stop.set()
-                t.join(timeout=5.0)
+                join_thread(t, 5.0, "shard packer")
         for li, i in enumerate(row_idx):
             x = row_leaves[li]
             shape = (target,) + x.shape[1:]

@@ -36,6 +36,7 @@ from ..obs import trace as obstrace
 from ..util import seal as sealmod
 from . import format as fmt
 from .format import SnapshotError
+from ..util import join_thread
 
 log = gklog.get("snapshot")
 
@@ -393,6 +394,11 @@ class Snapshotter:
         self.last_error: Optional[str] = None
 
     def start(self):
+        # idempotent: a second start() (warm-restore paths call it after
+        # App wiring) must not spawn a second writer loop — two loops
+        # would double the write cadence and race the retention prune
+        if self._thread is not None and self._thread.is_alive():
+            return
         self._stop.clear()
         self._thread = threading.Thread(
             target=self._loop, name="snapshotter", daemon=True
@@ -403,7 +409,7 @@ class Snapshotter:
         self._stop.set()
         self._wake.set()
         if self._thread is not None:
-            self._thread.join(timeout=5.0)
+            join_thread(self._thread, 5.0, "snapshotter loop")
             self._thread = None
 
     def notify_sweep(self):

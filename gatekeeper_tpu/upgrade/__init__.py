@@ -59,7 +59,10 @@ class UpgradeManager:
 
     def start(self):
         """Async on-start migration (upgrade controller.go adds the manager
-        as a Runnable)."""
+        as a Runnable).  Idempotent: one migration pass per process — a
+        second start() while (or after) the first runs is a no-op."""
+        if self._thread is not None:
+            return
         self._thread = threading.Thread(
             target=self._run, name="upgrade", daemon=True
         )

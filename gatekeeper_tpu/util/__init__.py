@@ -110,6 +110,28 @@ def replica_id() -> str:
     return os.environ.get("GK_REPLICA_ID", "")
 
 
+def join_thread(thread, timeout: float, what: str = "") -> bool:
+    """Bounded join with a post-join liveness check: returns True when
+    the thread actually exited, False (and logs a warning naming it) when
+    it is still alive after `timeout` — the caller proceeds with shutdown
+    instead of hanging behind a wedged worker (the PR 8 wedge class; the
+    static twin of this rule is gklint's `bare-join`).  None threads are
+    trivially 'joined'."""
+    if thread is None:
+        return True
+    thread.join(timeout=timeout)
+    if thread.is_alive():
+        import logging
+
+        logging.getLogger("gatekeeper.util").warning(
+            "thread %s still alive %.1fs after join%s — proceeding with "
+            "shutdown; it is daemonized and cannot pin exit",
+            thread.name, timeout, f" ({what})" if what else "",
+        )
+        return False
+    return True
+
+
 def close_listener(server, thread) -> None:
     """Tear down a socketserver-based listener for an idempotent
     ``start()``: ``shutdown()`` only when its serve_forever thread

@@ -175,6 +175,9 @@ class _Pump(threading.Thread):
             if faults.ENABLED:
                 try:
                     faults.fire(faults.WATCH_DELIVER, gvk=self.gvk)
+                # gklint: disable=swallowed-exception -- the injected error
+                # IS the simulated failure: dropping exactly this delivery
+                # is the chaos contract (docs/failure-modes.md)
                 except Exception:
                     continue  # injected delivery drop; the pump survives
             self.manager._fan_out(self.gvk, ev)
@@ -286,7 +289,11 @@ class WatchManager:
             try:
                 self._metrics_hook(len(self._pumps), self.intended().size())
             except Exception:
-                pass
+                import logging
+
+                logging.getLogger("gatekeeper_tpu.watch").debug(
+                    "watch metrics hook failed", exc_info=True
+                )
 
     # ---- introspection ----------------------------------------------------
 

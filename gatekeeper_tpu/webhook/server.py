@@ -27,6 +27,7 @@ from ..metrics.catalog import (
     record_stage,
 )
 from ..obs import trace as obstrace
+from ..util import join_thread
 from ..obs.debug import get_router
 from .namespacelabel import NamespaceLabelHandler
 from .policy import AdmissionResponse, ValidationHandler
@@ -493,7 +494,8 @@ class MicroBatcher:
             if set_load is not None:
                 set_load(None)
         except Exception:
-            pass
+            log.debug("clearing driver load hint failed on batcher stop",
+                      exc_info=True)
         # drain under the cv lock: a request appended concurrently either
         # lands before the drain (gets BatcherStopped here) or after _stop
         # is set (review() rejects it) — no pending can be left waiting on
@@ -507,7 +509,7 @@ class MicroBatcher:
                 )
                 p.event.set()
             self._cv.notify_all()
-        self._thread.join(timeout=2.0)
+        join_thread(self._thread, 2.0, "webhook micro-batcher loop")
 
 
 class WebhookServer:

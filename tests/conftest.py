@@ -67,6 +67,69 @@ def _gk_logger_isolation():
     root.propagate = propagate
 
 
+def _listening_socket_inodes():
+    """Inodes of this process's LISTEN-state TCP sockets (v4+v6), or
+    None when /proc is unavailable (non-Linux).  Inode identity — not fd
+    numbers — so dup()ed fds of one socket count once and fd-number
+    reuse across tests cannot alias."""
+    import re
+
+    inodes = set()
+    for path in ("/proc/net/tcp", "/proc/net/tcp6"):
+        try:
+            with open(path) as f:
+                next(f, None)
+                for line in f:
+                    parts = line.split()
+                    if len(parts) > 9 and parts[3] == "0A":  # LISTEN
+                        inodes.add(parts[9])
+        except OSError:
+            return None
+    held = set()
+    try:
+        for fd in os.listdir("/proc/self/fd"):
+            try:
+                target = os.readlink(f"/proc/self/fd/{fd}")
+            except OSError:
+                continue  # fd closed between listdir and readlink
+            m = re.match(r"socket:\[(\d+)\]", target)
+            if m and m.group(1) in inodes:
+                held.add(m.group(1))
+    except OSError:
+        return None
+    return held
+
+
+@pytest.fixture(autouse=True)
+def _no_listener_leaks():
+    """Fail any test that leaves a new LISTENING socket open — the
+    file-descriptor complement of the thread-leak fixture below, and the
+    runtime twin of gklint's static `listener-close`/`start-guard` rules
+    (tools/gklint.py).  A leaked listener holds its port for the rest of
+    the session: the next test binding the same --port gets EADDRINUSE
+    minutes away from the actual culprit.  Servers must stop via
+    close_listener()/server_close() (WebhookServer.stop, exporter.stop,
+    FrontDoor.stop...)."""
+    import time as _t
+
+    before = _listening_socket_inodes()
+    yield
+    if before is None:
+        return  # no /proc: nothing to check on this platform
+    deadline = _t.monotonic() + 2.0
+    while _t.monotonic() < deadline:
+        after = _listening_socket_inodes()
+        leaked = (after or set()) - before
+        if not leaked:
+            return
+        _t.sleep(0.05)  # teardown threads may still be closing
+    pytest.fail(
+        f"test leaked {len(leaked)} listening socket(s) — close servers "
+        "via close_listener()/server_close() in stop() "
+        "(gklint: listener-close)"
+    )
+
+
 @pytest.fixture(autouse=True)
 def _no_fault_or_thread_leaks():
     """Fail any test that leaves the process-global fault plane enabled or

@@ -1,0 +1,11 @@
+"""MUST-FLAG fixture: bare-join — an unbounded thread join on the
+shutdown path; a wedged worker hangs the supervisor forever (the PR 8
+wedge chaos class)."""
+
+
+class Supervisor:
+    def __init__(self, worker):
+        self._worker = worker
+
+    def stop(self):
+        self._worker.join()  # unbounded: a wedged worker hangs shutdown

@@ -1,0 +1,34 @@
+"""MUST-FLAG fixture: the PR 7 stall shape (cv-held-lock +
+blocking-under-lock).
+
+The batcher loop ran its adaptation step while holding the batcher
+condition variable; the service model inside takes the driver lock (and
+can block on real work).  During a long driver hold — an audit sweep, a
+snapshot capture — every producer trying to enqueue stalls behind the
+cv even though the queue itself is free.  The fix moved the adaptation
+outside the cv (webhook/server.py _run)."""
+
+import threading
+
+
+class Batcher:
+    def __init__(self, driver):
+        self._cv = threading.Condition()
+        self._driver_lock = threading.Lock()
+        self._driver = driver
+        self._pending = []
+
+    def _adapt(self):
+        # the service model prices a batch under the driver lock; a slow
+        # holder upstream makes this block for seconds
+        with self._driver_lock:
+            return self._driver.predict()
+
+    def run_once(self, command_pipe):
+        with self._cv:
+            while not self._pending:
+                self._cv.wait(timeout=0.1)
+            self._adapt()  # BUG: cv held across the driver lock
+            command_pipe.readline()  # BUG: unbounded pipe read under cv
+            batch, self._pending = self._pending, []
+        return batch

@@ -1,0 +1,27 @@
+"""Clean twin of cvhold_bad: the adaptation runs OUTSIDE the condition
+variable (producers only need the cv to append and notify), so gklint
+must stay silent."""
+
+import threading
+
+
+class Batcher:
+    def __init__(self, driver):
+        self._cv = threading.Condition()
+        self._driver_lock = threading.Lock()
+        self._driver = driver
+        self._pending = []
+
+    def _adapt(self):
+        with self._driver_lock:
+            return self._driver.predict()
+
+    def run_once(self, command_pipe):
+        with self._cv:
+            while not self._pending:
+                self._cv.wait(timeout=0.1)
+        self._adapt()  # adapt with the cv RELEASED
+        command_pipe.readline()  # blocking I/O with no lock held
+        with self._cv:
+            batch, self._pending = self._pending, []
+        return batch

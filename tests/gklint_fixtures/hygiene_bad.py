@@ -1,0 +1,29 @@
+"""MUST-FLAG fixture: resource-hygiene violations — a non-daemon thread
+nobody joins (thread-leak), a start() with no idempotence guard
+(start-guard), and a listener this file never closes (listener-close)."""
+
+import threading
+from http.server import ThreadingHTTPServer
+
+
+def fire_and_forget(work):
+    t = threading.Thread(target=work)  # neither daemon nor joined
+    t.start()
+    return t
+
+
+class Poller:
+    def __init__(self):
+        self._thread = None
+        self._server = None
+
+    def start(self):
+        # no guard: a second start() leaks the first loop thread and
+        # binds a second listener
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), None)
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            pass

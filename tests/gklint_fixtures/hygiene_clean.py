@@ -1,0 +1,42 @@
+"""Clean twin of hygiene_bad: daemon threads, guarded idempotent
+start(), bounded join with a liveness check, listener torn down.
+gklint must stay silent."""
+
+import threading
+from http.server import ThreadingHTTPServer
+
+
+def fire_and_forget(work):
+    t = threading.Thread(target=work, daemon=True)
+    t.start()
+    return t
+
+
+class Poller:
+    def __init__(self):
+        self._thread = None
+        self._server = None
+        self._stop = threading.Event()
+
+    def start(self):
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), None)
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            if self._thread.is_alive():
+                raise RuntimeError("poller loop wedged past its join")
+            self._thread = None
+
+    def _loop(self):
+        while not self._stop.wait(timeout=0.05):
+            pass

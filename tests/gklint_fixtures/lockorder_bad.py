@@ -1,0 +1,27 @@
+"""MUST-FLAG fixture: the PR 6 deadlock shape (lock-order-cycle).
+
+Two process-wide locks acquired in opposite orders on two paths — the
+background warm path took the dispatch gate then the driver lock while a
+foreground sweep held the driver lock and waited on the gate.  Each
+thread holds one and waits on the other: the classic ABBA rendezvous
+deadlock, exactly what DISPATCH_LOCK's ordering discipline exists to
+prevent."""
+
+import threading
+
+DISPATCH_LOCK = threading.Lock()
+DRIVER_LOCK = threading.Lock()
+
+
+def warm_path(executable):
+    # background warm: gate first, then driver state
+    with DISPATCH_LOCK:
+        with DRIVER_LOCK:
+            executable.warm()
+
+
+def sweep_path(driver):
+    # foreground sweep: driver state first, then the gate
+    with DRIVER_LOCK:
+        with DISPATCH_LOCK:
+            driver.dispatch()

@@ -1,0 +1,20 @@
+"""Clean twin of lockorder_bad: both paths honor ONE global order
+(gate before driver lock), so the held-while-acquiring graph is acyclic
+and gklint must stay silent."""
+
+import threading
+
+DISPATCH_LOCK = threading.Lock()
+DRIVER_LOCK = threading.Lock()
+
+
+def warm_path(executable):
+    with DISPATCH_LOCK:
+        with DRIVER_LOCK:
+            executable.warm()
+
+
+def sweep_path(driver):
+    with DISPATCH_LOCK:
+        with DRIVER_LOCK:
+            driver.dispatch()

@@ -1,0 +1,24 @@
+"""Suppression-syntax fixture: one properly reasoned disable (must be
+honored silently), one disable missing its reason (must yield a
+suppression-reason finding while still suppressing the original), and
+one naming an unknown rule (unknown-rule finding)."""
+
+
+def reasoned(evaluate):
+    try:
+        return evaluate()
+    # gklint: disable=swallowed-exception -- fixture: demonstrates a
+    # correctly reasoned suppression the analyzer must honor
+    except Exception:
+        pass
+
+
+def unreasoned(evaluate):
+    try:
+        return evaluate()
+    except Exception:  # gklint: disable=swallowed-exception
+        pass
+
+
+def unknown(evaluate):  # gklint: disable=no-such-rule -- typo'd rule id
+    return evaluate()

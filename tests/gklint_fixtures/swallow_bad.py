@@ -1,0 +1,23 @@
+"""MUST-FLAG fixture: a swallowed admission exception.
+
+A backend failure during evaluation silently becomes... nothing — an
+implicit fail-open nobody chose.  PR 1 made this an explicit routed
+decision (deadline.py fail-open/closed); a bare pass is the anti-
+pattern."""
+
+
+def handle_admission(request, evaluate):
+    try:
+        return evaluate(request)
+    except Exception:
+        pass  # BUG: implicit fail-open; the caller sees None
+
+
+def audit_sweep(inventory, evaluate):
+    findings = []
+    for row in inventory:
+        try:
+            findings.extend(evaluate(row))
+        except Exception:
+            continue  # BUG: the sweep "succeeds" with missing violations
+    return findings

@@ -1,0 +1,26 @@
+"""Clean twin of swallow_bad: failures route through an explicit
+decision and are logged/counted.  gklint must stay silent."""
+
+import logging
+
+log = logging.getLogger("fixture.swallow")
+
+
+def handle_admission(request, evaluate, fail_open):
+    try:
+        return evaluate(request)
+    except Exception:
+        log.exception("evaluation failed; applying failure policy")
+        return {"allowed": bool(fail_open), "status": "backend failure"}
+
+
+def audit_sweep(inventory, evaluate):
+    findings = []
+    failures = 0
+    for row in inventory:
+        try:
+            findings.extend(evaluate(row))
+        except Exception:
+            failures += 1
+            log.warning("audit row failed", exc_info=True)
+    return findings, failures

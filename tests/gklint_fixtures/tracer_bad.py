@@ -1,0 +1,34 @@
+"""MUST-FLAG fixture: JAX trace-safety violations.
+
+tracer-truthiness: Python `if` and float() on traced arguments inside a
+jitted body concretize the tracer (TracerBoolConversionError at best, a
+silently baked-in branch at worst).
+jit-in-loop: constructing the jit wrapper per iteration.
+impure-in-jit: a wall-clock read frozen into the executable at trace
+time."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def bad_kernel(x, limit):
+    if x > limit:  # truthiness on a tracer
+        return jnp.zeros_like(x)
+    scale = float(x)  # scalar coercion on a tracer
+    return x * scale
+
+
+@jax.jit
+def stamped(x):
+    return x * time.time()  # frozen at trace time
+
+
+def eval_shards(shards):
+    out = []
+    for shard in shards:
+        fn = jax.jit(lambda v: v + 1)  # rebuilt every iteration
+        out.append(fn(shard))
+    return out

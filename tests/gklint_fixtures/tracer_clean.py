@@ -1,0 +1,28 @@
+"""Clean twin of tracer_bad: branches go through jnp.where, shape-space
+reads (static under tracing) drive Python control flow, and the jit
+wrapper is built once at module scope.  gklint must stay silent."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def good_kernel(x, limit):
+    zeros = jnp.zeros_like(x)
+    scaled = x * x.astype(jnp.float32)
+    return jnp.where(x > limit, zeros, scaled)
+
+
+@jax.jit
+def shaped(x):
+    if x.ndim > 1:  # shape space: static under tracing
+        return x.sum(axis=-1)
+    rows = x.shape[0]
+    return x * rows
+
+
+_eval_one = jax.jit(lambda v: v + 1)  # built once
+
+
+def eval_shards(shards):
+    return [_eval_one(shard) for shard in shards]

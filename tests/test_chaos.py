@@ -796,20 +796,26 @@ class TestGracefulDrain:
         result = {}
 
         def call():
+            # a deadline-carrying request always takes the QUEUED path
+            # (the inline fast path is uninterruptible), so the batch
+            # loop — not this thread — owns the wedged dispatch.  The
+            # budget is generous: only the wedge bounds this test.
+            token = deadline.push(30.0)
             try:
                 result["r"] = mb.review(AugmentedReview(
                     admission_request=ns_review("drain-hang")
                 ))
             except Exception as e:
                 result["r"] = e
+            finally:
+                deadline.pop(token)
 
         try:
-            mb._busy = True  # steer the request into the queue
             t = threading.Thread(target=call)
             t.start()
-            assert wait_until(lambda: len(mb._pending) == 1)
-            mb._busy = False
             # the batch loop picks it up and wedges inside the dispatch
+            # (observing the 1-element queue in between would race the
+            # loop's sub-ms grab — this state is the stable one)
             assert wait_until(
                 lambda: mb._busy and not mb._pending, timeout_s=5.0
             ), "batch loop never picked up the wedged request"

@@ -1,0 +1,262 @@
+"""gklint analyzer unit tests: every seeded fixture violation is
+flagged (the PR 6 ABBA deadlock shape, the PR 7 cv-held-lock stall,
+tracer truthiness, swallowed admission exceptions, resource hygiene),
+every clean twin is silent, and the suppression + baseline mechanics
+behave as documented in docs/static-analysis.md."""
+
+import json
+import os
+import pathlib
+import textwrap
+
+from gatekeeper_tpu import analysis
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+FIXTURES = REPO / "tests" / "gklint_fixtures"
+
+
+def _lint(*names):
+    paths = [str(FIXTURES / n) for n in names]
+    return analysis.lint(str(REPO), paths)
+
+
+def _rules_by_file(findings):
+    out = {}
+    for f in findings:
+        out.setdefault(os.path.basename(f.path), set()).add(f.rule)
+    return out
+
+
+# ---- must-flag seeds --------------------------------------------------------
+
+
+def test_lockorder_abba_flagged_both_sites():
+    findings = [
+        f for f in _lint("lockorder_bad.py") if f.rule == "lock-order-cycle"
+    ]
+    # one finding per conflicting edge: the gate->driver site AND the
+    # driver->gate site, each naming the full cycle
+    assert len(findings) == 2, findings
+    assert {f.context for f in findings} == {"warm_path", "sweep_path"}
+    for f in findings:
+        assert "DISPATCH_LOCK" in f.message and "DRIVER_LOCK" in f.message
+        assert "deadlock cycle" in f.message
+
+
+def test_lockorder_clean_twin_silent():
+    assert _lint("lockorder_clean.py") == []
+
+
+def test_cvhold_flagged_as_cv_held_lock_and_blocking():
+    rules = _rules_by_file(_lint("cvhold_bad.py"))["cvhold_bad.py"]
+    assert "cv-held-lock" in rules  # the PR 7 _adapt-under-cv shape
+    assert "blocking-under-lock" in rules  # readline under the cv
+    cv = [f for f in _lint("cvhold_bad.py") if f.rule == "cv-held-lock"]
+    assert cv[0].context == "Batcher.run_once"
+    assert "_driver_lock" in cv[0].message and "_cv" in cv[0].message
+
+
+def test_cvhold_clean_twin_silent():
+    assert _lint("cvhold_clean.py") == []
+
+
+def test_tracer_seeds_flagged():
+    findings = _lint("tracer_bad.py")
+    rules = {f.rule for f in findings}
+    assert rules == {"tracer-truthiness", "jit-in-loop", "impure-in-jit"}
+    truthy = [f for f in findings if f.rule == "tracer-truthiness"]
+    # the `if x > limit` branch AND the float(x) coercion
+    assert len(truthy) == 2
+    assert all("bad_kernel" in f.message for f in truthy)
+
+
+def test_tracer_clean_twin_silent():
+    # jnp.where, shape-space branches, module-scope jit: all legal
+    assert _lint("tracer_clean.py") == []
+
+
+def test_swallowed_admission_exception_flagged():
+    findings = _lint("swallow_bad.py")
+    assert {f.rule for f in findings} == {"swallowed-exception"}
+    assert {f.context for f in findings} == {"handle_admission", "audit_sweep"}
+
+
+def test_swallow_clean_twin_silent():
+    assert _lint("swallow_clean.py") == []
+
+
+def test_hygiene_seeds_flagged():
+    rules = _rules_by_file(_lint("hygiene_bad.py"))["hygiene_bad.py"]
+    assert rules == {"thread-leak", "start-guard", "listener-close"}
+
+
+def test_hygiene_clean_twin_silent():
+    assert _lint("hygiene_clean.py") == []
+
+
+def test_bare_join_flagged():
+    findings = _lint("barejoin_bad.py")
+    assert [f.rule for f in findings] == ["bare-join"]
+    assert findings[0].context == "Supervisor.stop"
+
+
+# ---- suppression mechanics --------------------------------------------------
+
+
+def test_reasoned_suppression_honored_and_unreasoned_reported():
+    findings = _lint("suppression_demo.py")
+    rules = {f.rule for f in findings}
+    # the reasoned disable silences its swallow entirely; the unreasoned
+    # one still suppresses but earns a suppression-reason finding; the
+    # typo'd rule id earns unknown-rule
+    assert "swallowed-exception" not in rules
+    assert "suppression-reason" in rules
+    assert "unknown-rule" in rules
+
+
+def test_disable_file_suppresses_everywhere(tmp_path):
+    mod = tmp_path / "gen.py"
+    mod.write_text(textwrap.dedent("""\
+        # gklint: disable-file=swallowed-exception -- generated fixture
+        def a(run):
+            try:
+                return run()
+            except Exception:
+                pass
+        def b(run):
+            try:
+                return run()
+            except Exception:
+                pass
+    """))
+    findings = analysis.lint(str(tmp_path), [str(mod)])
+    assert [f.rule for f in findings] == []
+
+
+def test_suppression_comment_block_above_statement(tmp_path):
+    mod = tmp_path / "block.py"
+    mod.write_text(textwrap.dedent("""\
+        def a(run):
+            try:
+                return run()
+            # a multi-line justification whose disable sits at the top
+            # gklint: disable=swallowed-exception -- documented contract
+            # with trailing commentary lines after the disable
+            except Exception:
+                pass
+    """))
+    assert analysis.lint(str(tmp_path), [str(mod)]) == []
+
+
+# ---- baseline mechanics -----------------------------------------------------
+
+
+def test_baseline_roundtrip_absorbs_then_surfaces_new(tmp_path):
+    findings = _lint("swallow_bad.py")
+    assert findings
+    baseline_path = tmp_path / "baseline.json"
+    analysis.write_baseline(str(baseline_path), findings)
+    data = json.loads(baseline_path.read_text())
+    assert data["findings"]  # keyed entries present
+    baseline = analysis.load_baseline(str(baseline_path))
+    # identical findings are fully absorbed
+    assert analysis.apply_baseline(findings, baseline) == []
+    # a NEW finding (different context) still surfaces
+    extra = analysis.Finding(
+        "swallowed-exception", findings[0].path, 99, "new", "new_fn"
+    )
+    assert analysis.apply_baseline(findings + [extra], baseline) == [extra]
+
+
+def test_baseline_is_count_capped(tmp_path):
+    findings = _lint("swallow_bad.py")
+    one = [findings[0]]
+    baseline_path = tmp_path / "baseline.json"
+    analysis.write_baseline(str(baseline_path), one)
+    baseline = analysis.load_baseline(str(baseline_path))
+    # two findings under a count-1 key: one absorbed, one surfaces
+    dup = analysis.Finding(
+        findings[0].rule, findings[0].path, findings[0].line + 1,
+        findings[0].message, findings[0].context,
+    )
+    left = analysis.apply_baseline([findings[0], dup], baseline)
+    assert len(left) == 1
+
+
+# ---- registry cross-checks --------------------------------------------------
+
+
+def _registry_repo(tmp_path, fire_point="faults.KNOWN", doc_points=("a.b",),
+                   view_name="documented_metric", doc_metrics=("documented_metric",)):
+    root = tmp_path
+    (root / "gatekeeper_tpu" / "faults").mkdir(parents=True)
+    (root / "gatekeeper_tpu" / "metrics").mkdir(parents=True)
+    (root / "docs").mkdir()
+    (root / "gatekeeper_tpu" / "faults" / "__init__.py").write_text(
+        'KNOWN = "a.b"\nALL_POINTS = (KNOWN,)\n'
+    )
+    (root / "gatekeeper_tpu" / "metrics" / "catalog.py").write_text(
+        f'View = object\nv = View\ndef catalog_views():\n'
+        f'    return [View("{view_name}")]\n'
+        if False else
+        f'def catalog_views():\n    return [View("{view_name}")]\n'
+    )
+    (root / "gatekeeper_tpu" / "caller.py").write_text(
+        "from . import faults\n"
+        f"def go():\n    faults.fire({fire_point})\n"
+    )
+    (root / "docs" / "failure-modes.md").write_text(
+        "\n".join(f"`{p}`" for p in doc_points) + "\n"
+    )
+    (root / "docs" / "metrics.md").write_text(
+        "\n".join(f"`{m}`" for m in doc_metrics) + "\n"
+    )
+    return root
+
+
+def test_unknown_fault_point_literal_flagged(tmp_path):
+    root = _registry_repo(tmp_path, fire_point='"not.registered"')
+    findings = analysis.lint(str(root), [str(root / "gatekeeper_tpu")])
+    assert any(f.rule == "unknown-fault-point" for f in findings)
+
+
+def test_registered_fault_point_clean(tmp_path):
+    root = _registry_repo(tmp_path)
+    findings = analysis.lint(str(root), [str(root / "gatekeeper_tpu")])
+    assert [f for f in findings if f.rule == "unknown-fault-point"] == []
+
+
+def test_undocumented_fault_point_flagged(tmp_path):
+    root = _registry_repo(tmp_path, doc_points=("something.else",))
+    findings = analysis.lint(str(root), [str(root / "gatekeeper_tpu")])
+    assert any(f.rule == "undocumented-fault-point" for f in findings)
+
+
+def test_undocumented_metric_flagged(tmp_path):
+    root = _registry_repo(tmp_path, doc_metrics=("other_metric",))
+    findings = analysis.lint(str(root), [str(root / "gatekeeper_tpu")])
+    assert any(f.rule == "undocumented-metric" for f in findings)
+
+
+# ---- misc ergonomics --------------------------------------------------------
+
+
+def test_syntax_error_is_reported_not_crashed(tmp_path):
+    bad = tmp_path / "broken.py"
+    bad.write_text("def nope(:\n")
+    findings = analysis.lint(str(tmp_path), [str(bad)])
+    assert findings and "does not parse" in findings[0].message
+
+
+def test_select_restricts_rules():
+    findings = analysis.lint(
+        str(REPO), [str(FIXTURES / "tracer_bad.py")],
+        select={"jit-in-loop"},
+    )
+    assert {f.rule for f in findings} == {"jit-in-loop"}
+
+
+def test_every_registered_rule_documented_in_catalog():
+    # self-check: every rule id carries a description for --list-rules
+    for rule, doc in analysis.RULES.items():
+        assert doc and len(doc) > 10, rule

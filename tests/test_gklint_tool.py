@@ -1,0 +1,110 @@
+"""tools/gklint.py wired into tier-1 (the check_observability pattern):
+the repo itself must lint clean — zero unsuppressed findings over
+gatekeeper_tpu/ — and the CLI contract (exit codes, JSON format, rule
+listing) must hold, so a regression that re-introduces a deadlock shape
+or a silent swallow fails the suite, not a future incident review."""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+TOOL = REPO / "tools" / "gklint.py"
+FIXTURES = REPO / "tests" / "gklint_fixtures"
+
+
+def _run(*args):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")  # gklint never imports jax,
+    # but keep the child hermetic anyway
+    return subprocess.run(
+        [sys.executable, str(TOOL), *args],
+        capture_output=True, text=True, cwd=str(REPO), env=env,
+        timeout=120,
+    )
+
+
+def test_repo_lints_clean():
+    """The acceptance bar: `python tools/gklint.py gatekeeper_tpu/`
+    exits 0 with zero unsuppressed findings."""
+    r = _run("gatekeeper_tpu/")
+    assert r.returncode == 0, f"gklint found problems:\n{r.stderr}"
+    assert "gklint: ok" in r.stdout
+
+
+def test_tools_and_bench_lint_clean():
+    """The auxiliary surfaces stay clean too (make lint covers them via
+    the default path; pin them here so a regression is attributable)."""
+    r = _run("tools/", "bench.py")
+    assert r.returncode == 0, f"gklint found problems:\n{r.stderr}"
+
+
+def test_fixture_seeds_fail_with_json_details():
+    r = _run(str(FIXTURES), "--no-baseline", "--format=json")
+    assert r.returncode == 1
+    payload = json.loads(r.stdout)
+    rules = {f["rule"] for f in payload["findings"]}
+    # the four incident-derived must-flag classes from the issue
+    assert "lock-order-cycle" in rules
+    assert "cv-held-lock" in rules
+    assert "tracer-truthiness" in rules
+    assert "swallowed-exception" in rules
+    assert payload["count"] == len(payload["findings"]) > 0
+    for f in payload["findings"]:
+        assert f["path"].startswith("tests/gklint_fixtures/")
+        assert f["line"] >= 1 and f["message"]
+
+
+def test_list_rules():
+    r = _run("--list-rules")
+    assert r.returncode == 0
+    for rule in ("lock-order-cycle", "blocking-under-lock", "cv-held-lock",
+                 "tracer-truthiness", "jit-in-loop", "impure-in-jit",
+                 "swallowed-exception", "thread-leak", "bare-join",
+                 "listener-close", "start-guard", "unknown-fault-point",
+                 "undocumented-fault-point", "undocumented-metric",
+                 "suppression-reason"):
+        assert rule in r.stdout, rule
+
+
+def test_unknown_select_is_usage_error():
+    r = _run("--select", "no-such-rule")
+    assert r.returncode == 2
+
+
+def test_baseline_absorbs_fixture_findings(tmp_path):
+    from gatekeeper_tpu import analysis
+
+    baseline = tmp_path / "b.json"
+    findings = analysis.lint(str(REPO), [str(FIXTURES)])
+    analysis.write_baseline(str(baseline), findings)
+    r = _run(str(FIXTURES), "--baseline", str(baseline))
+    assert r.returncode == 0, r.stderr
+    # and --no-baseline surfaces them again
+    r = _run(str(FIXTURES), "--baseline", str(baseline), "--no-baseline")
+    assert r.returncode == 1
+
+
+def test_write_baseline_refuses_narrowed_runs(tmp_path):
+    """A baseline written from a subset would silently drop every
+    accepted finding outside it — the CLI must refuse."""
+    baseline = tmp_path / "b.json"
+    r = _run(str(FIXTURES), "--baseline", str(baseline), "--write-baseline")
+    assert r.returncode == 2
+    assert not baseline.exists()
+    r = _run("--select", "bare-join", "--baseline", str(baseline),
+             "--write-baseline")
+    assert r.returncode == 2
+    assert not baseline.exists()
+
+
+def test_committed_baseline_is_empty():
+    """The repo's committed baseline must stay at zero entries: new
+    findings are fixed or inline-suppressed with reasons, not silently
+    banked (regenerating with --write-baseline on a dirty tree would
+    show up here)."""
+    with open(REPO / ".gklint-baseline.json") as f:
+        data = json.load(f)
+    assert data["findings"] == []
