@@ -39,11 +39,19 @@ REPLICA_WEDGE = "fleet.replica_wedge"  # replica command loop: hang = the
 #                                        child stops answering its pipe
 MESH_DISPATCH_STALL = "mesh.dispatch_stall"  # ops/driver.py mesh-collective
 #                                        enqueue (hang = stuck rendezvous)
+# fleet observability plane (ISSUE 11)
+SCRAPE_FAIL = "fleet.scrape_fail"      # obs/fleetobs.py federated scrape of
+#                                        one replica exporter (error = the
+#                                        scrape fails -> stale-marked view)
+PROFILER_STALL = "obs.profiler_stall"  # obs/profiler.py sampler tick (hang
+#                                        = a wedged sampler; snapshots and
+#                                        the hot path must keep serving)
 
 ALL_POINTS = (
     KUBE_SEND, KUBE_RECV, WATCH_DELIVER, TPU_COMPILE, TPU_DISPATCH,
     WEBHOOK_ENQUEUE, SNAPSHOT_WRITE, SNAPSHOT_LOAD, SNAPSHOT_RESYNC,
     SNAPSHOT_CORRUPT, REPLICA_CRASH, REPLICA_WEDGE, MESH_DISPATCH_STALL,
+    SCRAPE_FAIL, PROFILER_STALL,
 )
 
 # ---- the process-global plane ----------------------------------------------
@@ -114,8 +122,10 @@ __all__ = [
     "KUBE_SEND",
     "LATENCY",
     "MESH_DISPATCH_STALL",
+    "PROFILER_STALL",
     "REPLICA_CRASH",
     "REPLICA_WEDGE",
+    "SCRAPE_FAIL",
     "SNAPSHOT_CORRUPT",
     "SNAPSHOT_LOAD",
     "SNAPSHOT_RESYNC",

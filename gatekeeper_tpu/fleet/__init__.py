@@ -22,7 +22,12 @@ Pieces:
 - :mod:`supervisor` — replica supervision (exit/wedge detection, warm
   restarts with capped backoff, flap quarantine, graceful drain and
   zero-failed-admission rolling restarts; ISSUE 8,
-  docs/failure-modes.md fleet failure matrix).
+  docs/failure-modes.md fleet failure matrix).  Its
+  ``trace_targets()``/``metrics_targets()`` rosters feed the fleet
+  observability plane (ISSUE 11, :mod:`gatekeeper_tpu.obs.fleetobs`):
+  the front door originates wire traces, federates every replica's
+  /metrics, and serves cross-process joined traces at
+  ``/debug/fleet-traces``.
 
 Trust model: replicas share the snapshot + AOT directories read-mostly
 (atomic-rename snapshots, flock-serialized writers, sealed entries

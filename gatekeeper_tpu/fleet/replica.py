@@ -323,6 +323,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             "event": "ready",
             "replica_id": args.replica_id,
             "port": app.webhook_server.port,
+            # the ephemeral exporter port, announced so the parent-side
+            # metrics federator (obs/fleetobs.py) can scrape this
+            # replica's /metrics into the fleet view
+            "metrics_port": (app.metrics_exporter.port
+                             if app.metrics_exporter is not None else 0),
             "ready_s": round(time.monotonic() - t0, 3),
             "restore_outcome": getattr(
                 app, "snapshot_restore_outcome", "none"),
@@ -374,6 +379,78 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                                  "draining": app.webhook_server._draining})
                 elif op == "drain":
                     _reply(cmd, _handle_drain(app, cmd, args.replica_id))
+                elif op == "traces":
+                    # the trace ring over the command pipe (ISSUE 11):
+                    # the HTTP /debug/traces surface is primary; this
+                    # lets a collector join traces even while the
+                    # webhook listener is saturated or draining.
+                    # Malformed params degrade to defaults — a bad
+                    # command must not escape as ValueError and end
+                    # the command loop (the outer catch treats that
+                    # as shutdown)
+                    from ..obs import trace as _obstrace
+
+                    try:
+                        min_ms = float(cmd.get("min_ms", 0.0))
+                    except (TypeError, ValueError):
+                        min_ms = 0.0
+                    try:
+                        limit = (int(cmd["limit"])
+                                 if "limit" in cmd else None)
+                    except (TypeError, ValueError):
+                        limit = None
+                    _reply(cmd, {
+                        "event": "traces",
+                        "replica_id": args.replica_id,
+                        "traces": _obstrace.get_tracer().traces(
+                            min_ms=min_ms, limit=limit,
+                        ),
+                    })
+                elif op == "chaos":
+                    # runtime (re)install of the seeded fault plane:
+                    # lets a harness seed one deterministic fault (e.g.
+                    # the OBS_r11 slow-request latency rule) into a
+                    # WARM replica without a respawn; spec=None
+                    # uninstalls.  Same spec schema as GK_CHAOS.
+                    spec = cmd.get("spec")
+                    err = ""
+                    try:
+                        if spec:
+                            _faults.install_from_spec(spec)
+                        else:
+                            _faults.uninstall()
+                    except Exception as e:
+                        # a typo'd spec must fail THIS command loudly,
+                        # not kill the command loop
+                        err = f"{type(e).__name__}: {e}"
+                    _reply(cmd, {"event": "chaos",
+                                 "replica_id": args.replica_id,
+                                 "enabled": _faults.ENABLED,
+                                 "error": err})
+                elif op == "profiler":
+                    # runtime re-rate of the sampling profiler (bench.py
+                    # measures profiler-on vs -off throughput on the
+                    # SAME warm replica, no respawn)
+                    from ..obs.profiler import get_profiler
+
+                    prof = get_profiler()
+                    if "hz" in cmd:
+                        try:
+                            hz = float(cmd["hz"])
+                        except (TypeError, ValueError):
+                            hz = None  # bad hz: report state, change
+                            #            NOTHING (a failed parse must
+                            #            not start a profiler the
+                            #            operator disabled)
+                        if hz is not None:
+                            prof.configure(hz=hz)
+                            if prof.hz > 0 and not prof.running:
+                                prof.start()
+                    _reply(cmd, {"event": "profiler",
+                                 "replica_id": args.replica_id,
+                                 "hz": prof.hz,
+                                 "running": prof.running,
+                                 "samples": prof.samples})
         except (KeyboardInterrupt, ValueError):
             pass
         return 0
@@ -524,6 +601,8 @@ class ReplicaHandle:
         self.replica_id = replica_id
         self.ready = ready          # the child's announced ready line
         self.port: int = int(ready["port"])
+        # exporter port for the metrics federator (0 on older replicas)
+        self.metrics_port: int = int(ready.get("metrics_port", 0))
         self.ready_s: float = float(ready["ready_s"])  # in-process
         self.spawn_s = spawn_s      # parent wall: Popen -> ready line
         self.host = "127.0.0.1"

@@ -491,6 +491,27 @@ class ReplicaSupervisor:
             return [s.handle for s in self._slots.values()
                     if s.handle is not None]
 
+    # live target rosters for the fleet observability plane (ISSUE 11,
+    # obs/fleetobs.py): passed as the collectors' targets() callables so
+    # federation/assembly follow restarts onto fresh ephemeral ports
+
+    def trace_targets(self) -> List[dict]:
+        """{replica_id, host, port} per live replica — its webhook
+        listener, where /debug/traces is served."""
+        return [
+            {"replica_id": h.replica_id, "host": h.host, "port": h.port}
+            for h in self.handles()
+        ]
+
+    def metrics_targets(self) -> List[dict]:
+        """{replica_id, host, port} per live replica — its metrics
+        exporter, for the federator's scrape."""
+        return [
+            {"replica_id": h.replica_id, "host": h.host,
+             "port": h.metrics_port}
+            for h in self.handles() if h.metrics_port
+        ]
+
     def status(self) -> dict:
         with self._mu:
             return {

@@ -175,6 +175,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--trace-sample-rate", type=float, default=1.0,
                    help="fraction of completed traces retained in the "
                         "/debug/traces ring (slow traces always retained)")
+    # always-on sampling profiler (docs/tracing.md, ISSUE 11); the env
+    # default is parsed defensively — a typo'd GK_PROFILER_HZ must not
+    # kill every process that builds this parser
+    from .obs.profiler import env_hz
+
+    p.add_argument("--profiler-hz", type=float, default=env_hz(),
+                   help="sampling rate of the always-on stack profiler "
+                        "serving /debug/profilez (0 disables; bounded, "
+                        "span-stage-correlated, <5%% overhead budget)")
     # cost attribution + SLO engine (docs/slo.md)
     p.add_argument("--cost-top-k", type=int, default=20,
                    help="templates exported individually by the cost "
@@ -723,6 +732,18 @@ class App:
                 collect_hooks=self._collect_hooks,
             )
             self.metrics_addr_exporter.start()
+        # always-on sampling profiler (obs/profiler.py): collapsed-stack
+        # CPU profiles at /debug/profilez on BOTH debug surfaces, stage-
+        # correlated via the tracer's thread registry.  The flag value
+        # is ALWAYS propagated to the singleton — --profiler-hz 0 must
+        # zero the import-time default too, or a later runtime command
+        # could "resume" a profiler the operator explicitly disabled
+        from .obs.profiler import get_profiler
+
+        hz = getattr(args, "profiler_hz", 0.0) or 0.0
+        get_profiler().configure(hz=hz)
+        if hz > 0:
+            get_profiler().start()
         if args.enable_pprof:
             self.profile_server = ProfileServer(args.pprof_port)
             self.profile_server.start()
@@ -818,6 +839,12 @@ class App:
 
             jax.profiler.stop_server()
             self._jax_profiler_on = False
+        # unconditional: the sampler may have been enabled at RUNTIME
+        # (the replica 'profiler' pipe command) on a process started
+        # with --profiler-hz 0; stop() is idempotent and bounded
+        from .obs.profiler import get_profiler
+
+        get_profiler().stop()
         self.manager.stop()
 
     def run_forever(self):

@@ -271,6 +271,58 @@ MESH_WIDTH_M = Measure(
     "(1 = the single-device path; drops when a dispatch stall degrades "
     "the mesh)",
 )
+# ---- fleet observability plane (ISSUE 11) -----------------------------------
+# Wire-path stage telemetry recorded by the front door (the serving edge
+# the FLEET_r06 176 reviews/s number traverses), scrape-health gauges
+# recorded by the metrics federator, and the sampling profiler's own
+# accounting.  Stage names are the frontdoor.WIRE_STAGES stable set
+# (docs/tracing.md); tools/check_observability.py cross-checks them.
+FRONTDOOR_STAGE_M = Measure(
+    "frontdoor_stage_seconds",
+    "Time one admission request spent in one front-door wire-path stage "
+    "(accept, read_body, route_choose, proxy_connect, replica_wait, "
+    "write_back) — the stages are disjoint and sum to the wire latency",
+    unit="s",
+)
+FRONTDOOR_REQS_M = Measure(
+    "frontdoor_requests",
+    "Requests through the fleet front door by outcome (ok, "
+    "backend_error, no_backend, bad_request) and serving backend "
+    "replica id (empty when no backend answered)",
+)
+FLEET_SCRAPE_OK_M = Measure(
+    "fleet_scrape_ok",
+    "1 when the federator's most recent scrape of this replica's "
+    "exporter succeeded, 0 when the federated view is serving its "
+    "stale-marked last-known-good series",
+)
+FLEET_SCRAPE_AGE_M = Measure(
+    "fleet_scrape_age_seconds",
+    "Seconds since the federator last scraped this replica "
+    "successfully (grows while the replica is wedged or down)",
+    unit="s",
+)
+FLEET_SCRAPED_M = Measure(
+    "fleet_replicas_scraped",
+    "Replica exporters scraped successfully on the federator's most "
+    "recent pass (the fleet rollup's freshness denominator)",
+)
+FLEET_ADMISSIONS_M = Measure(
+    "fleet_admission_requests",
+    "Fleet rollup: sum of request_count samples across every scraped "
+    "replica exporter (stale-marked series included)",
+)
+PROFILER_SAMPLES_M = Measure(
+    "profiler_samples",
+    "Thread-stack samples collected by the always-on sampling profiler "
+    "(obs/profiler.py; one sample = one thread's stack at one tick)",
+)
+PROFILER_OVERFLOW_M = Measure(
+    "profiler_overflow",
+    "Profiler samples dropped because the unique-stack table hit its "
+    "memory bound (max_stacks); the profile is still valid, its tail "
+    "is just truncated",
+)
 
 # bucket boundaries copied from the reference's view.Distribution calls
 _INGEST_BUCKETS = (
@@ -400,6 +452,19 @@ def catalog_views():
              tag_keys=("replica_id",)),
         View("mesh_dispatch_stalls_total", MESH_STALL_M, AGG_COUNT),
         View("mesh_sweep_width", MESH_WIDTH_M, AGG_LAST_VALUE),
+        View("frontdoor_stage_seconds", FRONTDOOR_STAGE_M,
+             AGG_DISTRIBUTION, tag_keys=("stage",), buckets=_STAGE_BUCKETS),
+        View("frontdoor_requests_total", FRONTDOOR_REQS_M, AGG_COUNT,
+             tag_keys=("outcome", "backend")),
+        View("fleet_scrape_ok", FLEET_SCRAPE_OK_M, AGG_LAST_VALUE,
+             tag_keys=("replica_id",)),
+        View("fleet_scrape_age_seconds", FLEET_SCRAPE_AGE_M,
+             AGG_LAST_VALUE, tag_keys=("replica_id",)),
+        View("fleet_replicas_scraped", FLEET_SCRAPED_M, AGG_LAST_VALUE),
+        View("fleet_admission_requests", FLEET_ADMISSIONS_M,
+             AGG_LAST_VALUE),
+        View("profiler_samples_total", PROFILER_SAMPLES_M, AGG_COUNT),
+        View("profiler_overflow_total", PROFILER_OVERFLOW_M, AGG_COUNT),
     ]
 
 
@@ -749,6 +814,70 @@ def record_mesh_width(width: int):
         _global().record(MESH_WIDTH_M, float(width))
     except Exception:  # telemetry never blocks eval
         record_dropped("record_mesh_width")
+
+
+def record_frontdoor_stage(stage: str, seconds: float):
+    """One wire-path stage interval at the fleet front door (stage in
+    frontdoor.WIRE_STAGES), exemplar-linked to the active wire trace.
+    Guarded like record_stage."""
+    try:
+        _global().record(
+            FRONTDOOR_STAGE_M, seconds, {"stage": stage},
+            exemplar_trace_id=_current_trace_id(),
+        )
+    except Exception:  # telemetry never blocks the wire path
+        record_dropped("record_frontdoor_stage")
+
+
+def record_frontdoor_request(outcome: str, backend: str):
+    """One request through the front door: outcome in (ok,
+    backend_error, no_backend, bad_request); backend = the serving
+    replica id ('' when none answered).  Guarded like record_stage."""
+    try:
+        _global().record(
+            FRONTDOOR_REQS_M, 1.0,
+            {"outcome": outcome, "backend": backend},
+        )
+    except Exception:  # telemetry never blocks the wire path
+        record_dropped("record_frontdoor_request")
+
+
+def record_scrape(replica_id: str, ok: bool, age_s: float):
+    """One federated-scrape health sample for one replica exporter
+    (obs/fleetobs.py): ok flag + staleness age.  Guarded like
+    record_stage."""
+    try:
+        reg = _global()
+        tags = {"replica_id": replica_id}
+        reg.record(FLEET_SCRAPE_OK_M, 1.0 if ok else 0.0, tags)
+        reg.record(FLEET_SCRAPE_AGE_M, float(age_s), tags)
+    except Exception:  # telemetry never blocks the scrape
+        record_dropped("record_scrape")
+
+
+def record_fleet_rollup(replicas_scraped: int, admission_requests: float):
+    """The federator's per-pass fleet rollups.  Guarded like
+    record_stage."""
+    try:
+        reg = _global()
+        reg.record(FLEET_SCRAPED_M, float(replicas_scraped))
+        reg.record(FLEET_ADMISSIONS_M, float(admission_requests))
+    except Exception:  # telemetry never blocks the scrape
+        record_dropped("record_fleet_rollup")
+
+
+def record_profiler(samples: int, overflow: int = 0):
+    """One profiler tick's accounting: samples collected + samples
+    dropped on the unique-stack bound.  Guarded like record_stage."""
+    try:
+        reg = _global()
+        if samples > 0:
+            reg.record(PROFILER_SAMPLES_M, float(samples), count=samples)
+        if overflow > 0:
+            reg.record(PROFILER_OVERFLOW_M, float(overflow),
+                       count=overflow)
+    except Exception:  # telemetry never blocks the sampler
+        record_dropped("record_profiler")
 
 
 def record_cache(cache: str, hit: bool, n: int = 1):

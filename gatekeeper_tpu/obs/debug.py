@@ -10,6 +10,10 @@ Endpoints (docs/tracing.md):
   /debug/stacks                  live thread-stack dump
   /debug/costs?top=              per-template cost attribution (obs/costs.py)
   /debug/slo                     SLO burn-rate status (obs/slo.py)
+  /debug/profilez?reset=         collapsed-stack CPU profile (obs/profiler.py)
+  /debug/fleet-traces?min_ms=    assembled cross-process traces — present
+                                 only where a fleet TraceCollector is
+                                 installed (obs/fleetobs.py)
 
 Contracts this module owns:
 
@@ -63,6 +67,7 @@ class DebugRouter:
             "/debug/stacks": self._stacks,
             "/debug/costs": self._costs,
             "/debug/slo": self._slo,
+            "/debug/profilez": self._profilez,
         }
 
     def endpoints(self) -> List[str]:
@@ -125,6 +130,13 @@ class DebugRouter:
         from . import slo as obsslo
 
         return _json(200, obsslo.get_engine().evaluate())
+
+    def _profilez(self, q) -> Response:
+        from . import profiler as obsprofiler
+
+        reset = _num(q, "reset", int, 0)
+        body = obsprofiler.get_profiler().collapsed(reset=bool(reset))
+        return 200, "text/plain; charset=utf-8", body.encode()
 
 
 _ROUTER = DebugRouter()

@@ -226,6 +226,54 @@ CURRENT: contextvars.ContextVar[Optional[Span]] = contextvars.ContextVar(
     "gk_current_span", default=None
 )
 
+# Cross-thread mirror of CURRENT for the sampling profiler
+# (obs/profiler.py): a sampler thread cannot read another thread's
+# contextvars, so span (de)activation also writes this ident-keyed dict.
+# GIL-atomic dict ops only — no lock on the span hot path.
+_ACTIVE_BY_THREAD: Dict[int, Span] = {}
+
+
+def _thread_activate(span: Optional[Span]) -> Optional[Span]:
+    ident = threading.get_ident()
+    prev = _ACTIVE_BY_THREAD.get(ident)
+    if span is None:
+        _ACTIVE_BY_THREAD.pop(ident, None)
+    else:
+        _ACTIVE_BY_THREAD[ident] = span
+    return prev
+
+
+def _thread_restore(prev: Optional[Span]) -> None:
+    ident = threading.get_ident()
+    if prev is None:
+        _ACTIVE_BY_THREAD.pop(ident, None)
+    else:
+        _ACTIVE_BY_THREAD[ident] = prev
+
+
+def active_spans() -> Dict[int, Span]:
+    """Snapshot of {thread_ident: active span} — the profiler's stage-
+    correlation input.  A copy: the sampler must never iterate the live
+    dict while request threads mutate it."""
+    return dict(_ACTIVE_BY_THREAD)
+
+
+def activate(span: Span):
+    """Establish ``span`` as CURRENT for this thread (contextvar AND the
+    profiler's thread registry) without a context manager — for code
+    that brackets activation across non-lexical scopes (the micro-
+    batcher's dispatch loop).  Returns an opaque state for
+    :func:`deactivate`."""
+    token = CURRENT.set(span)
+    prev = _thread_activate(span)
+    return (token, prev)
+
+
+def deactivate(state) -> None:
+    token, prev = state
+    CURRENT.reset(token)
+    _thread_restore(prev)
+
 
 class Tracer:
     """Process tracer: ring buffer of completed traces + slow sampler."""
@@ -362,36 +410,42 @@ def add_event(name: str, **attrs):
 class _SpanCtx:
     """Context manager for one span; establishes it as CURRENT inside."""
 
-    __slots__ = ("span", "_token")
+    __slots__ = ("span", "_token", "_prev_active")
 
     def __init__(self, span: Span):
         self.span = span
         self._token = None
+        self._prev_active = None
 
     def __enter__(self) -> Span:
         self._token = CURRENT.set(self.span)
+        self._prev_active = _thread_activate(self.span)
         return self.span
 
     def __exit__(self, exc_type, exc, tb):
         if exc is not None:
             self.span.attrs.setdefault("error", repr(exc))
         CURRENT.reset(self._token)
+        _thread_restore(self._prev_active)
         self.span.end()
         return False
 
 
 def root_span(name: str, traceparent: Optional[str] = None,
-              **attrs) -> _SpanCtx:
+              start: Optional[float] = None, **attrs) -> _SpanCtx:
     """Start a new exported trace rooted at this span.  ``traceparent``
     (the W3C header value) adopts the caller's trace id so the deny log
-    line and /debug/traces entry correlate with the upstream trace."""
+    line and /debug/traces entry correlate with the upstream trace.
+    ``start`` backdates the root to an already-measured perf_counter
+    anchor (the front door's accept time), so child stage spans recorded
+    against that anchor stay inside the root duration."""
     parent = parse_traceparent(traceparent)
     if parent is not None:
         tr = Trace(trace_id=parent[0], remote_parent=parent[1])
-        sp = Span(name, tr, parent_id=parent[1], **attrs)
+        sp = Span(name, tr, parent_id=parent[1], start=start, **attrs)
     else:
         tr = Trace()
-        sp = Span(name, tr, **attrs)
+        sp = Span(name, tr, start=start, **attrs)
     # fleet identity on every root span: /debug/traces entries from N
     # replicas merged by an aggregator stay attributable (docs/fleet.md)
     from ..util import replica_id
@@ -456,18 +510,21 @@ class _UseCtx:
     e.g. the batcher's per-request fallback evaluating under each
     request's own span)."""
 
-    __slots__ = ("_span", "_token")
+    __slots__ = ("_span", "_token", "_prev_active")
 
     def __init__(self, sp: Span):
         self._span = sp
         self._token = None
+        self._prev_active = None
 
     def __enter__(self) -> Span:
         self._token = CURRENT.set(self._span)
+        self._prev_active = _thread_activate(self._span)
         return self._span
 
     def __exit__(self, exc_type, exc, tb):
         CURRENT.reset(self._token)
+        _thread_restore(self._prev_active)
         return False
 
 

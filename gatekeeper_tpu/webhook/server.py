@@ -394,14 +394,18 @@ class MicroBatcher:
                         batch_deadline_ms=round(self._deadline_s * 1e3, 3),
                         offered_load_rps=round(self._load_rps, 1),
                     )
-                    btoken = obstrace.CURRENT.set(bsp)
+                    # activate (not a bare CURRENT.set): the sampling
+                    # profiler's stage correlation reads the cross-
+                    # thread registry, and this loop is exactly the
+                    # dispatch thread it needs to see (obs/profiler.py)
+                    btoken = obstrace.activate(bsp)
             try:
                 if batch:
                     responses = self._client.review_batch(
                         [p.obj for p in batch]
                     )
                     if bsp is not None:
-                        obstrace.CURRENT.reset(btoken)
+                        obstrace.deactivate(btoken)
                         btoken = None
                         bsp.end()
                         bsp = None
@@ -420,7 +424,7 @@ class MicroBatcher:
                 # request traces (and keep appending after their waiters
                 # were released)
                 if bsp is not None:
-                    obstrace.CURRENT.reset(btoken)
+                    obstrace.deactivate(btoken)
                     btoken = None
                     bsp.end()
                     bsp = None
@@ -446,7 +450,7 @@ class MicroBatcher:
                     p.event.set()
             finally:
                 if btoken is not None:
-                    obstrace.CURRENT.reset(btoken)
+                    obstrace.deactivate(btoken)
                 if bsp is not None:
                     bsp.end()  # idempotent on the success path
                 self._busy = False

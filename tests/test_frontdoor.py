@@ -1,7 +1,10 @@
 """Front-door resilience (ISSUE 8): the bounded single retry onto a
 different live backend, health-based ejection, probing readmission, and
-the supervisor's backend-swap hook.  All against stub HTTP backends —
-no replica spawn, so this runs everywhere tier-1 does."""
+the supervisor's backend-swap hook — plus the ISSUE 11 wire-path
+observability contract (trace origination + stage spans, correlation
+headers on EVERY path, /fleetz latency summaries, stage metrics).  All
+against stub HTTP backends — no replica spawn, so this runs everywhere
+tier-1 does."""
 
 import http.client
 import json
@@ -12,7 +15,13 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import pytest
 
-from gatekeeper_tpu.fleet.frontdoor import ROUND_ROBIN, FrontDoor
+from gatekeeper_tpu.fleet.frontdoor import (
+    ROUND_ROBIN,
+    WIRE_STAGES,
+    FrontDoor,
+)
+from gatekeeper_tpu.metrics.views import global_registry
+from gatekeeper_tpu.obs import trace as obstrace
 
 
 def _free_port() -> int:
@@ -153,6 +162,214 @@ class TestBoundedRetry:
                 if b["replica_id"].startswith("dead")
             ))
             assert all(_post(door.port)[0] == 200 for _ in range(4))
+        finally:
+            door.stop()
+
+
+class _EchoHeaders:
+    """Backend that records the request headers it received."""
+
+    def __init__(self):
+        outer = self
+        self.headers: list = []
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(n)
+                outer.headers.append(dict(self.headers))
+                body = b'{"ok": true}'
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self.server.server_address[1]
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+class TestWireObservability:
+    """ISSUE 11: the door originates a W3C trace per request with the
+    stable stage set, injects traceparent downstream, stamps
+    correlation headers on every path, and summarizes per-backend
+    latency on /fleetz."""
+
+    def test_trace_originated_with_full_stage_set(self, live_backend):
+        # the global tracer's sampling/buffer config is sticky across
+        # tests: pin full retention so the wire trace cannot be dropped
+        obstrace.configure(buffer_size=256, sample_rate=1.0)
+        door = FrontDoor(
+            [{"host": "127.0.0.1", "port": live_backend.port,
+              "replica_id": "live"}], probe_interval_s=3600.0,
+        ).start()
+        try:
+            st, hd, _body = _post(door.port)
+            assert st == 200
+            tid = hd.get("X-GK-Trace-Id")
+            assert tid and len(tid) == 32
+
+            def find():
+                # the root span completes AFTER the response bytes are
+                # flushed (write_back is marked before the ctx exits):
+                # the ring entry lands a hair behind the client's read
+                return next(
+                    (t for t in obstrace.get_tracer().traces()
+                     if t["trace_id"] == tid), None,
+                )
+
+            assert wait_until(lambda: find() is not None), \
+                "wire trace never completed into the ring"
+            tr = find()
+            assert tr["root"] == "wire"
+            bd = obstrace.stage_breakdown(tr)
+            # every wire stage present, nothing undocumented
+            assert set(bd) == set(WIRE_STAGES)
+            # disjoint stages: the breakdown sums within the root
+            assert sum(bd.values()) <= tr["duration_ms"] * 1.05
+        finally:
+            door.stop()
+
+    def test_caller_traceparent_adopted_and_reinjected(self):
+        echo = _EchoHeaders()
+        door = FrontDoor(
+            [{"host": "127.0.0.1", "port": echo.port,
+              "replica_id": "e"}], probe_interval_s=3600.0,
+        ).start()
+        try:
+            caller_tid = "ab" * 16
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", door.port, timeout=10)
+            conn.request(
+                "POST", "/v1/admit", body=b"{}",
+                headers={
+                    "Content-Type": "application/json",
+                    "traceparent":
+                        f"00-{caller_tid}-{'12' * 8}-01",
+                },
+            )
+            r = conn.getresponse()
+            hd = dict(r.getheaders())
+            r.read()
+            conn.close()
+            # the caller's trace id is adopted...
+            assert hd["X-GK-Trace-Id"] == caller_tid
+            # ...and re-injected downstream with the DOOR's span id,
+            # not the caller's (the replica must parent to the door)
+            seen = echo.headers[-1].get("traceparent")
+            assert seen is not None and caller_tid in seen
+            assert "12" * 8 not in seen
+        finally:
+            door.stop()
+            echo.stop()
+
+    def test_correlation_headers_on_error_paths(self):
+        """The satellite regression: 502/all-down and bad-request
+        responses must carry the trace id (and the last-tried backend)
+        too — an unattributable 502 is unactionable."""
+        door = FrontDoor(
+            [{"host": "127.0.0.1", "port": _free_port(),
+              "replica_id": "dead"}], probe_interval_s=3600.0,
+        ).start()
+        try:
+            st, hd, _body = _post(door.port)
+            assert st == 502
+            assert hd.get("X-GK-Trace-Id")
+            assert hd.get("X-GK-Replica") == "dead"
+            # bad framing: trace id still present
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", door.port, timeout=10)
+            conn.request("POST", "/v1/admit", body=b"{}",
+                         headers={"Content-Length": "nope"})
+            r = conn.getresponse()
+            hd = dict(r.getheaders())
+            r.read()
+            conn.close()
+            assert r.status == 400
+            assert hd.get("X-GK-Trace-Id")
+        finally:
+            door.stop()
+
+    def test_stage_and_request_metrics_recorded(self, live_backend):
+        door = FrontDoor(
+            [{"host": "127.0.0.1", "port": live_backend.port,
+              "replica_id": "live"}], probe_interval_s=3600.0,
+        ).start()
+        try:
+            reqs_before = dict(global_registry().view_rows(
+                "frontdoor_requests_total"))
+            assert _post(door.port)[0] == 200
+
+            def stages_seen():
+                # write_back records a hair after the response flushes
+                return {k[0] for k in global_registry().view_rows(
+                    "frontdoor_stage_seconds")}
+
+            assert wait_until(
+                lambda: set(WIRE_STAGES) <= stages_seen()
+            ), stages_seen()
+            reqs = global_registry().view_rows(
+                "frontdoor_requests_total")
+            key = ("ok", "live")
+            assert reqs.get(key, 0) == reqs_before.get(key, 0) + 1
+        finally:
+            door.stop()
+
+    def test_fleetz_latency_summary(self, live_backend):
+        door = FrontDoor(
+            [{"host": "127.0.0.1", "port": live_backend.port,
+              "replica_id": "live"}], probe_interval_s=3600.0,
+        ).start()
+        try:
+            for _ in range(5):
+                assert _post(door.port)[0] == 200
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", door.port, timeout=10)
+            conn.request("GET", "/fleetz")
+            stats = json.loads(conn.getresponse().read())
+            conn.close()
+            lat = stats["backends"][0]["latency"]
+            assert lat["n"] == 5
+            assert lat["p50_ms"] is not None
+            assert lat["p99_ms"] >= lat["p50_ms"]
+            assert lat["window_s"] == FrontDoor.LATENCY_WINDOW_S
+        finally:
+            door.stop()
+
+    def test_door_serves_metrics_and_debug(self, live_backend):
+        obstrace.configure(buffer_size=256, sample_rate=1.0)
+        door = FrontDoor(
+            [{"host": "127.0.0.1", "port": live_backend.port,
+              "replica_id": "live"}], probe_interval_s=3600.0,
+        ).start()
+        try:
+            assert _post(door.port)[0] == 200
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", door.port, timeout=10)
+            conn.request("GET", "/metrics")
+            body = conn.getresponse().read().decode()
+            assert "gatekeeper_frontdoor_stage_seconds" in body
+            assert "# EOF" not in body
+
+            def ring_traces():
+                conn.request("GET", "/debug/traces?min_ms=0")
+                r = conn.getresponse()
+                assert r.status == 200
+                return json.loads(r.read())["traces"]
+
+            # the wire trace completes just after the response flushes
+            assert wait_until(lambda: bool(ring_traces()))
+            conn.close()
         finally:
             door.stop()
 

@@ -1,0 +1,251 @@
+"""Sampling profiler (ISSUE 11): bounded-rate/bounded-memory sampling,
+stage correlation through the tracer's thread registry, the
+/debug/profilez router contract, and the `obs.profiler_stall` chaos
+behavior (a wedged sampler degrades alone — snapshots and shutdown stay
+bounded)."""
+
+import threading
+import time
+
+import pytest
+
+from gatekeeper_tpu import faults
+from gatekeeper_tpu.faults import FaultRule
+from gatekeeper_tpu.obs import trace as obstrace
+from gatekeeper_tpu.obs.debug import get_router
+from gatekeeper_tpu.obs.profiler import MAX_HZ, SamplingProfiler
+
+
+def _busy(stop: threading.Event):
+    while not stop.is_set():
+        sum(range(500))
+
+
+def wait_until(cond, timeout_s=5.0, step_s=0.02):
+    end = time.monotonic() + timeout_s
+    while time.monotonic() < end:
+        if cond():
+            return True
+        time.sleep(step_s)
+    return cond()
+
+
+class TestSampler:
+    def test_collects_stacks_from_busy_threads(self):
+        prof = SamplingProfiler(hz=100)
+        stop = threading.Event()
+        th = threading.Thread(target=_busy, args=(stop,),
+                              name="prof-busy", daemon=True)
+        th.start()
+        try:
+            prof.start()
+            assert wait_until(lambda: prof.samples > 5)
+            txt = prof.collapsed()
+            assert "prof-busy" in txt
+            # folded format: "thread;...;frames count"
+            body = [ln for ln in txt.splitlines()
+                    if not ln.startswith("#")]
+            assert body and all(
+                ln.rsplit(" ", 1)[1].isdigit() for ln in body
+            )
+        finally:
+            stop.set()
+            prof.stop()
+            th.join(timeout=5)
+
+    def test_rate_is_bounded(self):
+        prof = SamplingProfiler(hz=10_000)
+        try:
+            assert prof.hz <= MAX_HZ
+        finally:
+            prof.stop()
+
+    def test_memory_bound_counts_overflow(self):
+        """The REAL sampling path against live threads: with the
+        minimum max_stacks bound (the constructor floors it at 16),
+        extra threads' samples must overflow (counted) while the table
+        never grows past the bound.  The sample key includes the thread
+        NAME, so 24 distinctly-named busy threads guarantee more unique
+        keys than the bound."""
+        prof = SamplingProfiler(hz=0, max_stacks=2)
+        assert prof.max_stacks == 16  # constructor floor
+        stop = threading.Event()
+        threads = [
+            threading.Thread(target=_busy, args=(stop,),
+                             name=f"ovf-{i}", daemon=True)
+            for i in range(24)
+        ]
+        for t in threads:
+            t.start()
+        try:
+            # drive the sampler's own tick (no sampler thread at hz=0)
+            for _ in range(3):
+                prof._sample_once(own_ident=-1)
+            snap = prof.snapshot()
+            assert snap["unique_stacks"] <= 16, snap["unique_stacks"]
+            assert snap["overflow"] > 0
+            assert snap["samples"] > 0  # existing stacks still count
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=5)
+
+    def test_stage_correlation_tags_samples(self):
+        """A thread sampled inside a stage-tagged span must show
+        stage:<name> in its folded line."""
+        prof = SamplingProfiler(hz=200)
+        stop = threading.Event()
+        seen = threading.Event()
+
+        def staged():
+            with obstrace.root_span("prof-root"):
+                with obstrace.span("prof.work", stage="dispatch"):
+                    seen.set()
+                    _busy(stop)
+
+        th = threading.Thread(target=staged, name="prof-staged",
+                              daemon=True)
+        th.start()
+        try:
+            assert seen.wait(5)
+            prof.start()
+            assert wait_until(
+                lambda: "stage:dispatch" in prof.collapsed(), 10.0
+            ), prof.collapsed()
+        finally:
+            stop.set()
+            prof.stop()
+            th.join(timeout=5)
+
+    def test_reconfigure_and_idempotent_start(self):
+        prof = SamplingProfiler(hz=50)
+        try:
+            prof.start()
+            t1 = prof._thread
+            prof.start()  # idempotent: same live thread kept
+            assert prof._thread is t1
+            prof.configure(hz=25)  # re-rate restarts the thread
+            assert prof.running and prof._thread is not t1
+            prof.configure(hz=0)  # 0 stops it
+            assert not prof.running
+        finally:
+            prof.stop()
+
+
+class TestEnvHz:
+    def test_malformed_env_falls_back_instead_of_crashing(self,
+                                                          monkeypatch):
+        """Review regression: a typo'd GK_PROFILER_HZ must not kill
+        module import or argparse construction for every replica."""
+        from gatekeeper_tpu.obs.profiler import DEFAULT_HZ, env_hz
+
+        monkeypatch.setenv("GK_PROFILER_HZ", "19hz")
+        assert env_hz() == DEFAULT_HZ
+        monkeypatch.setenv("GK_PROFILER_HZ", "")
+        assert env_hz() == DEFAULT_HZ
+        monkeypatch.setenv("GK_PROFILER_HZ", "7.5")
+        assert env_hz() == 7.5
+        # the flag default route survives the bad env too
+        monkeypatch.setenv("GK_PROFILER_HZ", "nonsense")
+        from gatekeeper_tpu.main import build_parser
+
+        args = build_parser().parse_args([])
+        assert args.profiler_hz == DEFAULT_HZ
+
+
+class TestProfilezRoute:
+    def test_profilez_served_and_reset(self):
+        prof = SamplingProfiler(hz=0)
+        with prof._lock:
+            prof._counts[("t", "", ("f",))] = 3
+            prof.samples = 3
+        import gatekeeper_tpu.obs.profiler as profmod
+
+        old = profmod._PROFILER
+        profmod._PROFILER = prof
+        try:
+            code, ctype, body = get_router().handle("/debug/profilez")
+            assert code == 200 and ctype.startswith("text/plain")
+            assert b"t;f 3" in body
+            code, _ct, body = get_router().handle(
+                "/debug/profilez", "reset=1"
+            )
+            assert code == 200
+            assert prof.snapshot()["unique_stacks"] == 0
+        finally:
+            profmod._PROFILER = old
+            prof.stop()
+
+    def test_profilez_bad_param_is_json_400(self):
+        code, ctype, body = get_router().handle(
+            "/debug/profilez", "reset=nope"
+        )
+        assert code == 400
+        assert b"reset" in body
+
+
+@pytest.mark.chaos
+class TestProfilerStallChaos:
+    def test_hang_wedges_sampler_alone(self):
+        """A hang-mode obs.profiler_stall parks the sampler thread; the
+        aggregate keeps serving and stop() stays bounded."""
+        prof = SamplingProfiler(hz=200)
+        plane = faults.install(seed=3)
+        plane.add(faults.PROFILER_STALL,
+                  FaultRule(mode="hang", count=1))
+        try:
+            prof.start()
+            # the first tick parks on the hang; snapshot/collapsed must
+            # keep answering from the (empty) aggregate immediately
+            time.sleep(0.05)
+            assert prof.snapshot()["samples"] == 0
+            assert prof.collapsed().startswith("# gk-profiler")
+            t0 = time.monotonic()
+            prof.stop()  # bounded despite the parked thread
+            assert time.monotonic() - t0 < 5.0
+        finally:
+            faults.uninstall()  # releases the hang; thread exits
+
+    def test_wedged_then_restarted_sampler_leaves_no_orphan(self):
+        """Review regression: a sampler wedged past its stop-join that
+        is then re-rated (configure -> stop times out -> start) must
+        NOT resume sampling when the hang releases — each incarnation
+        owns its own stop event, so the unwedged predecessor exits."""
+        prof = SamplingProfiler(hz=200)
+        plane = faults.install(seed=5)
+        plane.add(faults.PROFILER_STALL,
+                  FaultRule(mode="hang", count=1))
+        try:
+            prof.start()
+            time.sleep(0.05)  # first tick parks on the hang
+            prof.configure(hz=100)  # stop (times out) + fresh start
+            assert prof.running
+        finally:
+            faults.uninstall()  # releases the wedged predecessor
+        try:
+            # the released predecessor must EXIT, not resume: exactly
+            # one gk-profiler thread stays alive
+            def one_sampler():
+                alive = [t for t in threading.enumerate()
+                         if t.name == "gk-profiler" and t.is_alive()]
+                return len(alive) == 1
+            assert wait_until(one_sampler, 5.0), [
+                t.name for t in threading.enumerate()
+                if t.name == "gk-profiler"
+            ]
+        finally:
+            prof.stop()
+
+    def test_error_mode_skips_tick_and_counts(self):
+        prof = SamplingProfiler(hz=200)
+        plane = faults.install(seed=4)
+        plane.add(faults.PROFILER_STALL,
+                  FaultRule(mode="error", count=3))
+        try:
+            prof.start()
+            assert wait_until(lambda: prof.stalls >= 3)
+            # after the 3 injected errors the sampler keeps sampling
+            assert wait_until(lambda: prof.samples > 0)
+        finally:
+            faults.uninstall()
+            prof.stop()

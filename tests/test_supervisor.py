@@ -54,7 +54,8 @@ srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
 threading.Thread(target=srv.serve_forever, daemon=True).start()
 print(json.dumps({
     "event": "ready", "replica_id": sys.argv[2], "port":
-    srv.server_address[1], "ready_s": 0.01, "restore_outcome": "restored",
+    srv.server_address[1], "metrics_port": srv.server_address[1],
+    "ready_s": 0.01, "restore_outcome": "restored",
     "templates": 0,
 }), flush=True)
 if mode == "flaky":
@@ -180,6 +181,32 @@ class TestCrashRecovery:
                 and sup.status()["r0"]["state"] == "running"
             )), f"wedge never detected: {sup.status()}"
             assert sup.status()["r0"]["pid"] != pid0
+        finally:
+            sup.stop()
+
+
+class TestObservabilityTargets:
+    def test_target_rosters_follow_a_restart(self, spawner):
+        """trace_targets()/metrics_targets() (the fleet observability
+        plane's live rosters, ISSUE 11) must report the CURRENT
+        incarnation's ports — a restarted replica's fresh ephemeral
+        port, not the dead one's."""
+        sup = make_supervisor()
+        try:
+            (h,) = sup.start(1)
+            t0 = sup.trace_targets()
+            m0 = sup.metrics_targets()
+            assert t0 == [{"replica_id": "r0", "host": h.host,
+                           "port": h.port}]
+            assert m0[0]["port"] == h.metrics_port > 0
+            os.kill(h.proc.pid, signal.SIGKILL)
+            assert wait_until(lambda: (
+                sup.status()["r0"]["state"] == "running"
+                and sup.status()["r0"]["pid"] != h.proc.pid
+            ))
+            t1 = sup.trace_targets()
+            assert len(t1) == 1
+            assert t1[0]["port"] == sup.status()["r0"]["port"]
         finally:
             sup.stop()
 

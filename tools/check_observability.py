@@ -23,6 +23,16 @@ tests/test_observability_check.py; also runnable standalone):
    cost-ledger collector), and the collector must actually cap — an
    uncapped per-template label explodes Prometheus cardinality on a
    500-template cluster.
+6. Wire-stage conformance (ISSUE 11): the front door's stable
+   WIRE_STAGES set must match the documented table in docs/tracing.md,
+   and every ``STAGE_*`` constant the module defines must be listed in
+   WIRE_STAGES — an undocumented or unlisted stage breaks the
+   stage-breakdown contract bench.py's wire-path section reports on.
+7. Federated-format invariants (ISSUE 11): merging N replica scrapes
+   through obs/fleetobs.py must preserve the classic exposition
+   discipline — ONE HELP/TYPE header per family, no exemplars, no
+   ``# EOF`` — inject ``replica_id`` into unlabelled remote samples, and
+   leave samples that already carry a replica_id untouched.
 
 Run: python tools/check_observability.py   (exit 0 clean, 1 with findings)
 """
@@ -43,6 +53,9 @@ HOT_PATH_MODULES = (
     "gatekeeper_tpu/obs/costs.py",
     "gatekeeper_tpu/obs/slo.py",
     "gatekeeper_tpu/obs/debug.py",
+    "gatekeeper_tpu/obs/profiler.py",
+    "gatekeeper_tpu/obs/fleetobs.py",
+    "gatekeeper_tpu/fleet/frontdoor.py",
     "gatekeeper_tpu/metrics/views.py",
     "gatekeeper_tpu/metrics/exporter.py",
     "gatekeeper_tpu/webhook/server.py",
@@ -211,6 +224,112 @@ def check_label_cardinality() -> list:
     return problems
 
 
+def check_wire_stages() -> list:
+    """The front door's WIRE_STAGES set vs its own STAGE_* constants and
+    the docs/tracing.md stage table."""
+    from gatekeeper_tpu.fleet import frontdoor
+
+    problems = []
+    stages = set(frontdoor.WIRE_STAGES)
+    declared = {
+        v for k, v in vars(frontdoor).items()
+        if k.startswith("STAGE_") and isinstance(v, str)
+    }
+    for s in declared - stages:
+        problems.append(
+            f"frontdoor stage constant {s!r} is not listed in "
+            "WIRE_STAGES — it would be invisible to the stage-breakdown "
+            "contract"
+        )
+    for s in stages - declared:
+        problems.append(
+            f"WIRE_STAGES entry {s!r} has no STAGE_* constant in "
+            "fleet/frontdoor.py"
+        )
+    doc_path = os.path.join(REPO, "docs", "tracing.md")
+    try:
+        with open(doc_path) as f:
+            doc = f.read()
+    except OSError as e:
+        return problems + [f"docs/tracing.md unreadable: {e}"]
+    for s in sorted(stages):
+        if f"`{s}`" not in doc:
+            problems.append(
+                f"wire stage {s!r} is not documented in docs/tracing.md "
+                "(the stable stage-name table)"
+            )
+    return problems
+
+
+def check_federated_format() -> list:
+    """Merge synthetic replica scrapes through obs/fleetobs.py and
+    verify the classic exposition invariants survive federation."""
+    from gatekeeper_tpu.metrics.exporter import render_prometheus
+    from gatekeeper_tpu.metrics.views import (
+        AGG_COUNT,
+        AGG_DISTRIBUTION,
+        Measure,
+        Registry,
+        View,
+    )
+    from gatekeeper_tpu.obs.fleetobs import merge_families, render_families
+
+    problems = []
+    reg = Registry()
+    m = Measure("fed_check_seconds", "synthetic", "s")
+    c = Measure("fed_check_reqs", "synthetic")
+    reg.register(
+        View("fed_check_seconds", m, AGG_DISTRIBUTION, buckets=(0.1, 1.0)),
+        View("fed_check_total", c, AGG_COUNT, tag_keys=("outcome",)),
+    )
+    reg.record(m, 0.05, exemplar_trace_id="cd" * 16)
+    reg.record(c, 1.0, {"outcome": "ok"})
+    local = render_prometheus(reg)
+    remote = (
+        "# HELP gatekeeper_fed_check_total synthetic\n"
+        "# TYPE gatekeeper_fed_check_total counter\n"
+        'gatekeeper_fed_check_total{outcome="ok"} 3\n'
+        'gatekeeper_fed_check_total{outcome="ok",replica_id="rX"} 2\n'
+        "# HELP gatekeeper_fed_up synthetic\n"
+        "# TYPE gatekeeper_fed_up gauge\n"
+        "gatekeeper_fed_up 1\n"
+    )
+    out = render_families(merge_families(
+        local, [("r0", remote), ("r1", remote)]
+    ))
+    if "# EOF" in out or " # {" in out:
+        problems.append(
+            "federated output leaked an OpenMetrics construct "
+            "(exemplar or # EOF) into the classic format"
+        )
+    lines = out.splitlines()
+    for kind in ("HELP", "TYPE"):
+        seen = [ln.split()[2] for ln in lines
+                if ln.startswith(f"# {kind} ")]
+        dupes = {n for n in seen if seen.count(n) > 1}
+        if dupes:
+            problems.append(
+                f"federated output repeats # {kind} for {sorted(dupes)} "
+                "— one header per family is the classic contract"
+            )
+    if 'gatekeeper_fed_up{replica_id="r0"} 1' not in out \
+            or 'gatekeeper_fed_up{replica_id="r1"} 1' not in out:
+        problems.append(
+            "federation did not inject replica_id into unlabelled "
+            "remote samples"
+        )
+    if 'outcome="ok",replica_id="rX"' not in out:
+        problems.append(
+            "federation rewrote a sample that already carried its own "
+            "replica_id label (replica-stamped series are authoritative)"
+        )
+    if out.count('gatekeeper_fed_check_total{outcome="ok"} 1') != 1:
+        problems.append(
+            "federation lost or duplicated the parent's own samples"
+        )
+    return problems
+
+
 def run_checks() -> list:
     sys.path.insert(0, REPO)
     return (
@@ -219,6 +338,8 @@ def run_checks() -> list:
         + check_monotonic_span_timing()
         + check_exemplar_wellformed()
         + check_label_cardinality()
+        + check_wire_stages()
+        + check_federated_format()
     )
 
 
