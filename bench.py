@@ -2698,6 +2698,432 @@ def bench_chaos_fleet() -> dict:
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_overload() -> dict:
+    """Overload robustness (ISSUE 12, recorded as OVERLOAD_r12): 2
+    replicas restore one sealed snapshot behind the overload-armed front
+    door (per-backend inflight bound, 1s admission budget, retry
+    budget); closed-loop client fleets drive 1x/2x/5x/10x the
+    saturation concurrency through the door.  The fleets HONOR the shed
+    contract — a 429's Retry-After paces them, capped at
+    BENCH_OVERLOAD_BACKOFF_S so they stay far more aggressive than the
+    door asks — because that is what the header is for; an extra
+    no-backoff phase records the abusive floor (a tight shed/retry loop
+    that on this one-core box steals the door's own CPU), where sheds
+    must STILL answer fast with exact verdicts.  Recorded per level:
+    offered and GOODPUT rates (no congestive collapse: goodput at 10x
+    must hold >= 70% of the 1x peak), accepted-request p50/p99 (p99
+    within the admission budget), shed counts by layer, and shed-answer
+    latency (door-side, from the wire traces: the single-digit-ms
+    criterion).  Verdict parity vs a fresh interpreter oracle is
+    checked on EVERY accepted response at every level — shedding drops
+    requests, never accuracy.  A seeded `fleet.overload_storm` chaos
+    phase then proves zero divergence while shedding under injected
+    slow-replica latency, and the brownout ladder is observed stepping
+    UP under the storm and RECOVERING to level 0 with hysteresis."""
+    import http.client as _httpc
+    import shutil
+    import tempfile
+    import threading
+
+    from gatekeeper_tpu import faults as _faults
+    from gatekeeper_tpu.faults import FaultRule
+    from gatekeeper_tpu.fleet import FrontDoor, spawn_fleet
+    from gatekeeper_tpu.obs import brownout as obsbrownout
+    from gatekeeper_tpu.obs import trace as obstrace
+    from gatekeeper_tpu.snapshot import Snapshotter
+    from gatekeeper_tpu.util.overloadcheck import (
+        classify_response,
+        verdict_matches,
+    )
+    from gatekeeper_tpu.util.synthetic import (
+        build_driver,
+        build_oracle,
+        make_pods,
+    )
+
+    n_templates = int(os.environ.get("BENCH_OVERLOAD_TEMPLATES", "2"))
+    n_resources = int(os.environ.get("BENCH_OVERLOAD_RESOURCES", "256"))
+    n_corpus = int(os.environ.get("BENCH_OVERLOAD_CORPUS", "64"))
+    phase_s = float(os.environ.get("BENCH_OVERLOAD_PHASE_S", "6"))
+    levels = [int(x) for x in os.environ.get(
+        "BENCH_OVERLOAD_LEVELS", "1,2,5,10").split(",")]
+    base_clients = int(os.environ.get("BENCH_OVERLOAD_BASE_CLIENTS", "2"))
+    max_inflight = int(os.environ.get("BENCH_OVERLOAD_INFLIGHT", "1"))
+    budget_s = float(os.environ.get("BENCH_OVERLOAD_BUDGET_S", "1.0"))
+    max_pending = int(os.environ.get("BENCH_OVERLOAD_MAX_PENDING", "64"))
+
+    root = tempfile.mkdtemp(prefix="gk-overload-bench-")
+    snap_dir = os.path.join(root, "snap")
+    cache_dir = os.path.join(root, "cache")
+    os.makedirs(snap_dir)
+    os.makedirs(cache_dir)
+
+    client = build_driver(n_templates, n_resources)
+    client.audit_capped(50)
+    assert Snapshotter(client, snap_dir, interval_s=0.0).write_once()
+
+    pods = make_pods(n_corpus, seed=61, violation_rate=0.4)
+    reqs = []
+    for i, p in enumerate(pods):
+        reqs.append({
+            "uid": f"ov-{i}",
+            "kind": {"group": "", "version": "v1", "kind": "Pod"},
+            "name": p["metadata"]["name"],
+            "namespace": p["metadata"]["namespace"],
+            "operation": "CREATE",
+            "userInfo": {"username": "overload-bench"},
+            "object": p,
+        })
+    bodies = [json.dumps({"request": r}).encode() for r in reqs]
+    oracle = build_oracle(n_templates, n_resources)
+    oracle_verdicts = []
+    for req in reqs:
+        results = oracle.review(
+            {k: req[k] for k in
+             ("kind", "name", "namespace", "operation", "object")}
+        ).results()
+        oracle_verdicts.append(
+            (not results, sorted(r.msg for r in results)))
+
+    def verdict_ok(out: dict, idx: int) -> bool:
+        # shared normalization with tools/check_overload.py: the tier-1
+        # gate and this artifact must judge the same bytes the same way
+        return verdict_matches(out, oracle_verdicts[idx])
+
+    handles = spawn_fleet(
+        2, snapshot_dir=snap_dir, cache_dir=cache_dir,
+        env={"JAX_PLATFORMS": "cpu"},
+        extra_flags=["--webhook-max-pending", str(max_pending)],
+    )
+    door = None
+    ctl = obsbrownout.get_controller()
+    try:
+        for h in handles:
+            assert h.ready.get("restore_outcome") == "restored", h.ready
+        door = FrontDoor(
+            [h.backend() for h in handles], probe_interval_s=0.1,
+            max_inflight=max_inflight, admission_budget_s=budget_s,
+        ).start()
+        # a deep trace ring: door-side shed latency is read from the
+        # wire traces (outcome attr), and the storm produces thousands
+        obstrace.configure(buffer_size=4096, sample_rate=1.0)
+        # the bench parent IS the door process: its global brownout
+        # controller sees every door shed via record_shed, so the
+        # ladder is driven by REAL signals (no actions wired — the
+        # parent has no audit/profiler to degrade; the ladder itself
+        # is the observable)
+        ctl.reset()
+        ctl.start()
+        level_series: list = []  # (wall_s, level) across the whole run
+        series_stop = threading.Event()
+        t_bench0 = time.monotonic()
+
+        def poll_levels():
+            while not series_stop.wait(0.1):
+                level_series.append(
+                    (round(time.monotonic() - t_bench0, 1), ctl.level))
+
+        poller = threading.Thread(target=poll_levels, daemon=True)
+        poller.start()
+
+        # warm both replicas through the door (jit, memos, connections)
+        for i in range(16):
+            st, _hd, _b = _door_post(door.port, bodies[i % len(bodies)])
+            assert st in (200, 429), st
+
+        # shed-backoff the client fleet applies on a 429: the shed
+        # contract's Retry-After is 1s — these clients are IMPATIENT
+        # (they cap the advertised wait at this fraction) but not
+        # abusive; a separate no-backoff phase records the abusive
+        # floor.  On this one-core box the load generators share the
+        # GIL with the door, so a no-backoff fleet's shed loop consumes
+        # the very CPU goodput needs — precisely the storm Retry-After
+        # exists to prevent
+        backoff_s = float(os.environ.get("BENCH_OVERLOAD_BACKOFF_S",
+                                         "0.25"))
+
+        def run_phase(n_clients: int, duration: float,
+                      backoff=None):
+            # per-phase trace isolation: door-side latency (sheds AND
+            # accepted) is read from the wire ring afterwards, so it
+            # must hold only THIS phase's requests
+            obstrace.get_tracer().clear()
+            backoff = backoff_s if backoff is None else backoff
+            results: list = []
+            lock = threading.Lock()
+            stop_at = time.monotonic() + duration
+
+            def slam(tid: int):
+                # one persistent keep-alive connection per client: a
+                # real apiserver reuses connections, and a fresh
+                # connection per request would bill a handler-thread
+                # spawn to every shed
+                conn = None
+                i = tid
+                while time.monotonic() < stop_at:
+                    idx = i % len(reqs)
+                    i += n_clients
+                    t0 = time.perf_counter()
+                    try:
+                        if conn is None:
+                            conn = _httpc.HTTPConnection(
+                                "127.0.0.1", door.port, timeout=30)
+                        conn.request(
+                            "POST", "/v1/admit", body=bodies[idx],
+                            headers={
+                                "Content-Type": "application/json"})
+                        r = conn.getresponse()
+                        data = r.read()
+                        st = r.status
+                        retry_after = r.getheader("Retry-After")
+                    except Exception:
+                        st, data, retry_after = 0, b"", None
+                        try:
+                            if conn is not None:
+                                conn.close()
+                        except OSError:
+                            pass
+                        conn = None
+                    dur = time.perf_counter() - t0
+                    with lock:
+                        results.append((st, dur, data, idx))
+                    if st == 429 and backoff > 0:
+                        try:
+                            wait = min(float(retry_after or 1.0),
+                                       backoff)
+                        except ValueError:
+                            wait = backoff
+                        time.sleep(wait)
+                if conn is not None:
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+
+            ts = [threading.Thread(target=slam, args=(t,))
+                  for t in range(n_clients)]
+            t0 = time.monotonic()
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=duration + 120)
+                if t.is_alive():
+                    raise RuntimeError("overload client wedged — a "
+                                       "refusal path is hanging")
+            wall = time.monotonic() - t0
+            return results, wall
+
+        # shared taxonomy with tools/check_overload.py (one copy: the
+        # tier-1 gate and this artifact cannot drift apart)
+        classify = classify_response
+
+        def pct(xs, q):
+            if not xs:
+                return None
+            xs = sorted(xs)
+            return round(xs[min(int(q * len(xs)), len(xs) - 1)], 3)
+
+        def wire_latencies():
+            """{outcome: [duration_ms]} over this phase's wire traces —
+            the DOOR's answer time (accept..write_back), free of the
+            co-located load generators' client-thread scheduling noise
+            (a real apiserver does not share the door's GIL)."""
+            out: dict = {}
+            for t in obstrace.get_tracer().traces():
+                if t.get("root") != "wire":
+                    continue
+                rootspan = next(
+                    (s for s in t.get("spans", ())
+                     if s.get("name") == "wire"), None)
+                if rootspan is None:
+                    continue
+                oc = (rootspan.get("attrs") or {}).get("outcome")
+                if oc:
+                    out.setdefault(oc, []).append(t["duration_ms"])
+            return out
+
+        phase_out = {}
+        divergence_box = [0]
+
+        def measure(label: str, n_clients: int, backoff=None,
+                    duration=None):
+            results, wall = run_phase(
+                n_clients, phase_s if duration is None else duration,
+                backoff=backoff,
+            )
+            counts: dict = {}
+            accepted_client_ms, divergences = [], 0
+            door_shed = replica_shed = expired = errors = 0
+            for st, dur, data, idx in results:
+                kind, out = classify(st, data)
+                counts[kind] = counts.get(kind, 0) + 1
+                if kind == "accepted":
+                    accepted_client_ms.append(dur * 1e3)
+                    if not verdict_ok(out, idx):
+                        divergences += 1
+                elif kind == "shed":
+                    if st == 429:
+                        door_shed += 1
+                    else:
+                        replica_shed += 1
+                elif kind == "expired":
+                    expired += 1
+                else:
+                    errors += 1
+            wire = wire_latencies()
+            shed_wire_ms = wire.get("shed", [])
+            ok_wire_ms = wire.get("ok", [])
+            divergence_box[0] += divergences
+            accepted = counts.get("accepted", 0)
+            phase_out[label] = {
+                "clients": n_clients,
+                "offered_rps": round(len(results) / wall, 1),
+                "goodput_rps": round(accepted / wall, 1),
+                "accepted": accepted,
+                "accepted_p50_ms": pct(ok_wire_ms, 0.50),
+                "accepted_p99_ms": pct(ok_wire_ms, 0.99),
+                "accepted_client_p50_ms": pct(accepted_client_ms, 0.50),
+                "accepted_client_p99_ms": pct(accepted_client_ms, 0.99),
+                "door_sheds": door_shed,
+                "replica_sheds": replica_shed,
+                "expired": expired,
+                "errors": errors,
+                "verdict_divergences": divergences,
+                "shed_answer_p50_ms": pct(shed_wire_ms, 0.50),
+                "shed_answer_p99_ms": pct(shed_wire_ms, 0.99),
+                "shed_answer_n": len(shed_wire_ms),
+                "brownout_level_end": ctl.level,
+            }
+            log(f"overload {label} ({n_clients} clients): "
+                f"{phase_out[label]}")
+
+        for mult in levels:
+            measure(f"{mult}x", base_clients * mult)
+        # the abusive floor: the same 10x fleet IGNORING Retry-After —
+        # a tight shed/retry loop that (on this one-core box) steals
+        # the door's own CPU.  Recorded for honesty: sheds must stay
+        # fast and verdicts exact even under the storm the contract
+        # exists to prevent; the goodput criterion applies to the
+        # protocol-conformant fleet above
+        measure(f"{levels[-1]}x_nobackoff",
+                base_clients * levels[-1], backoff=0.0, duration=4.0)
+        divergences_total = divergence_box[0]
+
+        # ---- seeded chaos storm: shedding must never corrupt verdicts ----
+        plane = _faults.install(seed=12)
+        plane.add("fleet.overload_storm",
+                  FaultRule(mode="latency", latency_s=0.25))
+        storm_results, storm_wall = run_phase(base_clients * 6, 4.0)
+        _faults.uninstall()
+        storm_counts: dict = {}
+        storm_divergences = 0
+        for st, dur, data, idx in storm_results:
+            kind, out = classify(st, data)
+            storm_counts[kind] = storm_counts.get(kind, 0) + 1
+            if kind == "accepted" and not verdict_ok(out, idx):
+                storm_divergences += 1
+        storm_level_peak = max(
+            (lv for _t, lv in level_series), default=0)
+        log(f"overload chaos storm: {storm_counts}, divergences="
+            f"{storm_divergences}, ladder peak={storm_level_peak}")
+
+        # ---- recovery: the ladder must step back DOWN with hysteresis ----
+        recovered = False
+        recovery_deadline = time.monotonic() + 60.0
+        while time.monotonic() < recovery_deadline:
+            if ctl.level == 0:
+                recovered = True
+                break
+            time.sleep(0.25)
+        recovery_s = round(time.monotonic() - t_bench0, 1)
+        series_stop.set()
+        poller.join(timeout=5)
+
+        goodput_1x = phase_out[f"{levels[0]}x"]["goodput_rps"]
+        goodput_peak = max(p["goodput_rps"] for p in phase_out.values())
+        top = f"{levels[-1]}x"
+        goodput_top = phase_out[top]["goodput_rps"]
+        ratio = round(goodput_top / max(goodput_1x, 1e-9), 3)
+        shed_p99 = phase_out[top]["shed_answer_p99_ms"]
+        accepted_p99 = phase_out[top]["accepted_p99_ms"]
+        ok = (
+            ratio >= 0.7
+            and divergences_total == 0
+            and storm_divergences == 0
+            and storm_counts.get("shed", 0) > 0
+            and (shed_p99 is not None and shed_p99 < 10.0)
+            and (accepted_p99 is not None
+                 and accepted_p99 <= budget_s * 1e3)
+            and storm_level_peak >= 1
+            and recovered
+        )
+        out = {
+            "metric": (
+                f"goodput at {top} offered load as a fraction of the "
+                f"1x saturation goodput (2 replicas, overload-armed "
+                f"door)"
+            ),
+            "value": ratio,
+            "unit": "goodput_ratio",
+            "vs_baseline": 0,
+            "overload_ok": ok,
+            "overload_goodput_ratio_10x": ratio,
+            "overload_goodput_1x_rps": goodput_1x,
+            "overload_goodput_peak_rps": goodput_peak,
+            "overload_phases": phase_out,
+            "overload_shed_answer_p99_ms": shed_p99,
+            "overload_accepted_p99_ms": accepted_p99,
+            "overload_budget_ms": budget_s * 1e3,
+            "overload_verdict_divergences": divergences_total,
+            "overload_chaos": {
+                "storm_counts": storm_counts,
+                "storm_divergences": storm_divergences,
+                "ladder_peak_level": storm_level_peak,
+                "ladder_recovered": recovered,
+                "recovered_by_s": recovery_s,
+            },
+            "overload_brownout_series": level_series[-400:],
+            "overload_frontdoor": door.stats(),
+            "overload_config": {
+                "templates": n_templates, "resources": n_resources,
+                "phase_s": phase_s, "levels": levels,
+                "base_clients": base_clients,
+                "max_inflight": max_inflight,
+                "budget_s": budget_s, "max_pending": max_pending,
+            },
+        }
+        record = {k: v for k, v in out.items()
+                  if k not in ("metric", "value", "unit", "vs_baseline")}
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "OVERLOAD_r12.json")
+        with open(path, "w") as f:
+            json.dump(record, f, indent=2, sort_keys=True)
+            f.write("\n")
+        log(f"overload recorded: {path}")
+        return out
+    finally:
+        ctl.stop()
+        ctl.reset()
+        if door is not None:
+            door.stop()
+        for h in handles:
+            h.stop()
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def _door_post(port: int, body: bytes, timeout: float = 60):
+    import http.client as _httpc
+
+    conn = _httpc.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", "/v1/admit", body=body,
+                     headers={"Content-Type": "application/json"})
+        r = conn.getresponse()
+        return r.status, dict(r.getheaders()), r.read()
+    finally:
+        conn.close()
+
+
 def _chaos_mesh_stall() -> dict:
     """Mesh-degradation leg of the chaos bench (subprocess on a virtual
     4-device CPU mesh, like mesh_curve): a seeded `mesh.dispatch_stall`
@@ -2781,6 +3207,7 @@ CONFIGS = {
     "multihost": bench_multihost,
     "fleet": bench_fleet,
     "chaos_fleet": bench_chaos_fleet,
+    "overload": bench_overload,
 }
 
 # secondary configs folded into the default run, with the extra-key name
@@ -2803,6 +3230,7 @@ _FOLDED = [
     ("multihost", "multihost_sweep_s"),
     ("fleet", "fleet_reviews_per_s"),
     ("chaos_fleet", "chaos_failed_admissions"),
+    ("overload", "overload_goodput_ratio_10x"),
 ]
 
 

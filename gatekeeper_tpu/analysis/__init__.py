@@ -18,6 +18,8 @@ Pass families (each module registers its rules on import):
   failpolicy     silently swallowed exceptions on admission/audit paths
   hygiene        thread daemon/join, bare joins, listener close,
                  idempotent start()
+  queuebound     unbounded queues (queue.Queue() without maxsize,
+                 list-backed pending queues on serving paths)
   registrycheck  fault-point and metric registries vs their docs
 """
 
@@ -38,6 +40,7 @@ from .core import (  # noqa: F401
 from . import failpolicy  # noqa: F401,E402
 from . import hygiene  # noqa: F401,E402
 from . import locks  # noqa: F401,E402
+from . import queuebound  # noqa: F401,E402
 from . import registrycheck  # noqa: F401,E402
 from . import tracesafety  # noqa: F401,E402
 

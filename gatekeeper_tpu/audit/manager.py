@@ -169,7 +169,17 @@ class AuditManager:
             self._thread = None
 
     def _loop(self):
+        from ..obs import brownout as _brownout
+
         while not self._stop.wait(timeout=self.interval_s):
+            if _brownout.defer_background():
+                # brownout ladder level >= 1 (docs/failure-modes.md):
+                # a sweep competes with saturated admissions for the
+                # same cores, so it steps aside — a skipped iteration,
+                # not a cancelled loop; freshness staleness is visible
+                # via audit_last_run_age_s and the SLO freshness probe
+                log.info("audit sweep deferred by brownout ladder")
+                continue
             self.run_once_guarded()
 
     def run_once_guarded(self) -> bool:

@@ -46,12 +46,22 @@ SCRAPE_FAIL = "fleet.scrape_fail"      # obs/fleetobs.py federated scrape of
 PROFILER_STALL = "obs.profiler_stall"  # obs/profiler.py sampler tick (hang
 #                                        = a wedged sampler; snapshots and
 #                                        the hot path must keep serving)
+# overload robustness plane (ISSUE 12)
+OVERLOAD_STORM = "fleet.overload_storm"  # fleet/frontdoor.py admission POST
+#                                        before routing (latency = handler
+#                                        threads held -> inflight climbs ->
+#                                        the shed/brownout path exercises)
+SLOW_CLIENT = "frontdoor.slow_client"   # fleet/frontdoor.py inbound body
+#                                        read (latency = a client trickling
+#                                        its body holds an accept thread —
+#                                        bounded by the inbound socket
+#                                        timeout)
 
 ALL_POINTS = (
     KUBE_SEND, KUBE_RECV, WATCH_DELIVER, TPU_COMPILE, TPU_DISPATCH,
     WEBHOOK_ENQUEUE, SNAPSHOT_WRITE, SNAPSHOT_LOAD, SNAPSHOT_RESYNC,
     SNAPSHOT_CORRUPT, REPLICA_CRASH, REPLICA_WEDGE, MESH_DISPATCH_STALL,
-    SCRAPE_FAIL, PROFILER_STALL,
+    SCRAPE_FAIL, PROFILER_STALL, OVERLOAD_STORM, SLOW_CLIENT,
 )
 
 # ---- the process-global plane ----------------------------------------------
@@ -122,7 +132,9 @@ __all__ = [
     "KUBE_SEND",
     "LATENCY",
     "MESH_DISPATCH_STALL",
+    "OVERLOAD_STORM",
     "PROFILER_STALL",
+    "SLOW_CLIENT",
     "REPLICA_CRASH",
     "REPLICA_WEDGE",
     "SCRAPE_FAIL",

@@ -39,6 +39,29 @@ Wire-path observability (ISSUE 11, docs/tracing.md):
   DebugRouter (traces, stacks, profilez, and ``fleet-traces`` when a
   TraceCollector is attached).
 
+Overload robustness (ISSUE 12, docs/failure-modes.md overload section):
+
+- **deadline propagation** — each request's budget is ``min(the door's
+  --admission-budget, the caller's X-GK-Deadline-Ms)``; backend
+  connect/read timeouts clamp to the remaining budget, the REMAINING
+  milliseconds ride downstream in ``X-GK-Deadline-Ms`` (the replica
+  re-enters `deadline.push` with what is left, never a fresh budget),
+  and expired work is dropped at door accept / before every proxy
+  attempt with the explicit fail-open/closed decision.
+- **bounded inflight + fast shed** — with ``max_inflight`` set, a
+  request arriving while every live backend sits at its bound answers
+  a single-digit-ms **429 + Retry-After** carrying the explicit
+  verdict, instead of queueing into a socket (congestive collapse is
+  queues, and the door refuses to build one).
+- **retry budget** — the bounded single retry is additionally gated on
+  a process-wide token bucket (:class:`RetryBudget`), so retries cannot
+  amplify a brownout into a storm; a denied retry proceeds straight to
+  the explicit 502.
+- **slow-client hardening** — an inbound socket timeout bounds header
+  and body reads (slowloris parks an accept thread for at most
+  ``HEADER_TIMEOUT_S``) and bodies above ``MAX_BODY`` answer 413
+  before the read.
+
 Resilience (docs/failure-modes.md fleet failure matrix):
 
 - **bounded single retry** — a request whose backend fails at the
@@ -74,16 +97,22 @@ import http.client
 import itertools
 import json
 import logging
+import re
 import threading
 import time
 from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional, Sequence, Tuple
 
+from .. import deadline as _deadline
+from .. import faults
 from .. import logging as gklog
 from ..metrics.catalog import (
     record_frontdoor_request,
     record_frontdoor_stage,
+    record_retry_budget,
+    record_retry_denied,
+    record_shed,
 )
 from ..obs import trace as obstrace
 from ..util import close_listener, join_thread
@@ -95,8 +124,14 @@ LEAST_INFLIGHT = "least_inflight"
 
 # headers copied through to the backend (trace context must survive the
 # hop so replica traces correlate with the front-door request; the door
-# then REPLACES traceparent with its own span id on the proxied hop)
+# then REPLACES traceparent with its own span id on the proxied hop, and
+# ADDS X-GK-Deadline-Ms with the request's REMAINING budget)
 _FORWARD_HEADERS = ("Content-Type", "traceparent")
+
+# cheap uid extraction for the shed/expired fast paths: a full JSON parse
+# per shed would tax exactly the path whose contract is single-digit-ms
+# refusals, and the uid is the only field those responses need
+_UID_RE = re.compile(rb'"uid"\s*:\s*"([^"\\]*)"')
 
 # ---- the stable wire-path stage set (docs/tracing.md) -----------------------
 # Disjoint by construction: their durations sum to the wire latency the
@@ -119,6 +154,75 @@ OUTCOME_OK = "ok"
 OUTCOME_BACKEND_ERROR = "backend_error"
 OUTCOME_NO_BACKEND = "no_backend"
 OUTCOME_BAD_REQUEST = "bad_request"
+OUTCOME_SHED = "shed"          # refused by the overload plane (429)
+OUTCOME_EXPIRED = "expired"    # deadline exhausted before/at the door
+
+
+def _admission_review_body(uid: str, allowed: bool, message: str,
+                           code: int, reason: str) -> bytes:
+    """A well-formed AdmissionReview for the door's OWN refusals (shed /
+    expired): the explicit fail-open/closed decision the webhook itself
+    would have produced, built through the SAME AdmissionResponse
+    machinery (webhook/policy.py) so door-produced and replica-produced
+    verdicts cannot drift in shape.  This is NOT a fabricated
+    enforcement verdict — it is the policy-selected degraded decision
+    the overload contract mandates (docs/failure-modes.md)."""
+    from ..webhook.policy import FAIL_OPEN_ANNOTATION, AdmissionResponse
+
+    resp = AdmissionResponse(
+        allowed, message, code,
+        annotations={FAIL_OPEN_ANNOTATION: reason} if allowed else None,
+    )
+    return json.dumps({
+        "apiVersion": "admission.k8s.io/v1beta1",
+        "kind": "AdmissionReview",
+        "response": resp.to_dict(uid=uid),
+    }).encode()
+
+
+class RetryBudget:
+    """Token-bucket retry budget (ISSUE 12): the door's bounded retry is
+    additionally gated on a PROCESS-WIDE bucket, so per-request retries
+    cannot multiply offered load during a brownout — the classic retry
+    storm.  Refills at `rate_per_s` up to `cap`; each retry takes one
+    token; an empty bucket denies the retry (the request proceeds to the
+    explicit 502, it does not wait for tokens)."""
+
+    def __init__(self, cap: float = 10.0, rate_per_s: float = 1.0):
+        self.cap = float(cap)
+        self.rate_per_s = float(rate_per_s)
+        self._tokens = float(cap)
+        self._t = time.monotonic()
+        self._lock = threading.Lock()
+        self.denied = 0
+
+    def _refill_locked(self, now: float):
+        self._tokens = min(
+            self.cap, self._tokens + (now - self._t) * self.rate_per_s
+        )
+        self._t = now
+
+    def take(self) -> bool:
+        now = time.monotonic()
+        with self._lock:
+            self._refill_locked(now)
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                granted = True
+            else:
+                self.denied += 1
+                granted = False
+            tokens = self._tokens
+        record_retry_budget(tokens)
+        if not granted:
+            record_retry_denied()
+        return granted
+
+    def tokens(self) -> float:
+        now = time.monotonic()
+        with self._lock:
+            self._refill_locked(now)
+            return self._tokens
 
 
 class _StageClock:
@@ -199,10 +303,30 @@ class FrontDoor:
     RETRY_LIMIT = 1
     # /fleetz latency summaries decay over this trailing window
     LATENCY_WINDOW_S = 60.0
+    # ---- overload plane (ISSUE 12, docs/failure-modes.md) ------------------
+    # backend connect/read ceiling; the per-request deadline clamps BELOW
+    # this (a 50ms-budget request never parks a socket 30s)
+    BACKEND_TIMEOUT_S = 30.0
+    # inbound socket timeout covering header AND body reads: a slowloris
+    # client parks one accept thread for at most this long
+    HEADER_TIMEOUT_S = 15.0
+    # inbound body bound; admission payloads are small — larger is abuse
+    MAX_BODY = 32 * 1024 * 1024
+    # Retry-After advertised on shed responses (seconds)
+    RETRY_AFTER_S = 1
+    # retry-budget bucket defaults (RetryBudget)
+    RETRY_BUDGET_CAP = 10.0
+    RETRY_BUDGET_RATE_PER_S = 1.0
 
     def __init__(self, backends: Sequence[Tuple[str, int]] | Sequence[dict],
                  port: int = 0, policy: str = LEAST_INFLIGHT,
-                 probe_interval_s: Optional[float] = None):
+                 probe_interval_s: Optional[float] = None,
+                 admission_budget_s: Optional[float] = None,
+                 max_inflight: int = 0,
+                 fail_open: bool = False,
+                 retry_budget_cap: Optional[float] = None,
+                 retry_budget_rate_per_s: Optional[float] = None,
+                 header_timeout_s: Optional[float] = None):
         if policy not in (ROUND_ROBIN, LEAST_INFLIGHT):
             raise ValueError(f"unknown front-door policy: {policy!r}")
         self.policy = policy
@@ -211,6 +335,28 @@ class FrontDoor:
             probe_interval_s if probe_interval_s is not None
             else self.PROBE_INTERVAL_S
         )
+        # per-request deadline the door itself grants (min()-merged with
+        # the caller's X-GK-Deadline-Ms); None = only the caller's bound
+        self.admission_budget_s = admission_budget_s
+        # per-backend inflight bound; 0 = unbounded (pre-overload-plane
+        # behavior).  Past the bound on every live backend, the door
+        # sheds with a fast 429 instead of queueing into a socket
+        self.max_inflight = int(max_inflight)
+        # the policy selecting the verdict on the door's OWN refusals
+        # (shed / expired) — mirrors the webhook's --admission-fail-open
+        self.fail_open = bool(fail_open)
+        self.retry_budget = RetryBudget(
+            cap=(retry_budget_cap if retry_budget_cap is not None
+                 else self.RETRY_BUDGET_CAP),
+            rate_per_s=(retry_budget_rate_per_s
+                        if retry_budget_rate_per_s is not None
+                        else self.RETRY_BUDGET_RATE_PER_S),
+        )
+        self.header_timeout_s = (
+            header_timeout_s if header_timeout_s is not None
+            else self.HEADER_TIMEOUT_S
+        )
+        self.sheds = 0    # door-level overload refusals (shed + expired)
         self.backends: List[Backend] = []
         for b in backends:
             if isinstance(b, dict):
@@ -249,29 +395,76 @@ class FrontDoor:
 
     # ---- choice ----------------------------------------------------------
 
+    def _has_capacity(self) -> bool:
+        """False when EVERY live backend sits at the inflight bound —
+        the door-accept fast-path shed predicate.  Advisory (lock-free
+        reads): the HARD bound is _choose's per-backend reservation,
+        which takes the slot under the backend's lock — this check just
+        refuses the obvious case before any routing work.  With no
+        bound configured, or with every backend ejected (the
+        fail-static path owns that case), capacity is never the reason
+        to refuse."""
+        if not self.max_inflight:
+            return True
+        with self._mu:
+            candidates = list(self.backends)
+        live = [b for b in candidates if not b.ejected]
+        if not live:
+            return True
+        return any(b.inflight < self.max_inflight for b in live)
+
     def _choose(self, exclude: Optional[set] = None) -> Optional[Backend]:
+        """Pick AND RESERVE a backend: the inflight slot is taken under
+        the chosen backend's lock before this returns, so max_inflight
+        holds under concurrent accepts — no check-then-act window.  The
+        caller owns the reservation and must decrement inflight exactly
+        once.  Raises OverloadShed when live backends exist but every
+        one is at its bound (the caller answers the fast 429 — a
+        saturated-but-healthy fleet must never be queued into);
+        returns None only when nothing is choosable at all."""
         with self._mu:
             candidates = list(self.backends)
         live = [
             (i, b) for i, b in enumerate(candidates)
             if (not exclude or i not in exclude) and not b.ejected
         ]
-        if not live:
-            # every non-excluded backend is ejected: try one anyway
-            # (fail-static) rather than 502ing while a backend may have
-            # just come back — its success readmits it on the spot
-            live = [
-                (i, b) for i, b in enumerate(candidates)
-                if not exclude or i not in exclude
-            ]
-        if not live:
+        if live:
+            start = next(self._rr) % len(live)
+            rotated = live[start:] + live[:start]
+            if self.policy == ROUND_ROBIN:
+                ordered = rotated
+            else:
+                # least inflight, rotation as tiebreak (stable sort
+                # over the rotated order) so equal backends share
+                ordered = sorted(rotated, key=lambda ib: ib[1].inflight)
+            for _i, b in ordered:
+                with b.lock:
+                    if (
+                        self.max_inflight
+                        and b.inflight >= self.max_inflight
+                    ):
+                        continue
+                    b.inflight += 1
+                return b
+            raise _deadline.OverloadShed(
+                "every live backend is at its inflight bound"
+            )
+        # every non-excluded backend is ejected: try one anyway
+        # (fail-static) rather than 502ing while a backend may have
+        # just come back — its success readmits it on the spot.  The
+        # inflight bound deliberately does not apply here: with zero
+        # live capacity the choice is between refusing everything and
+        # probing the ejected set with real traffic
+        fallback = [
+            (i, b) for i, b in enumerate(candidates)
+            if not exclude or i not in exclude
+        ]
+        if not fallback:
             return None
-        start = next(self._rr) % len(live)
-        if self.policy == ROUND_ROBIN:
-            return live[start][1]
-        # least inflight, rotation as tiebreak so equal backends share
-        rotated = live[start:] + live[:start]
-        return min(rotated, key=lambda ib: ib[1].inflight)[1]
+        b = fallback[next(self._rr) % len(fallback)][1]
+        with b.lock:
+            b.inflight += 1
+        return b
 
     # ---- ejection / readmission ------------------------------------------
 
@@ -363,7 +556,18 @@ class FrontDoor:
 
     # ---- forwarding ------------------------------------------------------
 
-    def _conn(self, backend: Backend) -> http.client.HTTPConnection:
+    def _conn(self, backend: Backend,
+              timeout_s: Optional[float] = None
+              ) -> http.client.HTTPConnection:
+        """Per-thread persistent connection, its connect/read timeout
+        clamped to the REQUEST's remaining deadline (never the flat
+        ceiling): an expired request must surface as an explicit
+        decision at the caller, not a socket parked for 30s holding a
+        backend slot."""
+        timeout_s = (
+            self.BACKEND_TIMEOUT_S if timeout_s is None
+            else max(min(timeout_s, self.BACKEND_TIMEOUT_S), 1e-3)
+        )
         conns = getattr(self._local, "conns", None)
         if conns is None:
             conns = self._local.conns = {}
@@ -371,9 +575,13 @@ class FrontDoor:
         conn = conns.get(key)
         if conn is None:
             conn = http.client.HTTPConnection(
-                backend.host, backend.port, timeout=30
+                backend.host, backend.port, timeout=timeout_s
             )
             conns[key] = conn
+        else:
+            conn.timeout = timeout_s  # applies on (re)connect
+            if conn.sock is not None:
+                conn.sock.settimeout(timeout_s)  # applies to live reads
         return conn
 
     def _drop_conn(self, backend: Backend):
@@ -395,6 +603,19 @@ class FrontDoor:
         raises ConnectionError when they all fail (the caller answers
         502 — never a silent allow).
 
+        Deadline discipline (ISSUE 12): each attempt starts by checking
+        the request's remaining budget (the contextvar the door's POST
+        handler pushed) — an expired request raises DeadlineExceeded
+        (the caller answers the explicit expired decision, it never
+        dangles a socket); the backend connect/read timeout is clamped
+        to the remaining budget; and the REMAINING milliseconds ride
+        downstream in the X-GK-Deadline-Ms header, so the replica
+        re-enters its own deadline with what is actually left.
+
+        Retries are gated on the process-wide token-bucket retry budget
+        (self.retry_budget): under a brownout, spent tokens turn would-be
+        retries into the explicit 502 instead of doubling offered load.
+
         Stage marks per attempt on the contiguous clock:
         ``route_choose`` (backend selection), ``proxy_connect``
         (connection + request send, where the door's own ``traceparent``
@@ -407,23 +628,58 @@ class FrontDoor:
         tried: set = set()
         last_exc: Optional[Exception] = None
         self._local.last_backend = ""
-        for attempt in range(1 + self.RETRY_LIMIT):
-            backend = self._choose(exclude=tried)
+        attempt = 0
+        while attempt <= self.RETRY_LIMIT:
+            remaining_s = _deadline.remaining()
+            if remaining_s is not None and remaining_s <= 0:
+                # expired between stages (or during a failed attempt):
+                # drop the work HERE — a proxied dispatch the caller can
+                # no longer use is pure wasted backend time
+                raise _deadline.DeadlineExceeded(
+                    "request deadline exhausted at the front door"
+                )
+            backend = self._choose(exclude=tried)  # reserves the slot
             if backend is None:
                 break
             with self._mu:
                 try:
                     idx = self.backends.index(backend)
                 except ValueError:
-                    continue  # raced a backend-list mutation; re-choose
+                    # raced a backend-list mutation; release the
+                    # reservation _choose took and re-choose — NOT an
+                    # attempt (no backend was tried) and no retry token
+                    with backend.lock:
+                        backend.inflight -= 1
+                    continue
+            if attempt > 0 and not self.retry_budget.take():
+                # the bounded retry exists, but a brownout must not be
+                # amplified by it: no token, no retry — the explicit
+                # 502 path answers (the apiserver's failurePolicy
+                # decides, exactly as when the retry itself fails).
+                # Taken only AFTER a backend is secured, so a dead-end
+                # choose never burns a token; the reservation is
+                # released since this backend will not be tried
+                with backend.lock:
+                    backend.inflight -= 1
+                gklog.log_event(
+                    log, "front-door retry denied: retry budget empty",
+                    level=logging.WARNING,
+                    event_type="frontdoor_retry_denied",
+                )
+                break
             tried.add(idx)
             self._local.last_backend = backend.replica_id
-            with backend.lock:
-                backend.inflight += 1
             t_attempt = clock.mark(STAGE_ROUTE_CHOOSE, attempt=attempt)
             pending = STAGE_PROXY_CONNECT
             try:
-                conn = self._conn(backend)
+                if faults.ENABLED:
+                    # the overload-storm seam: a latency rule here models
+                    # a slow replica hop with the inflight slot HELD
+                    # (which is what drives the accept-time shed in chaos
+                    # tests); an error rule is a failing backend and
+                    # follows the ordinary error/eject path below
+                    faults.fire(faults.OVERLOAD_STORM)
+                conn = self._conn(backend, remaining_s)
                 hdrs = dict(headers)
                 # the door's OWN trace context on the proxied hop: the
                 # replica's admission root adopts this trace_id and
@@ -433,6 +689,14 @@ class FrontDoor:
                 if cur is not None:
                     hdrs["traceparent"] = obstrace.format_traceparent(
                         cur.trace.trace_id, cur.span_id
+                    )
+                # remaining wire budget downstream, recomputed at send
+                # time: the replica must see what is LEFT, not what the
+                # caller started with
+                rem_ms = _deadline.remaining_ms()
+                if rem_ms is not None:
+                    hdrs[_deadline.DEADLINE_HEADER] = (
+                        f"{max(rem_ms, 0.0):.1f}"
                     )
                 conn.request(method, path, body=body, headers=hdrs)
                 clock.mark(STAGE_PROXY_CONNECT,
@@ -465,11 +729,35 @@ class FrontDoor:
                     clock.mark(pending, backend=backend.replica_id,
                                error=type(e).__name__)
                 self._drop_conn(backend)
+                rem_after = _deadline.remaining()
+                deadline_induced = (
+                    isinstance(e, TimeoutError)
+                    and rem_after is not None and rem_after <= 0
+                )
                 with backend.lock:
                     backend.inflight -= 1
+                    # a deadline-induced timeout still CHARGES the
+                    # streak: one tight-budget expiry is forgiven by the
+                    # next success, but a backend that times out every
+                    # request in a row is indistinguishable from wedged
+                    # and must eject like any other failure — the
+                    # /readyz prober readmits a healthy one within a
+                    # probe interval, while never ejecting would leave
+                    # a wedged replica burning budgets forever
                     backend.errors += 1
                     backend.consecutive_errors += 1
                     streak = backend.consecutive_errors
+                if deadline_induced:
+                    if streak >= self.EJECT_ERROR_STREAK:
+                        self._eject(backend, f"{streak} consecutive "
+                                    "errors (deadline-clamped timeouts)")
+                    # the REQUEST is out of time either way: surface the
+                    # explicit expired decision, never a retry it cannot
+                    # use
+                    raise _deadline.DeadlineExceeded(
+                        "request deadline exhausted waiting on "
+                        f"{backend.replica_id}"
+                    )
                 if isinstance(e, ConnectionRefusedError):
                     # nothing listening: the replica is DEAD, not slow —
                     # eject now, don't tax the next streak's requests
@@ -487,6 +775,7 @@ class FrontDoor:
                     event_type="frontdoor_backend_error",
                     backend=backend.replica_id, attempt=attempt,
                 )
+                attempt += 1  # only real tried-a-backend failures count
         raise ConnectionError(
             f"no fleet backend answered: {last_exc!r}"
         )
@@ -497,6 +786,18 @@ class FrontDoor:
         return {
             "policy": self.policy,
             "retries": self.retries,
+            "sheds": self.sheds,
+            "max_inflight": self.max_inflight,
+            "admission_budget_ms": (
+                round(self.admission_budget_s * 1e3, 3)
+                if self.admission_budget_s is not None else None
+            ),
+            "retry_budget": {
+                "tokens": round(self.retry_budget.tokens(), 3),
+                "cap": self.retry_budget.cap,
+                "rate_per_s": self.retry_budget.rate_per_s,
+                "denied": self.retry_budget.denied,
+            },
             "backends": [
                 {
                     "replica_id": b.replica_id,
@@ -526,6 +827,11 @@ class FrontDoor:
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
             disable_nagle_algorithm = True
+            # slow-client hardening (ISSUE 12): socketserver applies
+            # this to the connection, so header reads AND body reads are
+            # bounded — a slowloris peer parks an accept thread for at
+            # most this long, then the connection closes
+            timeout = outer.header_timeout_s
 
             def log_message(self, *args):
                 pass
@@ -538,7 +844,8 @@ class FrontDoor:
                 return super().parse_request()
 
             def _send(self, code: int, ctype: str, body: bytes,
-                      replica: str = "", trace_id: str = ""):
+                      replica: str = "", trace_id: str = "",
+                      retry_after: bool = False):
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
@@ -549,6 +856,11 @@ class FrontDoor:
                     self.send_header("X-GK-Replica", replica)
                 if trace_id:
                     self.send_header("X-GK-Trace-Id", trace_id)
+                if retry_after:
+                    # shed contract: the caller is told WHEN to come
+                    # back, so well-behaved clients pace themselves
+                    self.send_header("Retry-After",
+                                     str(outer.RETRY_AFTER_S))
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -589,6 +901,50 @@ class FrontDoor:
                         else render_prometheus())
                 self._send(200, CONTENT_TYPE_TEXT, body.encode())
 
+            def _refuse(self, wsp, clock, tid: str, body: bytes,
+                        expired: bool):
+                """The door's own fast refusal (ISSUE 12): an expired
+                deadline answers the explicit fail-open/closed decision
+                the webhook would have produced (HTTP 200, code 504 in
+                the verdict); an overload shed answers 429 +
+                Retry-After with the same explicit verdict in the body.
+                Both are single-digit-ms paths by construction: no
+                routing, no proxying, one regex for the uid."""
+                from ..webhook.policy import (
+                    DEADLINE_CODE,
+                    DEADLINE_MESSAGE,
+                    FAIL_OPEN_DEADLINE,
+                    FAIL_OPEN_SHED,
+                    SHED_CODE,
+                    SHED_MESSAGE,
+                )
+
+                m = _UID_RE.search(body or b"")
+                uid = m.group(1).decode("utf-8", "replace") if m else ""
+                if expired:
+                    outcome, reason = OUTCOME_EXPIRED, "deadline_expired"
+                    msg, code, annot = (
+                        DEADLINE_MESSAGE, DEADLINE_CODE, FAIL_OPEN_DEADLINE
+                    )
+                    http_code, retry_after = 200, False
+                else:
+                    outcome, reason = OUTCOME_SHED, "door_inflight"
+                    msg, code, annot = (
+                        SHED_MESSAGE, SHED_CODE, FAIL_OPEN_SHED
+                    )
+                    http_code, retry_after = 429, True
+                with outer._mu:  # += on many handler threads loses updates
+                    outer.sheds += 1
+                wsp.set_attrs(outcome=outcome, shed_reason=reason)
+                record_frontdoor_request(outcome, "")
+                record_shed(reason)
+                payload = _admission_review_body(
+                    uid, outer.fail_open, msg, code, annot
+                )
+                self._send(http_code, "application/json", payload,
+                           trace_id=tid, retry_after=retry_after)
+                clock.mark(STAGE_WRITE_BACK)
+
             def do_POST(self):
                 t_accept = getattr(self, "_t_accept", None)
                 if t_accept is None:
@@ -616,46 +972,118 @@ class FrontDoor:
                                    b"bad Content-Length", trace_id=tid)
                         clock.mark(STAGE_WRITE_BACK)
                         return
-                    body = (self.rfile.read(length)
-                            if length > 0 else b"")
+                    if length > outer.MAX_BODY:
+                        # bounded inbound body: an admission review this
+                        # large is abuse or corruption; refusing before
+                        # the read keeps the accept thread free
+                        self.close_connection = True
+                        wsp.set_attrs(outcome=OUTCOME_BAD_REQUEST)
+                        record_frontdoor_request(OUTCOME_BAD_REQUEST, "")
+                        self._send(413, "text/plain", b"body too large",
+                                   trace_id=tid)
+                        clock.mark(STAGE_WRITE_BACK)
+                        return
+                    if faults.ENABLED:
+                        # the slow-client seam: a latency rule holds an
+                        # accept thread through read_body, the slowloris
+                        # shape the socket timeout bounds in production
+                        faults.fire(faults.SLOW_CLIENT)
+                    try:
+                        body = (self.rfile.read(length)
+                                if length > 0 else b"")
+                    except TimeoutError:
+                        # slowloris body: the inbound socket timeout
+                        # fired mid-read — close, don't park forever
+                        self.close_connection = True
+                        wsp.set_attrs(outcome=OUTCOME_BAD_REQUEST)
+                        record_frontdoor_request(OUTCOME_BAD_REQUEST, "")
+                        self._send(408, "text/plain",
+                                   b"request body timeout", trace_id=tid)
+                        clock.mark(STAGE_WRITE_BACK)
+                        return
                     fwd = {
                         k: v for k in _FORWARD_HEADERS
                         if (v := self.headers.get(k)) is not None
                     }
                     fwd["Content-Length"] = str(len(body))
                     clock.mark(STAGE_READ_BODY)
+                    # the request's end-to-end deadline: min(the door's
+                    # own admission budget, the caller's remaining wire
+                    # budget).  Pushed on the contextvar so forward()
+                    # clamps socket timeouts to it and re-exports the
+                    # REMAINING milliseconds downstream
+                    budget = _deadline.effective_budget_s(
+                        outer.admission_budget_s,
+                        _deadline.parse_header_ms(
+                            self.headers.get(_deadline.DEADLINE_HEADER)
+                        ),
+                    )
+                    token = (
+                        _deadline.push(budget) if budget is not None
+                        else None
+                    )
                     try:
-                        code, _hdrs, data, rid = outer.forward(
-                            "POST", self.path, body, fwd, clock=clock
-                        )
-                    except ConnectionError as e:
-                        # all backends down: explicit 502, the
-                        # apiserver's failurePolicy decides — never a
-                        # fabricated verdict.  The last TRIED backend is
-                        # still named: a 502 without a suspect is
-                        # unactionable
-                        rid = getattr(outer._local, "last_backend", "")
-                        wsp.set_attrs(outcome=OUTCOME_NO_BACKEND,
-                                      backend=rid)
-                        record_frontdoor_request(OUTCOME_NO_BACKEND, rid)
-                        gklog.log_event(
-                            log, "front door exhausted its backends",
-                            level=logging.WARNING,
-                            event_type="frontdoor_no_backend",
-                            last_backend=rid,
-                        )
-                        self._send(502, "text/plain", str(e).encode(),
+                        if budget is not None and budget <= 0:
+                            # dead on arrival: drop at door accept
+                            self._refuse(wsp, clock, tid, body,
+                                         expired=True)
+                            return
+                        if not outer._has_capacity():
+                            # every live backend at its inflight bound:
+                            # fast 429 + Retry-After instead of queueing
+                            # the request into a socket
+                            self._refuse(wsp, clock, tid, body,
+                                         expired=False)
+                            return
+                        try:
+                            code, _hdrs, data, rid = outer.forward(
+                                "POST", self.path, body, fwd, clock=clock
+                            )
+                        except _deadline.DeadlineExceeded:
+                            self._refuse(wsp, clock, tid, body,
+                                         expired=True)
+                            return
+                        except _deadline.OverloadShed:
+                            # _choose found live backends but every one
+                            # at its bound (slots filled between the
+                            # accept-time check and routing): the same
+                            # fast 429, just decided one stage later
+                            self._refuse(wsp, clock, tid, body,
+                                         expired=False)
+                            return
+                        except ConnectionError as e:
+                            # all backends down: explicit 502, the
+                            # apiserver's failurePolicy decides — never a
+                            # fabricated verdict.  The last TRIED backend
+                            # is still named: a 502 without a suspect is
+                            # unactionable
+                            rid = getattr(outer._local, "last_backend", "")
+                            wsp.set_attrs(outcome=OUTCOME_NO_BACKEND,
+                                          backend=rid)
+                            record_frontdoor_request(OUTCOME_NO_BACKEND,
+                                                     rid)
+                            gklog.log_event(
+                                log, "front door exhausted its backends",
+                                level=logging.WARNING,
+                                event_type="frontdoor_no_backend",
+                                last_backend=rid,
+                            )
+                            self._send(502, "text/plain",
+                                       str(e).encode(),
+                                       replica=rid, trace_id=tid)
+                            clock.mark(STAGE_WRITE_BACK)
+                            return
+                        outcome = (OUTCOME_OK if 200 <= code < 300
+                                   else OUTCOME_BACKEND_ERROR)
+                        wsp.set_attrs(outcome=outcome, backend=rid,
+                                      status=code)
+                        record_frontdoor_request(outcome, rid)
+                        self._send(code, "application/json", data,
                                    replica=rid, trace_id=tid)
                         clock.mark(STAGE_WRITE_BACK)
-                        return
-                    outcome = (OUTCOME_OK if 200 <= code < 300
-                               else OUTCOME_BACKEND_ERROR)
-                    wsp.set_attrs(outcome=outcome, backend=rid,
-                                  status=code)
-                    record_frontdoor_request(outcome, rid)
-                    self._send(code, "application/json", data,
-                               replica=rid, trace_id=tid)
-                    clock.mark(STAGE_WRITE_BACK)
+                    finally:
+                        if token is not None:
+                            _deadline.pop(token)
 
         self._server = ThreadingHTTPServer(("0.0.0.0", self.port), Handler)
         self.port = self._server.server_address[1]

@@ -78,6 +78,13 @@ def _child_parser() -> argparse.ArgumentParser:
                    help="shared XLA + AOT executable cache dir")
     p.add_argument("--driver", choices=["interp", "tpu"], default="tpu")
     p.add_argument("--webhook-batch-static", action="store_true")
+    p.add_argument("--webhook-max-pending", type=int, default=None,
+                   help="micro-batcher pending bound passed through to "
+                        "the App (overload harnesses set it small to "
+                        "force sheds; default: the App's default)")
+    p.add_argument("--admission-fail-open", action="store_true",
+                   help="fail open on deadline/overload refusals "
+                        "(passed through to the App)")
     p.add_argument("--no-seed-namespaces", action="store_true",
                    help="do not create Namespace objects for restored "
                         "pack rows in the local in-memory store")
@@ -309,6 +316,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         flags += ["--xla-cache-dir", args.xla_cache_dir]
     if args.webhook_batch_static:
         flags += ["--webhook-batch-static"]
+    if args.webhook_max_pending is not None:
+        flags += ["--webhook-max-pending", str(args.webhook_max_pending)]
+    if args.admission_fail_open:
+        flags += ["--admission-fail-open"]
     app = App(build_parser().parse_args(flags), kube=InMemoryKube())
     app.start()
     try:
@@ -374,9 +385,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         replica_id=args.replica_id,
                     ))
                 elif op == "ping":
+                    from ..obs import brownout as _brownout
+
                     _reply(cmd, {"event": "pong",
                                  "replica_id": args.replica_id,
-                                 "draining": app.webhook_server._draining})
+                                 "draining": app.webhook_server._draining,
+                                 # overload-plane visibility for the
+                                 # bench/chaos harnesses: batcher sheds
+                                 # and the brownout ladder level without
+                                 # an extra HTTP scrape
+                                 "sheds": getattr(
+                                     app.micro_batcher, "sheds", 0),
+                                 "brownout_level": _brownout
+                                 .get_controller().level})
                 elif op == "drain":
                     _reply(cmd, _handle_drain(app, cmd, args.replica_id))
                 elif op == "traces":
@@ -495,6 +516,9 @@ class _Pipes:
     stderr tail."""
 
     def __init__(self):
+        # gklint: disable=unbounded-queue -- bounded by protocol: the child
+        # emits one ready line plus one reply per command; correlated
+        # replies route to per-command waiter queues, not here
         self.msgs: queue.Queue = queue.Queue()
         self.stderr_tail: deque = deque(maxlen=400)
         self.waiters: Dict[str, queue.Queue] = {}
@@ -627,6 +651,8 @@ class ReplicaHandle:
         enforces the timeout even when the child emits nothing."""
         cid = f"{self.replica_id}-{next(self._cmd_counter)}"
         cmd = {**cmd, "id": cid}
+        # gklint: disable=unbounded-queue -- holds at most one reply (the
+        # child echoes exactly one line per command id) plus the EOF sentinel
         replies: queue.Queue = queue.Queue()
         with self._pipes.waiters_lock:
             self._pipes.waiters[cid] = replies

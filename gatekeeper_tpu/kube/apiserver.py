@@ -195,6 +195,9 @@ class KubeApiServer:
                 raise _GoneError()
             backlog = [ev for seq, ev in self._history.get(gvk, ())
                        if seq > since_rv]
+            # gklint: disable=unbounded-queue -- watch fan-out is bounded by
+            # cluster churn, and a slow consumer must see every event (dropping
+            # one silently desyncs its cache); backpressure is the RV resync
             q: queue.Queue = queue.Queue()
             self._subscribers.setdefault(gvk, []).append(q)
             return backlog, q

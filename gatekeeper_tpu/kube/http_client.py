@@ -457,6 +457,8 @@ class HttpWatcher:
     def __init__(self, kube: HttpKube, gvk: GVK, replay: bool):
         self.kube = kube
         self.gvk = gvk
+        # gklint: disable=unbounded-queue -- watch stream events are bounded
+        # by cluster churn and must not be dropped (a gap forces a full relist)
         self.queue: "queue.Queue" = queue.Queue()
         self._stopped = False
         self._conn = None

@@ -216,6 +216,8 @@ class InMemoryKube:
     # ---- watch ------------------------------------------------------------
 
     def watch(self, gvk: GVK, replay: bool = True) -> "Watcher":
+        # gklint: disable=unbounded-queue -- watch fan-out bounded by store
+        # churn; events must not drop (consumers reconcile by replay, not RV gap)
         q: queue.Queue = queue.Queue()
         with self._lock:
             if replay:

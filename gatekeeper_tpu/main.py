@@ -144,6 +144,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--webhook-batch-static", action="store_true",
                    help="disable the load-adaptive batch controller and "
                         "keep the fixed recent-concurrency window")
+    # overload robustness (ISSUE 12, docs/failure-modes.md)
+    p.add_argument("--webhook-max-pending", type=int, default=1024,
+                   help="bound on the micro-batcher's pending queue; "
+                        "past it, dry-run admissions shed first, then "
+                        "new arrivals, each as an explicit fail-open/"
+                        "closed decision (0 = unbounded)")
+    p.add_argument("--brownout-disable", action="store_true",
+                   help="disable the brownout ladder (sustained-overload "
+                        "degradation: audit/snapshot deferral, reduced "
+                        "telemetry, throughput-pinned routing)")
     # graceful degradation (docs/failure-modes.md)
     p.add_argument("--admission-deadline-budget-ms", type=float, default=0.0,
                    help="per-request admission deadline budget in ms; work "
@@ -628,9 +638,13 @@ class App:
 
         breaker_fn = getattr(self.client.driver, "breaker_status", None)
         slo_engine = obsslo.get_engine()
+        from .obs import brownout as obsbrownout
+
+        brownout_ctl = obsbrownout.get_controller()
 
         def health_status():
-            st = {"slo": slo_engine.evaluate()}
+            st = {"slo": slo_engine.evaluate(),
+                  "brownout": brownout_ctl.status()}
             if breaker_fn is not None:
                 st["tpu_breaker"] = breaker_fn()
             return st
@@ -653,6 +667,7 @@ class App:
                 adaptive=not getattr(args, "webhook_batch_static", False),
                 max_deadline_s=getattr(
                     args, "webhook_batch_max_deadline_ms", 25.0) / 1000.0,
+                max_pending=getattr(args, "webhook_max_pending", None),
             )
             handler = ValidationHandler(
                 self.micro_batcher,
@@ -752,6 +767,61 @@ class App:
 
             jax.profiler.start_server(args.jax_profile_port)
             self._jax_profiler_on = True
+        # brownout ladder (obs/brownout.py, docs/failure-modes.md): the
+        # sustained-overload controller samples queue depth (the micro-
+        # batcher), the shed rate (fed by every shed site through
+        # record_shed) and the SLO burn flag; its actions are wired here
+        # because only the App knows the baselines to RESTORE on recovery
+        brownout_ctl.clear_actions()
+        if not getattr(args, "brownout_disable", False):
+            mb = self.micro_batcher
+
+            def _queue_frac() -> float:
+                if mb is None or not mb.max_pending:
+                    return 0.0
+                # a bare len() read: no lock — the signal is a trend,
+                # not an invariant, and the sampler must never contend
+                # with the enqueue path
+                return len(mb._pending) / mb.max_pending
+
+            brownout_ctl.set_providers(
+                queue_frac=_queue_frac,
+                slo_degraded=slo_engine.degraded,
+            )
+            base_sample = getattr(args, "trace_sample_rate", 1.0)
+            base_hz = hz
+            driver_pin = getattr(
+                self.client.driver, "set_brownout_pin", None
+            )
+
+            def _apply(old: int, new: int):
+                from .obs import trace as _obstrace
+
+                # idempotent per threshold crossing; each rung is
+                # reversible — stepping down restores the baseline
+                if (new >= 2) != (old >= 2):
+                    reduce = new >= 2
+                    # min(): an operator-configured rate BELOW the
+                    # brownout rate must never be raised by degradation
+                    _obstrace.configure(
+                        sample_rate=(min(base_sample, 0.05) if reduce
+                                     else base_sample)
+                    )
+                    prof = get_profiler()
+                    prof.configure(
+                        hz=min(base_hz, 1.0) if reduce else base_hz
+                    )
+                if driver_pin is not None and (new >= 3) != (old >= 3):
+                    driver_pin(new >= 3)
+
+            brownout_ctl.on_change(_apply)
+            # stop() restores the process-global tracer/profiler/pin
+            # baselines even mid-brownout: _apply from the level held
+            # at stop time down to 0 unwinds every threshold crossing
+            self._brownout_restore = _apply
+            brownout_ctl.start()
+        else:
+            self._brownout_restore = None
         self._start_routing_calibration()
         from .metrics.catalog import record_replica_up
 
@@ -845,6 +915,26 @@ class App:
         from .obs.profiler import get_profiler
 
         get_profiler().stop()
+        # the brownout sampler likewise (idempotent, bounded join); the
+        # ladder resets so a restarted App starts at level 0, and a
+        # stop mid-brownout RESTORES the degraded process-global state
+        # (tracer sample rate, profiler hz, routing pin) — those
+        # outlive this App, and "level 0" must mean undegraded
+        from .obs import brownout as obsbrownout
+
+        ctl = obsbrownout.get_controller()
+        level_at_stop = ctl.level
+        ctl.stop()
+        ctl.reset()
+        restore = getattr(self, "_brownout_restore", None)
+        if restore is not None and level_at_stop > 0:
+            try:
+                restore(level_at_stop, 0)
+            except Exception:
+                log.exception("brownout baseline restore failed on stop")
+        unpin = getattr(self.client.driver, "set_brownout_pin", None)
+        if unpin is not None:
+            unpin(False)  # defensive: also covers --brownout-disable
         self.manager.stop()
 
     def run_forever(self):

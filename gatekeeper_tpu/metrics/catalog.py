@@ -312,6 +312,35 @@ FLEET_ADMISSIONS_M = Measure(
     "Fleet rollup: sum of request_count samples across every scraped "
     "replica exporter (stale-marked series included)",
 )
+# ---- overload robustness plane (ISSUE 12) -----------------------------------
+# Bounded-backpressure accounting: every request refused by a bound
+# (micro-batcher max_pending, front-door inflight cap, expired deadline,
+# spent retry budget) counts here by reason — the shed rate is also a
+# brownout-ladder input (obs/brownout.py).
+SHED_M = Measure(
+    "shed",
+    "Admission requests refused by the overload plane, by reason "
+    "(queue_full, queue_full_dryrun, door_inflight, deadline_expired) "
+    "— every shed is an explicit fail-open/closed decision, never a "
+    "timeout (denied retries count separately in "
+    "frontdoor_retries_denied_total)",
+)
+BROWNOUT_M = Measure(
+    "brownout_level",
+    "Current brownout-ladder level (0 normal; 1 audit/snapshot "
+    "deferral; 2 + reduced trace sampling and profiler rate; 3 + router "
+    "pinned to the cheapest sustainable tier)",
+)
+RETRY_TOKENS_M = Measure(
+    "frontdoor_retry_tokens",
+    "Tokens currently in the front door's retry budget bucket; retries "
+    "are denied at zero so they cannot amplify a brownout into a storm",
+)
+RETRY_DENIED_M = Measure(
+    "frontdoor_retries_denied",
+    "Front-door retries denied because the retry budget bucket was "
+    "empty (the request fails over to the explicit 502 path instead)",
+)
 PROFILER_SAMPLES_M = Measure(
     "profiler_samples",
     "Thread-stack samples collected by the always-on sampling profiler "
@@ -465,6 +494,10 @@ def catalog_views():
              AGG_LAST_VALUE),
         View("profiler_samples_total", PROFILER_SAMPLES_M, AGG_COUNT),
         View("profiler_overflow_total", PROFILER_OVERFLOW_M, AGG_COUNT),
+        View("shed_total", SHED_M, AGG_COUNT, tag_keys=("reason",)),
+        View("brownout_level", BROWNOUT_M, AGG_LAST_VALUE),
+        View("frontdoor_retry_tokens", RETRY_TOKENS_M, AGG_LAST_VALUE),
+        View("frontdoor_retries_denied_total", RETRY_DENIED_M, AGG_COUNT),
     ]
 
 
@@ -878,6 +911,50 @@ def record_profiler(samples: int, overflow: int = 0):
                        count=overflow)
     except Exception:  # telemetry never blocks the sampler
         record_dropped("record_profiler")
+
+
+def record_shed(reason: str, n: int = 1):
+    """n requests refused by the overload plane for one reason
+    (shed_total{reason}; docs/failure-modes.md shed order).  Also feeds
+    the brownout controller's shed-rate signal.  Guarded like
+    record_stage."""
+    if n <= 0:
+        return
+    try:
+        _global().record(SHED_M, float(n), {"reason": reason}, count=n)
+    except Exception:  # telemetry never blocks the shed path
+        record_dropped("record_shed")
+    try:
+        from ..obs.brownout import note_shed
+
+        note_shed(n)
+    except Exception:  # the ladder signal must never fail the refusal
+        record_dropped("record_shed.brownout")
+
+
+def record_brownout_level(level: int):
+    """The brownout controller's current ladder level (recorded on every
+    transition and on controller start)."""
+    try:
+        _global().record(BROWNOUT_M, float(level))
+    except Exception:  # telemetry never blocks degradation
+        record_dropped("record_brownout_level")
+
+
+def record_retry_budget(tokens: float):
+    """The front door's current retry-budget bucket level."""
+    try:
+        _global().record(RETRY_TOKENS_M, float(tokens))
+    except Exception:  # telemetry never blocks the wire path
+        record_dropped("record_retry_budget")
+
+
+def record_retry_denied():
+    """One front-door retry denied on an empty retry budget."""
+    try:
+        _global().record(RETRY_DENIED_M, 1.0)
+    except Exception:  # telemetry never blocks the wire path
+        record_dropped("record_retry_denied")
 
 
 def record_cache(cache: str, hit: bool, n: int = 1):

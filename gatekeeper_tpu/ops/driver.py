@@ -336,6 +336,10 @@ class TpuDriver(InterpDriver):
         # micro-batcher: with it, routing prices sustainable THROUGHPUT
         # under saturation instead of this batch's latency alone
         self._offered_load: Optional[tuple] = None
+        # brownout pin (obs/brownout.py level 3): routing locked to the
+        # cheapest SUSTAINABLE (max-throughput) tier regardless of
+        # per-batch latency or hint freshness — drain the queue first
+        self._brownout_pin = False
         # incremental host-serving constraint side (ops/npside.py):
         # admission-sized batches evaluate the same VExpr IR in numpy —
         # no dispatch RTT, no compile, O(1) maintenance per mutation.
@@ -1799,6 +1803,14 @@ class TpuDriver(InterpDriver):
         rps, t = h
         return rps if _time.monotonic() - t <= self.LOAD_HINT_TTL_S else None
 
+    def set_brownout_pin(self, active: bool):
+        """Brownout ladder level 3 (obs/brownout.py): pin routing to the
+        cheapest sustainable tier — the max-throughput choice the
+        saturated branch of _route_eval makes, but unconditionally, so
+        the pin holds even between batcher dispatches (a stale load
+        hint must not un-pin a declared brownout)."""
+        self._brownout_pin = bool(active)
+
     def _tier_models(self, per_review_cells: int):
         """[(tier, floor_ms, per_review_ms)] from the calibration — the
         affine service model shared by latency routing, load-aware
@@ -1851,6 +1863,18 @@ class TpuDriver(InterpDriver):
             costs.append(
                 (cal["np_floor_ms"] + cells / cal["np_cells_per_ms"], "np")
             )
+        if self._brownout_pin:
+            # brownout pin: the max-throughput tier at the coalesced
+            # batch size, unconditionally — the queue drains fastest
+            # there, which is the only latency that matters mid-brownout
+            per_review = max(cells // max(n_reviews, 1), 1)
+            B = self.ROUTE_MAX_BATCH
+            mu = {
+                tier: B / max(floor + B * per_ms, 1e-9)
+                for tier, floor, per_ms in self._tier_models(per_review)
+            }
+            if mu:
+                return max(mu.items(), key=lambda kv: kv[1])[0]
         lam = self._load_hint()
         if lam:
             per_review = max(cells // max(n_reviews, 1), 1)

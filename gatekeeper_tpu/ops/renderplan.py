@@ -1287,6 +1287,9 @@ class RenderPool:
             if cls._queue is None:
                 import queue
 
+                # gklint: disable=unbounded-queue -- fed only by map_ordered
+                # with the CURRENT render batch's interp-tail cells; drained
+                # before the call returns, so depth is bounded by batch size
                 cls._queue = queue.SimpleQueue()
             while cls._started < cls.WORKERS:
                 t = threading.Thread(

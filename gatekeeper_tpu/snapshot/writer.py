@@ -424,11 +424,21 @@ class Snapshotter:
         )
 
     def _loop(self):
+        from ..obs import brownout as _brownout
+
         while not self._stop.is_set():
             self._wake.wait(timeout=self.interval_s)
             self._wake.clear()
             if self._stop.is_set():
                 return
+            if _brownout.defer_background():
+                # brownout ladder level >= 1: a snapshot capture takes
+                # the driver lock and serializes the pack — deferred
+                # while admissions are saturated.  The wake flag is
+                # already cleared; the next sweep (or the interval
+                # timer) re-arms once pressure clears
+                log.info("snapshot arming deferred by brownout ladder")
+                continue
             if not self._due():
                 continue
             self.write_once()

@@ -56,6 +56,9 @@ class Registrar:
     def __init__(self, name: str, manager: "WatchManager"):
         self.name = name
         self.manager = manager
+        # gklint: disable=unbounded-queue -- by-design unbounded: the event
+        # pump is bounded by cluster churn and a dropped event silently
+        # desyncs the replicated cache (the RV dedup cannot repair a gap)
         self.events: "queue.Queue[Tuple[GVK, WatchEvent]]" = queue.Queue()
 
     def add_watch(self, gvk: GVK):

@@ -22,7 +22,7 @@ from dataclasses import dataclass, field
 from typing import Callable, List, Optional
 
 from .. import logging as gklog
-from ..deadline import DeadlineExceeded
+from ..deadline import DeadlineExceeded, OverloadShed
 from ..obs import slo as obsslo
 from ..obs import trace as obstrace
 from ..apis.config import CONFIG_NAME, GVK as CONFIG_GVK, parse_config
@@ -52,9 +52,12 @@ RESPONSE_UNKNOWN = "unknown"
 # AdmissionReview JSON is exact and testable (tests/test_webhook.py)
 DEADLINE_MESSAGE = "admission deadline budget exhausted"
 DEADLINE_CODE = 504
+SHED_MESSAGE = "admission request shed under overload"
+SHED_CODE = 429
 FAIL_OPEN_ANNOTATION = "admission.gatekeeper.sh/fail-open"
 FAIL_OPEN_DEADLINE = "deadline-exhausted"
 FAIL_OPEN_INTERNAL = "internal-error"
+FAIL_OPEN_SHED = "overload-shed"
 
 log = gklog.get("webhook")
 
@@ -181,6 +184,16 @@ class ValidationHandler:
                 status = RESPONSE_ERROR
                 return self._failure_response(
                     DEADLINE_MESSAGE, DEADLINE_CODE, FAIL_OPEN_DEADLINE
+                )
+            except OverloadShed:
+                # bounded-queue refusal (docs/failure-modes.md shed
+                # order): the same explicit fail-open/closed decision,
+                # answered FAST — the whole point of shedding is that
+                # the refusal costs microseconds, not a queue wait
+                log.warning("admission request shed under overload")
+                status = RESPONSE_ERROR
+                return self._failure_response(
+                    SHED_MESSAGE, SHED_CODE, FAIL_OPEN_SHED
                 )
             except Exception as e:  # error executing query -> 500
                 log.exception("error executing query")

@@ -24,6 +24,7 @@ from ..metrics.catalog import (
     WEBHOOK_QUEUE_M,
     record_batch_size,
     record_batcher_state,
+    record_shed,
     record_stage,
 )
 from ..obs import trace as obstrace
@@ -45,9 +46,21 @@ class BatcherStopped(RuntimeError):
     MicroBatcher — they must fail fast, not wait on an event forever."""
 
 
+def _low_value(obj) -> bool:
+    """Shed-priority classification (docs/failure-modes.md shed order):
+    dry-run admissions are advisory — under overload they are refused
+    before any enforced admission is.  Accepts both the handler's
+    AugmentedReview and a bare request dict (tests, embedders)."""
+    req = getattr(obj, "admission_request", None)
+    if req is None and isinstance(obj, dict):
+        req = obj
+    return bool(isinstance(req, dict) and req.get("dryRun"))
+
+
 class _Pending:
     __slots__ = (
-        "obj", "event", "result", "error", "deadline", "span", "queue_span",
+        "obj", "event", "result", "error", "deadline", "low_value",
+        "span", "queue_span",
     )
 
     def __init__(self, obj, deadline: Optional[float] = None):
@@ -56,6 +69,7 @@ class _Pending:
         self.result = None
         self.error: Optional[Exception] = None
         self.deadline = deadline  # absolute monotonic, or None
+        self.low_value = _low_value(obj)
         # explicit cross-thread context passing: the request's active span
         # (linked by the batch span) and its open queue-wait span (ended
         # by the batch thread when the batch is drained)
@@ -109,15 +123,30 @@ class MicroBatcher:
     # dispatch headroom reserved when the adaptive window is clamped to
     # a queued member's admission-deadline budget
     DEADLINE_CLAMP_MARGIN_S = 0.002
+    # bounded backpressure (ISSUE 12, docs/failure-modes.md): the pending
+    # queue never grows past this — past the bound, the lowest-value work
+    # (dry-run admissions) sheds first, then new arrivals shed outright.
+    # 0 = unbounded (the pre-overload-plane behavior, tests only).
+    MAX_PENDING = 1024
 
     def __init__(self, client, window_s: float = 0.002, max_batch: int = 256,
-                 adaptive: bool = True, max_deadline_s: float = 0.025):
+                 adaptive: bool = True, max_deadline_s: float = 0.025,
+                 max_pending: Optional[int] = None):
         self._client = client
         self.window_s = window_s
         self.max_batch = max_batch
         self.adaptive = adaptive
         self.max_deadline_s = max_deadline_s
+        self.max_pending = (
+            self.MAX_PENDING if max_pending is None else int(max_pending)
+        )
+        self.sheds = 0  # queue-bound refusals (brownout signal + /statusz)
         self._pending: List[_Pending] = []
+        # queued dry-run count (maintained under the cv): the at-bound
+        # eviction scan short-circuits to O(1) when no dry-run is
+        # queued — the common case under an all-enforced storm, which
+        # is exactly when the enqueue path is hottest
+        self._pending_dryruns = 0
         self._cv = threading.Condition()
         self._inline = threading.Lock()  # at most one idle fast-path eval
         self._busy = False  # a batch is evaluating (pending already drained)
@@ -266,13 +295,67 @@ class MicroBatcher:
             finally:
                 self._inline.release()
         p = _Pending(obj, deadline=dl)
+        # bounded backpressure (docs/failure-modes.md shed order): the
+        # decision is made under the cv, but refusals are DELIVERED (and
+        # counted) outside it — Event.set on an evicted waiter and the
+        # registry record must not run under the producer lock
+        evicted: Optional[_Pending] = None
+        shed_self = False
         with self._cv:
             if self._stop:
                 # enqueues after stop() must fail fast, never wait on an
                 # event no batch loop will ever set
                 raise BatcherStopped("webhook batcher is stopped")
-            self._pending.append(p)
-            self._cv.notify()
+            if self.max_pending and len(self._pending) >= self.max_pending:
+                if p.low_value:
+                    # a dry-run arrival at the bound sheds itself: it is
+                    # the lowest-value work in sight
+                    shed_self = True
+                elif self._pending_dryruns > 0:
+                    # an enforced admission preempts the oldest QUEUED
+                    # dry-run (the counter makes the no-dry-run case
+                    # O(1) — no scan under the cv at peak load)
+                    for i, q in enumerate(self._pending):
+                        if q.low_value:
+                            evicted = self._pending.pop(i)
+                            self._pending_dryruns -= 1
+                            break
+                    if evicted is None:
+                        shed_self = True
+                else:
+                    # nothing to preempt — the bound is the bound
+                    shed_self = True
+            if not shed_self:
+                self._pending.append(p)
+                if p.low_value:
+                    self._pending_dryruns += 1
+                self._cv.notify()
+        if evicted is not None:
+            with self._rate_lock:  # += races concurrent shedders
+                self.sheds += 1
+            if evicted.queue_span is not None:
+                evicted.queue_span.end()
+            evicted.error = _deadline.OverloadShed(
+                "dry-run admission preempted by enforced work at the "
+                "pending bound"
+            )
+            evicted.event.set()
+            record_shed("queue_full_dryrun")
+        if shed_self:
+            with self._rate_lock:  # += races concurrent shedders
+                self.sheds += 1
+            if p.queue_span is not None:
+                # the span opened at _Pending construction must close
+                # even though the request never queued — shed traces
+                # otherwise lose their (zero-length) queue_wait stage
+                p.queue_span.end()
+            record_shed(
+                "queue_full_dryrun" if p.low_value else "queue_full"
+            )
+            raise _deadline.OverloadShed(
+                "micro-batcher pending queue is at its bound "
+                f"({self.max_pending})"
+            )
         if dl is None:
             p.event.wait()
         elif not p.event.wait(timeout=max(0.0, dl - time.monotonic())):
@@ -349,6 +432,10 @@ class MicroBatcher:
                         self._cv.wait(timeout=self.window_s)
                 batch = self._pending[: self.max_batch]
                 self._pending = self._pending[self.max_batch:]
+                if self._pending_dryruns:
+                    self._pending_dryruns -= sum(
+                        1 for q in batch if q.low_value
+                    )
                 last_batch_size = len(batch)
                 self._busy = True
             # the batch is drained: queue-wait ends here for every member
@@ -507,6 +594,7 @@ class MicroBatcher:
         with self._cv:
             self._stop = True
             drained, self._pending = self._pending, []
+            self._pending_dryruns = 0
             for p in drained:
                 p.error = BatcherStopped(
                     "webhook batcher stopped before evaluation"
@@ -792,12 +880,54 @@ class WebhookServer:
                 if self.path not in ("/v1/admit", "/v1/admitlabel"):
                     self._send_text(404, "not found")
                     return
-                token = None
-                if outer.deadline_budget_s:
-                    token = _deadline.push(outer.deadline_budget_s)
                 try:
                     review = json.loads(body or b"{}")
                     req = review.get("request") or {}
+                    if not isinstance(req, dict):
+                        # {"request": "bogus"} is a malformed envelope,
+                        # not an empty request — it must get the same
+                        # explicit 500 AdmissionReview, and everything
+                        # below (budget parse, uid extraction) assumes
+                        # a dict
+                        raise TypeError(
+                            "AdmissionReview request must be an "
+                            f"object, got {type(req).__name__}"
+                        )
+                except Exception as e:  # malformed envelope
+                    log.exception("bad admission request")
+                    resp = AdmissionResponse(False, str(e), 500)
+                    self._send_json(
+                        200,
+                        {
+                            "apiVersion": "admission.k8s.io/v1beta1",
+                            "kind": "AdmissionReview",
+                            "response": resp.to_dict(uid=""),
+                        },
+                    )
+                    return
+                # end-to-end deadline (ISSUE 12): the budget is min()
+                # over every bound the request carries — the configured
+                # --admission-deadline-budget-ms, the AdmissionReview's
+                # own request.timeoutSeconds (the webhook config's
+                # timeout, when the caller stamps it — opportunistic,
+                # never required), and the REMAINING wire budget a
+                # fleet front door forwarded in X-GK-Deadline-Ms.  A replica behind the door re-enters
+                # the budget with what is left of the caller's patience,
+                # never a fresh allowance; an already-expired budget is
+                # refused at the first downstream stage (batcher
+                # enqueue), surfacing the explicit fail-open/closed
+                # decision within microseconds.
+                budget = _deadline.effective_budget_s(
+                    outer.deadline_budget_s,
+                    _deadline.parse_timeout_seconds(req),
+                    _deadline.parse_header_ms(
+                        self.headers.get(_deadline.DEADLINE_HEADER)
+                    ),
+                )
+                token = None
+                if budget is not None:
+                    token = _deadline.push(budget)
+                try:
                     # W3C trace context: adopt the apiserver's trace id so
                     # the deny log line and /debug/traces entry correlate
                     # with the upstream request
@@ -812,10 +942,9 @@ class WebhookServer:
                         else:
                             resp = outer.label_handler.handle(req)
                         rsp.set_attrs(allowed=resp.allowed, code=resp.code)
-                except Exception as e:  # malformed envelope
+                except Exception as e:  # handler defect
                     log.exception("bad admission request")
                     resp = AdmissionResponse(False, str(e), 500)
-                    req = {}
                 finally:
                     if token is not None:
                         _deadline.pop(token)

@@ -1,0 +1,12 @@
+"""MUST-FLAG fixture: unbounded queues (unbounded-queue, ISSUE 12) — a
+bare queue.Queue(), an explicit maxsize=0 (infinite by queue's
+semantics), and a SimpleQueue (unbounded by construction)."""
+
+import queue
+
+
+class Intake:
+    def __init__(self):
+        self.requests = queue.Queue()          # no bound at all
+        self.events = queue.Queue(maxsize=0)   # 0 = explicitly infinite
+        self.replies = queue.SimpleQueue()     # cannot be bounded

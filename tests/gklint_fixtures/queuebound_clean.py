@@ -1,0 +1,14 @@
+"""Clean twin of queuebound_bad: every queue carries a bound — a
+literal maxsize, a positional bound, and a configured one.  gklint must
+stay silent."""
+
+import queue
+
+DEPTH = 256
+
+
+class Intake:
+    def __init__(self, depth: int = 128):
+        self.requests = queue.Queue(maxsize=DEPTH)  # configured bound
+        self.events = queue.Queue(64)               # positional bound
+        self.replies = queue.Queue(maxsize=depth)   # computed bound

@@ -1,0 +1,205 @@
+"""Brownout ladder unit tests (ISSUE 12, obs/brownout.py): hysteresis in
+BOTH directions over a fake clock, the shed-rate signal's decay, action
+callbacks on every transition, the background-deferral predicate, and
+the module-global wiring record_shed feeds."""
+
+import pytest
+
+from gatekeeper_tpu.obs import brownout
+from gatekeeper_tpu.obs.brownout import MAX_LEVEL, BrownoutController
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture()
+def ctl():
+    clock = _Clock()
+    c = BrownoutController(clock=clock)
+    c.clock = clock  # test handle
+    return c
+
+
+def drive(c, seconds, step=0.25, queue_frac=0.0, slo=False):
+    """Advance the fake clock through `seconds` of ticks with the given
+    signal providers pinned."""
+    c.set_providers(queue_frac=lambda: queue_frac,
+                    slo_degraded=lambda: slo)
+    end = c.clock.t + seconds
+    while c.clock.t < end:
+        c.clock.t += step
+        c.tick(now=c.clock.t)
+
+
+class TestLadderUp:
+    def test_sustained_queue_pressure_steps_up_one_rung_per_window(
+        self, ctl
+    ):
+        drive(ctl, 0.9, queue_frac=1.0)
+        assert ctl.level == 0  # not sustained long enough yet
+        drive(ctl, 0.5, queue_frac=1.0)
+        assert ctl.level == 1
+        # each further rung needs its own sustained window
+        drive(ctl, ctl.UP_AFTER_S + 0.3, queue_frac=1.0)
+        assert ctl.level == 2
+        drive(ctl, ctl.UP_AFTER_S + 0.3, queue_frac=1.0)
+        assert ctl.level == 3
+
+    def test_caps_at_max_level(self, ctl):
+        drive(ctl, 10 * ctl.UP_AFTER_S, queue_frac=1.0)
+        assert ctl.level == MAX_LEVEL
+
+    def test_slo_burn_alone_is_an_overload_signal(self, ctl):
+        drive(ctl, ctl.UP_AFTER_S + 0.5, slo=True)
+        assert ctl.level >= 1
+
+    def test_shed_rate_alone_is_an_overload_signal(self, ctl):
+        end = ctl.clock.t + ctl.UP_AFTER_S + 0.6
+        while ctl.clock.t < end:
+            ctl.note_shed(5)  # 5 sheds per 0.25s tick = 20/s
+            ctl.clock.t += 0.25
+            ctl.tick(now=ctl.clock.t)
+        assert ctl.level >= 1
+
+    def test_transient_blip_does_not_step(self, ctl):
+        drive(ctl, 0.5, queue_frac=1.0)   # brief spike
+        drive(ctl, 5.0, queue_frac=0.0)   # clear
+        assert ctl.level == 0
+
+
+class TestLadderDown:
+    def test_recovery_steps_down_with_its_own_hysteresis(self, ctl):
+        drive(ctl, 3 * (ctl.UP_AFTER_S + 0.5), queue_frac=1.0)
+        assert ctl.level == 3
+        # clear, but not for long enough: holds
+        drive(ctl, ctl.DOWN_AFTER_S - 1.0, queue_frac=0.0)
+        assert ctl.level == 3
+        drive(ctl, 1.5, queue_frac=0.0)
+        assert ctl.level == 2
+        # all the way down
+        drive(ctl, 3 * (ctl.DOWN_AFTER_S + 0.5), queue_frac=0.0)
+        assert ctl.level == 0
+
+    def test_between_the_bars_holds_the_rung(self, ctl):
+        drive(ctl, ctl.UP_AFTER_S + 0.5, queue_frac=1.0)
+        assert ctl.level == 1
+        # mid-band pressure (above QUEUE_LOW, below QUEUE_HIGH): the
+        # ladder must neither climb nor recover — that's the hysteresis
+        mid = (ctl.QUEUE_LOW + ctl.QUEUE_HIGH) / 2
+        drive(ctl, 4 * ctl.DOWN_AFTER_S, queue_frac=mid)
+        assert ctl.level == 1
+
+    def test_oscillation_across_the_low_bar_never_recovers(self, ctl):
+        drive(ctl, ctl.UP_AFTER_S + 0.5, queue_frac=1.0)
+        assert ctl.level == 1
+        # alternate clear / mid-band faster than DOWN_AFTER_S: the clear
+        # streak resets every time, so the rung holds
+        for _ in range(10):
+            drive(ctl, ctl.DOWN_AFTER_S / 2, queue_frac=0.0)
+            drive(ctl, 0.5, queue_frac=0.5)
+        assert ctl.level == 1
+
+
+class TestActionsAndStatus:
+    def test_actions_fire_on_every_transition_with_old_and_new(self, ctl):
+        seen = []
+        ctl.on_change(lambda old, new: seen.append((old, new)))
+        drive(ctl, 2 * (ctl.UP_AFTER_S + 0.5), queue_frac=1.0)
+        drive(ctl, 3 * (ctl.DOWN_AFTER_S + 0.5), queue_frac=0.0)
+        assert (0, 1) in seen and (1, 2) in seen
+        assert (2, 1) in seen and (1, 0) in seen
+        assert ctl.transitions == len(seen)
+
+    def test_action_failure_does_not_break_the_ladder(self, ctl):
+        def boom(old, new):
+            raise RuntimeError("action defect")
+
+        ctl.on_change(boom)
+        drive(ctl, 2 * (ctl.UP_AFTER_S + 0.5), queue_frac=1.0)
+        assert ctl.level == 2  # the ladder kept stepping
+
+    def test_deferral_predicates_by_level(self, ctl):
+        assert not ctl.defer_background()
+        drive(ctl, ctl.UP_AFTER_S + 0.5, queue_frac=1.0)
+        assert ctl.defer_background()
+        assert not ctl.reduce_telemetry()
+        drive(ctl, ctl.UP_AFTER_S + 0.5, queue_frac=1.0)
+        assert ctl.reduce_telemetry()
+        assert not ctl.pin_routing()
+        drive(ctl, ctl.UP_AFTER_S + 0.5, queue_frac=1.0)
+        assert ctl.pin_routing()
+
+    def test_status_payload(self, ctl):
+        drive(ctl, ctl.UP_AFTER_S + 0.5, queue_frac=1.0)
+        st = ctl.status()
+        assert st["level"] == 1
+        assert st["level_name"] == "defer-audit"
+        assert st["transitions"] >= 1
+        assert st["signals"]["queue_frac"] == 1.0
+
+    def test_provider_failure_reads_as_not_overloaded(self, ctl):
+        def broken():
+            raise RuntimeError("provider died")
+
+        ctl.set_providers(queue_frac=broken, slo_degraded=broken)
+        ctl.clock.t += 10.0
+        ctl.tick(now=ctl.clock.t)
+        assert ctl.level == 0
+
+    def test_reset_returns_to_normal(self, ctl):
+        drive(ctl, ctl.UP_AFTER_S + 0.5, queue_frac=1.0)
+        assert ctl.level == 1
+        ctl.reset()
+        assert ctl.level == 0
+        assert not ctl.defer_background()
+
+
+class TestShedRateDecay:
+    def test_burst_decays_instead_of_pinning_the_ladder(self, ctl):
+        ctl.note_shed(100)
+        ctl.clock.t += 0.25
+        ctl.tick(now=ctl.clock.t)
+        assert ctl.shed_rate() > ctl.SHED_HIGH
+        # a long quiet stretch decays the rate below the low bar
+        drive(ctl, 30.0, queue_frac=0.0)
+        assert ctl.shed_rate() < ctl.SHED_LOW
+
+
+class TestModuleGlobalWiring:
+    def test_record_shed_feeds_the_global_controller(self):
+        from gatekeeper_tpu.metrics.catalog import record_shed
+
+        ctl = brownout.get_controller()
+        ctl.reset()
+        before = ctl._shed_count
+        record_shed("queue_full")
+        assert ctl._shed_count == before + 1
+        ctl.reset()
+
+    def test_defer_background_module_helper(self):
+        ctl = brownout.get_controller()
+        ctl.reset()
+        assert brownout.defer_background() is False
+        ctl.level = 1
+        try:
+            assert brownout.defer_background() is True
+        finally:
+            ctl.reset()
+
+    def test_sampler_start_stop_idempotent(self):
+        ctl = BrownoutController()
+        ctl.start()
+        ctl.start()  # idempotent: no second thread
+        import threading
+
+        names = [t.name for t in threading.enumerate()]
+        assert names.count("gk-brownout") == 1
+        ctl.stop()
+        ctl.stop()
+        names = [t.name for t in threading.enumerate()]
+        assert "gk-brownout" not in names

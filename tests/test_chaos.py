@@ -1195,3 +1195,206 @@ class TestDispatchGate:
         t.join(timeout=2.0)
         assert not t.is_alive()
         assert order == ["new-holder-done", "waiter"]
+
+
+class TestOverloadStorm:
+    """ISSUE 12: shedding under storm load never corrupts verdicts —
+    accepted requests match the interpreter oracle exactly while the
+    overload plane refuses the excess, and the new fault points drive
+    the storm deterministically."""
+
+    def test_zero_verdict_divergence_while_shedding(self, fault_plane):
+        """Saturate a REAL evaluation pipeline (slow dispatch via an
+        injected latency, bounded pending queue): every shed is an
+        OverloadShed, every accepted verdict is byte-identical to the
+        interpreter oracle — shedding must drop requests, never
+        accuracy."""
+        client, driver = tpu_client()
+        oracle = interp_client()
+        mb = MicroBatcher(client, window_s=0.005, max_pending=2,
+                          adaptive=False)
+        fault_plane.add(
+            faults.TPU_DISPATCH,
+            FaultRule(mode="latency", latency_s=0.15),
+        )
+        reqs = [
+            ns_review(f"storm-{i}",
+                      labels={"gatekeeper": "on"} if i % 3 else None)
+            for i in range(12)
+        ]
+        want = {
+            r["name"]: review_sig(oracle.review(
+                AugmentedReview(admission_request=r)))
+            for r in reqs
+        }
+        got: dict = {}
+        sheds: list = []
+        lock = threading.Lock()
+
+        def call(req):
+            try:
+                resp = mb.review(AugmentedReview(admission_request=req))
+            except deadline.OverloadShed:
+                with lock:
+                    sheds.append(req["name"])
+                return
+            with lock:
+                got[req["name"]] = review_sig(resp)
+
+        threads = [threading.Thread(target=call, args=(r,)) for r in reqs]
+        try:
+            for t in threads:
+                t.start()
+                time.sleep(0.01)
+            for t in threads:
+                t.join(timeout=30)
+            assert sheds, "the storm never forced a shed — not a storm"
+            assert got, "everything shed — no accepted verdicts to check"
+            divergences = [
+                name for name, sig in got.items() if sig != want[name]
+            ]
+            assert divergences == [], (
+                f"accepted verdicts diverged under shedding: {divergences}"
+            )
+        finally:
+            mb.stop()
+
+    def test_overload_storm_point_drives_door_sheds(self, fault_plane):
+        """The fleet.overload_storm seam: a latency rule holds proxied
+        attempts with their inflight slot taken, so the door's
+        accept-time shed engages — 429s answer FAST while the slow
+        requests complete correctly."""
+        from http.server import BaseHTTPRequestHandler
+        from http.server import ThreadingHTTPServer as _TS
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                self.send_response(200)
+                self.send_header("Content-Length", "2")
+                self.end_headers()
+                self.wfile.write(b"ok")
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(n)
+                body = b'{"served": true}'
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        backend = _TS(("127.0.0.1", 0), H)
+        bport = backend.server_address[1]
+        threading.Thread(target=backend.serve_forever,
+                         daemon=True).start()
+        from gatekeeper_tpu.fleet.frontdoor import FrontDoor
+
+        fault_plane.add(
+            faults.OVERLOAD_STORM,
+            FaultRule(mode="latency", latency_s=0.4),
+        )
+        door = FrontDoor(
+            [{"host": "127.0.0.1", "port": bport, "replica_id": "b"}],
+            probe_interval_s=3600.0, max_inflight=1,
+        ).start()
+        body = json.dumps({"request": ns_review("storm")}).encode()
+        results: list = []
+        lock = threading.Lock()
+
+        def post():
+            import http.client as hc
+
+            t0 = time.perf_counter()
+            conn = hc.HTTPConnection("127.0.0.1", door.port, timeout=10)
+            try:
+                conn.request(
+                    "POST", "/v1/admit", body=body,
+                    headers={"Content-Type": "application/json"},
+                )
+                r = conn.getresponse()
+                data = r.read()
+                with lock:
+                    results.append(
+                        (r.status, time.perf_counter() - t0, data))
+            finally:
+                conn.close()
+
+        threads = [threading.Thread(target=post) for _ in range(6)]
+        try:
+            for t in threads:
+                t.start()
+                time.sleep(0.02)
+            for t in threads:
+                t.join(timeout=30)
+            codes = [c for c, _d, _b in results]
+            assert 200 in codes, "the storm starved every request"
+            shed = [(c, d, b) for c, d, b in results if c == 429]
+            assert shed, "inflight bound never shed under the storm"
+            for _c, dur, data in shed:
+                assert dur < 0.2, f"shed took {dur:.3f}s"
+                out = json.loads(data)["response"]
+                assert out["allowed"] is False
+                assert out["status"]["code"] == 429
+        finally:
+            door.stop()
+            backend.shutdown()
+            backend.server_close()
+
+    def test_slow_client_point_fires_in_read_body(self, fault_plane):
+        """The frontdoor.slow_client seam: a latency rule stretches the
+        request's read_body stage (an accept thread held by a trickling
+        client) without corrupting the response."""
+        from http.server import BaseHTTPRequestHandler
+        from http.server import ThreadingHTTPServer as _TS
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(n)
+                body = b'{"served": true}'
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        backend = _TS(("127.0.0.1", 0), H)
+        bport = backend.server_address[1]
+        threading.Thread(target=backend.serve_forever,
+                         daemon=True).start()
+        from gatekeeper_tpu.fleet.frontdoor import FrontDoor
+
+        fault_plane.add(
+            faults.SLOW_CLIENT,
+            FaultRule(mode="latency", latency_s=0.25, count=1),
+        )
+        door = FrontDoor(
+            [{"host": "127.0.0.1", "port": bport, "replica_id": "b"}],
+            probe_interval_s=3600.0,
+        ).start()
+        try:
+            import http.client as hc
+
+            t0 = time.perf_counter()
+            conn = hc.HTTPConnection("127.0.0.1", door.port, timeout=10)
+            conn.request("POST", "/v1/admit", body=b"{}",
+                         headers={"Content-Type": "application/json"})
+            r = conn.getresponse()
+            data = r.read()
+            dur = time.perf_counter() - t0
+            conn.close()
+            assert r.status == 200 and b"served" in data
+            assert dur >= 0.25, "the slow-client latency never applied"
+        finally:
+            door.stop()
+            backend.shutdown()
+            backend.server_close()

@@ -463,3 +463,427 @@ class TestEjectionReadmission:
             conn.close()
         finally:
             door.stop()
+
+
+class _SlowStub:
+    """Backend that parks each POST on a gate (a wedged/slow replica)."""
+
+    def __init__(self, name: str = "slow"):
+        self.name = name
+        self.gate = threading.Event()
+        outer = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                body = b"ok"
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(n)
+                outer.gate.wait(10)
+                body = json.dumps({"served_by": outer.name}).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self.server.server_address[1]
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self.gate.set()
+        self.server.shutdown()
+        self.server.server_close()
+
+
+ADMIT_BODY = json.dumps({"request": {"uid": "uid-overload"}}).encode()
+
+
+class TestDeadlinePropagation:
+    """ISSUE 12: the door derives min(own budget, caller header), clamps
+    backend timeouts to the remaining budget, forwards the REMAINING
+    milliseconds downstream, and answers expired work with the explicit
+    fail-open/closed verdict."""
+
+    def test_remaining_budget_forwarded_in_header(self):
+        echo = _EchoHeaders()
+        door = FrontDoor(
+            [{"host": "127.0.0.1", "port": echo.port, "replica_id": "e"}],
+            probe_interval_s=3600.0, admission_budget_s=0.5,
+        ).start()
+        try:
+            st, _hd, _body = _post(door.port, ADMIT_BODY)
+            assert st == 200
+            fwd = echo.headers[-1].get("X-GK-Deadline-Ms")
+            assert fwd is not None
+            # REMAINING budget: below the granted 500ms, above zero
+            assert 0.0 < float(fwd) <= 500.0
+        finally:
+            door.stop()
+            echo.stop()
+
+    def test_caller_header_min_merged_with_door_budget(self):
+        echo = _EchoHeaders()
+        door = FrontDoor(
+            [{"host": "127.0.0.1", "port": echo.port, "replica_id": "e"}],
+            probe_interval_s=3600.0, admission_budget_s=10.0,
+        ).start()
+        try:
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", door.port, timeout=10)
+            conn.request("POST", "/v1/admit", body=ADMIT_BODY,
+                         headers={"Content-Type": "application/json",
+                                  "X-GK-Deadline-Ms": "200"})
+            resp = conn.getresponse()
+            resp.read()
+            conn.close()
+            assert resp.status == 200
+            fwd = float(echo.headers[-1]["X-GK-Deadline-Ms"])
+            assert fwd <= 200.0  # the tighter caller bound won
+        finally:
+            door.stop()
+            echo.stop()
+
+    def test_expired_on_arrival_answers_explicit_verdict(self):
+        """Dead-on-arrival work is dropped at door accept: a well-formed
+        fail-closed AdmissionReview (code 504), never a proxied hop —
+        the backend must not even see it."""
+        echo = _EchoHeaders()
+        door = FrontDoor(
+            [{"host": "127.0.0.1", "port": echo.port, "replica_id": "e"}],
+            probe_interval_s=3600.0,
+        ).start()
+        try:
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", door.port, timeout=10)
+            conn.request("POST", "/v1/admit", body=ADMIT_BODY,
+                         headers={"Content-Type": "application/json",
+                                  "X-GK-Deadline-Ms": "-5"})
+            resp = conn.getresponse()
+            body = resp.read()
+            conn.close()
+            assert resp.status == 200
+            out = json.loads(body)["response"]
+            assert out["allowed"] is False
+            assert out["status"]["code"] == 504
+            assert out["uid"] == "uid-overload"  # extracted from the body
+            assert echo.headers == []  # never proxied
+            assert door.sheds == 1
+        finally:
+            door.stop()
+            echo.stop()
+
+    def test_expired_fail_open_allows_with_annotation(self):
+        door = FrontDoor(
+            [("127.0.0.1", _free_port())],
+            probe_interval_s=3600.0, fail_open=True,
+        ).start()
+        try:
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", door.port, timeout=10)
+            conn.request("POST", "/v1/admit", body=ADMIT_BODY,
+                         headers={"Content-Type": "application/json",
+                                  "X-GK-Deadline-Ms": "0"})
+            resp = conn.getresponse()
+            out = json.loads(resp.read())["response"]
+            conn.close()
+            assert out["allowed"] is True
+            assert out["auditAnnotations"] == {
+                "admission.gatekeeper.sh/fail-open": "deadline-exhausted"
+            }
+        finally:
+            door.stop()
+
+    def test_slow_backend_with_tight_budget_expires_in_budget(self):
+        """The clamped socket timeout firing on an exhausted budget
+        answers the explicit expired verdict within ~budget — never a
+        30s socket park.  ONE expiry charges the error streak (a
+        backend timing out every request is indistinguishable from
+        wedged) but does not eject; the next success clears it."""
+        slow = _SlowStub()
+        door = FrontDoor(
+            [{"host": "127.0.0.1", "port": slow.port,
+              "replica_id": "slow"}],
+            probe_interval_s=3600.0, admission_budget_s=0.3,
+        ).start()
+        try:
+            t0 = time.perf_counter()
+            st, _hd, body = _post(door.port, ADMIT_BODY)
+            dur = time.perf_counter() - t0
+            assert st == 200
+            out = json.loads(body)["response"]
+            assert out["allowed"] is False
+            assert out["status"]["code"] == 504
+            assert dur < 2.0, f"expired answer took {dur:.3f}s"
+            b = door.stats()["backends"][0]
+            assert b["consecutive_errors"] == 1
+            assert b["ejected"] is False  # one expiry is forgivable
+            # a served request clears the streak: a healthy backend
+            # that occasionally carries a too-tight request never
+            # accumulates toward ejection
+            slow.gate.set()
+            st2, _hd2, _b2 = _post(door.port, ADMIT_BODY)
+            assert st2 == 200
+            assert door.stats()["backends"][0]["consecutive_errors"] == 0
+        finally:
+            door.stop()
+            slow.stop()
+
+    def test_wedged_backend_ejects_under_deadline_timeouts(self):
+        """A backend that times out EVERY budget-clamped request is
+        wedged from the door's perspective and must eject like any
+        failing backend — never-ejecting would leave it burning half
+        of all request budgets forever; a falsely-ejected healthy one
+        is readmitted by the /readyz prober."""
+        slow = _SlowStub()  # gate never set: wedged
+        door = FrontDoor(
+            [{"host": "127.0.0.1", "port": slow.port,
+              "replica_id": "wedged"}],
+            probe_interval_s=3600.0, admission_budget_s=0.2,
+        ).start()
+        try:
+            for _ in range(FrontDoor.EJECT_ERROR_STREAK):
+                st, _hd, body = _post(door.port, ADMIT_BODY)
+                assert st == 200
+                assert json.loads(body)["response"]["status"]["code"] \
+                    == 504
+            assert door.stats()["backends"][0]["ejected"] is True
+        finally:
+            door.stop()
+            slow.stop()
+
+
+class TestInflightShed:
+    def test_saturated_backends_shed_fast_with_retry_after(self):
+        slow = _SlowStub()
+        door = FrontDoor(
+            [{"host": "127.0.0.1", "port": slow.port,
+              "replica_id": "slow"}],
+            probe_interval_s=3600.0, max_inflight=1,
+        ).start()
+        occupier = threading.Thread(
+            target=lambda: _post(door.port, ADMIT_BODY))
+        try:
+            occupier.start()
+            assert wait_until(
+                lambda: door.stats()["backends"][0]["inflight"] >= 1)
+            t0 = time.perf_counter()
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", door.port, timeout=10)
+            conn.request("POST", "/v1/admit", body=ADMIT_BODY,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            body = resp.read()
+            dur = time.perf_counter() - t0
+            hd = dict(resp.getheaders())
+            conn.close()
+            assert resp.status == 429
+            assert hd.get("Retry-After") == "1"
+            out = json.loads(body)["response"]
+            assert out["allowed"] is False
+            assert out["status"]["code"] == 429
+            assert out["uid"] == "uid-overload"
+            assert dur < 0.2, f"shed took {dur:.3f}s (must be fast)"
+            assert door.sheds >= 1
+        finally:
+            slow.gate.set()
+            occupier.join(timeout=10)
+            door.stop()
+            slow.stop()
+
+    def test_no_bound_means_no_shed(self, live_backend):
+        door = FrontDoor(
+            [{"host": "127.0.0.1", "port": live_backend.port,
+              "replica_id": "live"}], probe_interval_s=3600.0,
+        ).start()
+        try:
+            assert door._has_capacity() is True
+            st, _hd, _body = _post(door.port, ADMIT_BODY)
+            assert st == 200 and door.sheds == 0
+        finally:
+            door.stop()
+
+
+class TestRetryBudget:
+    def test_empty_bucket_denies_the_retry(self, live_backend):
+        """Two dead backends ahead of a live one under round robin with
+        a zero-capacity retry budget: the first request's failure CANNOT
+        be retried — explicit 502 even though a live backend exists."""
+        door = FrontDoor(
+            [{"host": "127.0.0.1", "port": _free_port(),
+              "replica_id": "dead0"},
+             {"host": "127.0.0.1", "port": _free_port(),
+              "replica_id": "dead1"},
+             {"host": "127.0.0.1", "port": live_backend.port,
+              "replica_id": "live"}],
+            policy=ROUND_ROBIN, probe_interval_s=3600.0,
+            retry_budget_cap=0.0, retry_budget_rate_per_s=0.0,
+        ).start()
+        try:
+            codes = [_post(door.port, ADMIT_BODY)[0] for _ in range(6)]
+            assert 502 in codes
+            assert door.retry_budget.denied >= 1
+            assert door.stats()["retry_budget"]["denied"] >= 1
+            # the dead pair still ejects on refusal, so the door
+            # converges onto the live backend WITHOUT retries
+            assert wait_until(lambda: all(
+                b["ejected"] for b in door.stats()["backends"]
+                if b["replica_id"].startswith("dead")))
+            assert _post(door.port, ADMIT_BODY)[0] == 200
+        finally:
+            door.stop()
+
+    def test_bucket_refills_and_grants_again(self):
+        from gatekeeper_tpu.fleet.frontdoor import RetryBudget
+
+        rb = RetryBudget(cap=2.0, rate_per_s=1000.0)
+        assert rb.take() and rb.take()
+        # cap 2, both taken; at 1000/s the bucket refills immediately
+        assert wait_until(rb.take, timeout_s=1.0)
+
+    def test_deny_then_starve(self):
+        from gatekeeper_tpu.fleet.frontdoor import RetryBudget
+
+        rb = RetryBudget(cap=1.0, rate_per_s=0.0)
+        assert rb.take()
+        assert not rb.take()
+        assert rb.denied == 1
+        assert rb.tokens() == 0.0
+
+
+class TestSlowClientHardening:
+    def test_slowloris_header_stall_is_closed_by_timeout(self):
+        door = FrontDoor(
+            [("127.0.0.1", _free_port())],
+            probe_interval_s=3600.0, header_timeout_s=0.3,
+        ).start()
+        try:
+            s = socket.create_connection(("127.0.0.1", door.port),
+                                         timeout=5)
+            s.sendall(b"POST /v1/admit HTTP/1.1\r\nHost: x\r\n")
+            # ...and never finish the headers: the inbound socket
+            # timeout must close the connection instead of parking the
+            # accept thread forever
+            s.settimeout(5.0)
+            t0 = time.perf_counter()
+            data = s.recv(1024)
+            dur = time.perf_counter() - t0
+            s.close()
+            assert data == b""  # server closed on us
+            assert dur < 3.0, f"slowloris held the thread {dur:.1f}s"
+        finally:
+            door.stop()
+
+    def test_stalled_body_answers_408(self):
+        door = FrontDoor(
+            [("127.0.0.1", _free_port())],
+            probe_interval_s=3600.0, header_timeout_s=0.3,
+        ).start()
+        try:
+            s = socket.create_connection(("127.0.0.1", door.port),
+                                         timeout=5)
+            s.sendall(b"POST /v1/admit HTTP/1.1\r\nHost: x\r\n"
+                      b"Content-Length: 100\r\n\r\nonly-a-bit")
+            s.settimeout(5.0)
+            chunks = []
+            try:
+                while True:
+                    got = s.recv(4096)
+                    if not got:
+                        break
+                    chunks.append(got)
+            except socket.timeout:
+                pass
+            s.close()
+            assert b"408" in b"".join(chunks)
+        finally:
+            door.stop()
+
+    def test_oversized_body_answers_413_without_reading(self, live_backend):
+        door = FrontDoor(
+            [{"host": "127.0.0.1", "port": live_backend.port,
+              "replica_id": "live"}], probe_interval_s=3600.0,
+        ).start()
+        try:
+            s = socket.create_connection(("127.0.0.1", door.port),
+                                         timeout=5)
+            huge = FrontDoor.MAX_BODY + 1
+            s.sendall(f"POST /v1/admit HTTP/1.1\r\nHost: x\r\n"
+                      f"Content-Length: {huge}\r\n\r\n".encode())
+            s.settimeout(5.0)
+            data = s.recv(4096)
+            s.close()
+            assert b"413" in data.split(b"\r\n", 1)[0]
+        finally:
+            door.stop()
+
+
+class TestInflightReservation:
+    """The max_inflight bound is enforced by RESERVATION in _choose
+    (slot taken under the backend's lock), not by a check-then-act
+    read: concurrent accepts cannot overshoot the bound, and a
+    saturated-but-live fleet raises OverloadShed instead of silently
+    falling through to a saturated backend."""
+
+    def test_choose_reserves_and_sheds_at_the_bound(self, live_backend):
+        from gatekeeper_tpu.deadline import OverloadShed
+
+        door = FrontDoor(
+            [{"host": "127.0.0.1", "port": live_backend.port,
+              "replica_id": "live"}],
+            probe_interval_s=3600.0, max_inflight=2,
+        )
+        b1 = door._choose()
+        b2 = door._choose()
+        assert b1 is b2 and b1.inflight == 2  # both slots reserved
+        try:
+            door._choose()
+            assert False, "third choose must shed, not overshoot"
+        except OverloadShed:
+            pass
+        # releasing one reservation makes the slot choosable again
+        with b1.lock:
+            b1.inflight -= 1
+        assert door._choose() is b1 and b1.inflight == 2
+
+    def test_concurrent_chooses_never_overshoot(self, live_backend):
+        from gatekeeper_tpu.deadline import OverloadShed
+
+        door = FrontDoor(
+            [{"host": "127.0.0.1", "port": live_backend.port,
+              "replica_id": "live"}],
+            probe_interval_s=3600.0, max_inflight=3,
+        )
+        granted, shed = [], []
+        lock = threading.Lock()
+        start = threading.Barrier(16)
+
+        def race():
+            start.wait()
+            try:
+                b = door._choose()
+            except OverloadShed:
+                with lock:
+                    shed.append(1)
+                return
+            with lock:
+                granted.append(b)
+
+        ts = [threading.Thread(target=race) for _ in range(16)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=10)
+        assert len(granted) == 3 and len(shed) == 13
+        assert door.backends[0].inflight == 3  # exactly the bound

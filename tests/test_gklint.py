@@ -260,3 +260,81 @@ def test_every_registered_rule_documented_in_catalog():
     # self-check: every rule id carries a description for --list-rules
     for rule, doc in analysis.RULES.items():
         assert doc and len(doc) > 10, rule
+
+
+# ---- unbounded-queue (ISSUE 12) --------------------------------------------
+
+
+def test_unbounded_queue_seeds_flagged():
+    findings = _lint("queuebound_bad.py")
+    assert {f.rule for f in findings} == {"unbounded-queue"}
+    # the bare Queue(), the maxsize=0, and the SimpleQueue
+    assert len(findings) == 3, findings
+    assert {f.context for f in findings} == {"Intake.__init__"}
+    assert any("SimpleQueue" in f.message for f in findings)
+
+
+def test_unbounded_queue_clean_twin_silent():
+    assert _lint("queuebound_clean.py") == []
+
+
+def test_pending_list_flagged_on_serving_paths_only(tmp_path):
+    src = textwrap.dedent(
+        """
+        class Batcher:
+            def __init__(self):
+                self._pending = []
+        """
+    )
+    root = tmp_path / "repo"
+    serving = root / "gatekeeper_tpu" / "webhook"
+    serving.mkdir(parents=True)
+    (serving / "srv.py").write_text(src)
+    elsewhere = root / "gatekeeper_tpu" / "audit"
+    elsewhere.mkdir(parents=True)
+    (elsewhere / "pack.py").write_text(src)
+    findings = analysis.lint(str(root), [str(root / "gatekeeper_tpu")])
+    by_path = {f.path for f in findings
+               if f.rule == "unbounded-queue"}
+    # the serving-path copy is flagged; the audit-side scratch list is
+    # out of the rule's blast radius by design
+    assert by_path == {"gatekeeper_tpu/webhook/srv.py"}, findings
+
+
+def test_pending_list_with_len_bound_is_clean(tmp_path):
+    src = textwrap.dedent(
+        """
+        class Batcher:
+            MAX_PENDING = 64
+
+            def __init__(self):
+                self._pending = []
+
+            def push(self, item):
+                if len(self._pending) >= self.MAX_PENDING:
+                    raise RuntimeError("shed")
+                self._pending.append(item)
+        """
+    )
+    root = tmp_path / "repo"
+    serving = root / "gatekeeper_tpu" / "fleet"
+    serving.mkdir(parents=True)
+    (serving / "door.py").write_text(src)
+    findings = analysis.lint(str(root), [str(root / "gatekeeper_tpu")])
+    assert [f for f in findings if f.rule == "unbounded-queue"] == []
+
+
+def test_unbounded_queue_suppressible_with_reason(tmp_path):
+    src = textwrap.dedent(
+        """
+        import queue
+
+        # gklint: disable=unbounded-queue -- bounded by protocol: one
+        # reply per command
+        REPLIES = queue.Queue()
+        """
+    )
+    f = tmp_path / "mod.py"
+    f.write_text(src)
+    findings = analysis.lint(str(tmp_path), [str(f)])
+    assert findings == []
