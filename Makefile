@@ -34,6 +34,9 @@ lint:  ## gklint invariants + observability/parity conformance checks
 	python tools/gklint.py gatekeeper_tpu/
 	python tools/check_observability.py
 
+.PHONY: obs-check
+obs-check: lint  ## observability conformance + gklint (alias of lint so the two never drift)
+
 .PHONY: lint-baseline
 lint-baseline:  ## accept current gklint findings into .gklint-baseline.json
 	python tools/gklint.py --write-baseline

@@ -1058,6 +1058,7 @@ def bench_curve() -> dict:
     curve_interp = {}
     curve_np = {}
     routes = {}
+    routez_wins = {}
     cal_logged = None
     for n in counts:
         templates, constraints = make_templates(n)
@@ -1084,6 +1085,18 @@ def bench_curve() -> dict:
             cal_logged = {k: round(v, 3) for k, v in cal.items()}
             log(f"routing calibration: {cal_logged}")
         routes[n] = c.driver._route_eval(n)
+        # route explainability (ISSUE 13): the decision just recorded
+        # lands in this driver's ledger — keep its per-shape win row so
+        # the artifact carries the ledger's view of the frontier, not
+        # just the return value
+        routez_wins[n] = next(
+            (
+                row["wins"]
+                for row in c.driver.route_ledger.tier_wins()
+                if row["per_review_cells"] == n and row["n_reviews"] == 1
+            ),
+            {},
+        )
 
         def series(offset, forced=None):
             # distinct pod offset per series: unique content must not hit
@@ -1146,6 +1159,18 @@ def bench_curve() -> dict:
     )
     log(f"curve route accuracy: {agree}/{len(counts)} Ns picked the "
         f"measured-fastest path")
+    # the exact shape frontier where the compiled tier starts winning
+    # (ISSUE 13: consumed from the route ledger rather than inferred) —
+    # None means the compiled tier lost at every measured shape
+    sorted_ns = sorted(counts)
+    device_ns = [n for n in sorted_ns if routes[n] == "device"]
+    frontier = {
+        "device_first_cells": device_ns[0] if device_ns else None,
+        "host_last_cells": max(
+            (n for n in sorted_ns if routes[n] != "device"), default=None
+        ),
+    }
+    log(f"curve route frontier: {frontier} (ledger wins: {routez_wins})")
     return {
         "metric": "admission handler p50 vs constraint count (unique-content)",
         "value": curve[max(counts)],
@@ -1158,6 +1183,8 @@ def bench_curve() -> dict:
         "curve_device_p50_ms": curve_device,
         "curve_route": routes,
         "curve_route_accuracy": f"{agree}/{len(counts)}",
+        "curve_routez_wins": routez_wins,
+        "curve_route_frontier": frontier,
         "routing_calibration": cal_logged,
     }
 
@@ -3190,6 +3217,253 @@ print(json.dumps({
     return json.loads(proc.stdout.strip().splitlines()[-1])
 
 
+def bench_obs_engine() -> dict:
+    """ISSUE 13 proof config -> OBS_r13.json, three sections:
+
+      1. engine-telemetry overhead: the route ledger + compile stats
+         measured on the in-process fleet-shape review stream with
+         PAIRED off/on arms (alternating order, arm medians — the
+         OBS_r11 profiler estimator), acceptance <3%;
+      2. route explainability: a calibrated shape sweep whose
+         /debug/routez tier-win table must reproduce the live
+         `_route_eval` choices (the BENCH_r05 curve_route frontier,
+         re-measured on this box's calibration);
+      3. a SEEDED breaker trip (fault plane on tpu.dispatch) proving the
+         flight-recorder dump carries trip -> tier fallback -> recovery
+         in causal order.
+    """
+    from gatekeeper_tpu import faults
+    from gatekeeper_tpu.client.client import Client
+    from gatekeeper_tpu.obs import compilestats, flightrec
+    from gatekeeper_tpu.obs.debug import get_router
+    from gatekeeper_tpu.ops.driver import TpuDriver
+    from gatekeeper_tpu.util.synthetic import make_pods, make_templates
+
+    n_templates = int(os.environ.get("BENCH_OBS_TEMPLATES", "10"))
+    n_stream = int(os.environ.get("BENCH_OBS_REVIEWS", "300000"))
+    n_pairs = int(os.environ.get("BENCH_OBS_PAIRS", "5"))
+    chunk = int(os.environ.get("BENCH_OBS_CHUNK", "256"))
+
+    templates, constraints = make_templates(n_templates)
+    c = Client(driver=TpuDriver())
+    for t in templates:
+        c.add_template(t)
+    for cons in constraints:
+        c.add_constraint(cons)
+    driver = c.driver
+    pods = make_pods(4096, seed=13)
+    reqs = [{
+        "kind": {"group": "", "version": "v1", "kind": "Pod"},
+        "name": p["metadata"]["name"],
+        "namespace": p["metadata"]["namespace"],
+        "operation": "CREATE",
+        "object": p,
+    } for p in pods]
+
+    def batch_of(start, n):
+        return [reqs[(start + j) % len(reqs)] for j in range(n)]
+
+    # warm every chunk shape, then calibrate so stream routing runs the
+    # production (measured cost model) decision path
+    driver.review_batch(batch_of(0, chunk))
+    tail = n_stream % chunk
+    if tail:
+        driver.review_batch(batch_of(0, tail))
+    cal = driver.calibrate_routing()
+    cal_out = {k: round(v, 3) for k, v in cal.items()} if cal else None
+    log(f"obs_engine: calibration {cal_out}")
+
+    # ---- 1. paired telemetry overhead --------------------------------------
+    ledger = driver.route_ledger
+    stats = compilestats.get_stats()
+
+    def stream_round() -> float:
+        t0 = time.perf_counter()
+        done = 0
+        while done < n_stream:
+            n = min(chunk, n_stream - done)
+            driver.review_batch(batch_of(done, n))
+            done += n
+        return round(n_stream / (time.perf_counter() - t0), 1)
+
+    def set_telemetry(on: bool):
+        ledger.enabled = on
+        stats.enabled = on
+
+    rates_off, rates_on = [], []
+    try:
+        for i in range(n_pairs):
+            # alternate arm order: monotonic co-tenant drift must not
+            # systematically tax whichever arm runs second
+            order = (False, True) if i % 2 == 0 else (True, False)
+            for on in order:
+                set_telemetry(on)
+                (rates_on if on else rates_off).append(stream_round())
+    finally:
+        set_telemetry(True)
+    # estimator: MEDIAN OF PAIR RATIOS — this box's co-tenancy swings
+    # round rates ±7%, far above the plane's real cost (one ledger
+    # record per 256-review chunk).  Within a back-to-back pair the
+    # drift hits both arms almost equally (order alternated), and the
+    # median over pairs rejects a burst landing inside any single pair;
+    # the arm medians ride along in the artifact for cross-checking
+    pair_ratios = sorted(on / off for on, off in zip(rates_on, rates_off))
+    ratio = pair_ratios[len(pair_ratios) // 2]
+    overhead_pct = round((1.0 - ratio) * 100.0, 2)
+    med_off = sorted(rates_off)[len(rates_off) // 2]
+    med_on = sorted(rates_on)[len(rates_on) // 2]
+    log(f"obs_engine: telemetry overhead {overhead_pct}% "
+        f"(pair ratios={[round(r, 4) for r in pair_ratios]}, "
+        f"median off={med_off} on={med_on}, off={rates_off}, "
+        f"on={rates_on})")
+
+    # ---- 2. /debug/routez vs the live route frontier -----------------------
+    ledger.clear()
+    curve_ns = [int(x) for x in os.environ.get(
+        "BENCH_CURVE", "5,10,50,100,200,1000,2000").split(",")]
+    live_routes = {n: driver._route_eval(n, n_reviews=1) for n in curve_ns}
+    batch_routes = {
+        r: driver._route_eval(n_templates * r, n_reviews=r)
+        for r in (1, 8, 64, 256, 1024, 4096)
+    }
+    code, _ctype, body = get_router().handle("/debug/routez", "limit=64")
+    assert code == 200, f"/debug/routez answered {code}"
+    routez = json.loads(body)
+    wins_by_shape = {
+        (row["per_review_cells"], row["n_reviews"]): row["wins"]
+        for row in routez["tier_wins"]
+    }
+    matches = all(
+        max(wins_by_shape.get((n, 1), {}).items(),
+            key=lambda kv: kv[1], default=(None, 0))[0] == live_routes[n]
+        for n in curve_ns
+    )
+    device_ns = [n for n in sorted(curve_ns) if live_routes[n] == "device"]
+    frontier = {
+        "device_first_cells": device_ns[0] if device_ns else None,
+        "host_last_cells": max(
+            (n for n in sorted(curve_ns) if live_routes[n] != "device"),
+            default=None,
+        ),
+    }
+    log(f"obs_engine: routez matches live routes: {matches}; "
+        f"routes={live_routes}; batch_routes={batch_routes}")
+
+    # ---- 3. seeded breaker trip -> flight-recorder dump --------------------
+    import tempfile
+
+    rec = flightrec.get_recorder()
+    rec.clear()
+    dump_dir = tempfile.mkdtemp(prefix="gk-flightrec-")
+    rec.configure(dump_dir=dump_dir)
+    c2 = Client(driver=TpuDriver(breaker_threshold=3,
+                                 breaker_cooldown_s=0.5))
+    for t, k in zip(templates[:5], constraints[:5]):
+        c2.add_template(t)
+        c2.add_constraint(k)
+    d2 = c2.driver
+    d2.DEVICE_MIN_CELLS = 0  # force the device tier (instance override)
+    d2.review_batch(batch_of(0, 1))  # warm: device path healthy
+    plane = faults.install(seed=13)
+    from gatekeeper_tpu.faults import FaultRule
+
+    plane.add(faults.TPU_DISPATCH,
+              FaultRule(mode=faults.ERROR, probability=1.0, count=3))
+    try:
+        for i in range(3):  # three failed dispatches trip the breaker
+            d2.review_batch(batch_of(100 + i, 1))
+        assert d2.breaker.state == "open", d2.breaker.state
+        # diverted while open: the ledger records breaker_open and the
+        # tier flip lands in the flight recorder
+        d2.review_batch(batch_of(200, 1))
+        # recovery: the background probe's next dispatch succeeds (the
+        # fault rule is spent) and closes the breaker
+        t0 = time.perf_counter()
+        while d2.breaker.state != "closed":
+            if time.perf_counter() - t0 > 30.0:
+                raise RuntimeError(
+                    f"breaker did not recover (state={d2.breaker.state})")
+            time.sleep(0.05)
+    finally:
+        faults.uninstall()
+    d2.review_batch(batch_of(300, 1))  # back on the device tier
+    code, _ctype, body = get_router().handle("/debug/flightrecz", "dump=1")
+    assert code == 200, f"/debug/flightrecz answered {code}"
+    fpayload = json.loads(body)
+    events = fpayload["events"]
+
+    def first_seq(pred):
+        return next((e["seq"] for e in events if pred(e)), None)
+
+    trip_seq = first_seq(
+        lambda e: e["type"] == "breaker_transition"
+        and e.get("new") == "open"
+    )
+    fallback_seq = first_seq(
+        lambda e: e["type"] == "route_flip"
+        and e.get("reason") in ("breaker_open", "device_failed")
+        and (trip_seq is None or e["seq"] > trip_seq)
+    )
+    recovery_seq = first_seq(
+        lambda e: e["type"] == "breaker_transition"
+        and e.get("new") == "closed"
+        and (fallback_seq is None or e["seq"] > fallback_seq)
+    )
+    causal = (
+        trip_seq is not None and fallback_seq is not None
+        and recovery_seq is not None
+        and trip_seq < fallback_seq < recovery_seq
+    )
+    log(f"obs_engine: flight recording trip={trip_seq} "
+        f"fallback={fallback_seq} recovery={recovery_seq} "
+        f"causal={causal} ({len(events)} events, "
+        f"dump={fpayload.get('dumped_to')})")
+
+    # compile provenance for the corpus (populated by every aot_jit build
+    # this config triggered; xlacache counters availability rides along)
+    compilez = stats.snapshot(limit=0)
+    out = {
+        "metric": "engine-telemetry overhead on the in-process stream "
+                  f"({n_templates} constraints, chunk {chunk})",
+        "value": overhead_pct,
+        "unit": "%",
+        "vs_baseline": 0,
+        "engine_telemetry_overhead_pct": overhead_pct,
+        "telemetry_pair_ratios": [round(r, 4) for r in pair_ratios],
+        "telemetry_arm_median_overhead_pct": round(
+            (1.0 - med_on / med_off) * 100.0, 2),
+        "telemetry_rates_off": rates_off,
+        "telemetry_rates_on": rates_on,
+        "routing_calibration": cal_out,
+        "routez_live_routes": {str(k): v for k, v in live_routes.items()},
+        "routez_batch_routes": {
+            str(k): v for k, v in batch_routes.items()
+        },
+        "routez_tier_wins": routez["tier_wins"],
+        "routez_matches_live": bool(matches),
+        "route_frontier": frontier,
+        "compile_provenance_mix": compilez["provenance_mix"],
+        "compile_epoch_lag": compilez["compile_epoch_lag"],
+        "xlacache_counters_available": compilez["xlacache"][
+            "counters_available"],
+        "flightrec": {
+            "dump_path": fpayload.get("dumped_to"),
+            "event_count": len(events),
+            "trip_seq": trip_seq,
+            "fallback_seq": fallback_seq,
+            "recovery_seq": recovery_seq,
+            "causal_order_ok": causal,
+        },
+    }
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "OBS_r13.json"), "w") as f:
+        json.dump(out, f, indent=2, sort_keys=True)
+    assert overhead_pct < 3.0, (
+        f"engine telemetry overhead {overhead_pct}% >= 3%")
+    assert causal, "flight recording lost the trip->fallback->recovery order"
+    return out
+
+
 CONFIGS = {
     "synthetic": bench_synthetic,
     "latency": bench_latency,
@@ -3208,6 +3482,7 @@ CONFIGS = {
     "fleet": bench_fleet,
     "chaos_fleet": bench_chaos_fleet,
     "overload": bench_overload,
+    "obs_engine": bench_obs_engine,
 }
 
 # secondary configs folded into the default run, with the extra-key name
@@ -3231,6 +3506,7 @@ _FOLDED = [
     ("fleet", "fleet_reviews_per_s"),
     ("chaos_fleet", "chaos_failed_admissions"),
     ("overload", "overload_goodput_ratio_10x"),
+    ("obs_engine", "engine_telemetry_overhead_pct"),
 ]
 
 
@@ -3337,6 +3613,12 @@ def main():
                 k: sub.get(k) for k in
                 ("parity", "sweep_s", "dcn_bytes_per_sweep")
             }
+        if name == "obs_engine":
+            out["route_frontier"] = sub.get("route_frontier")
+            out["routez_matches_live"] = sub.get("routez_matches_live")
+            out["flightrec_causal_order_ok"] = (
+                sub.get("flightrec") or {}
+            ).get("causal_order_ok")
     print(json.dumps(out))
 
 

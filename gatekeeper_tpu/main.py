@@ -154,6 +154,27 @@ def build_parser() -> argparse.ArgumentParser:
                    help="disable the brownout ladder (sustained-overload "
                         "degradation: audit/snapshot deferral, reduced "
                         "telemetry, throughput-pinned routing)")
+    # black-box flight recorder (ISSUE 13, docs/observability.md)
+    p.add_argument("--flightrec-dir",
+                   default=os.environ.get("GK_FLIGHTREC_DIR", ""),
+                   help="directory for black-box flight-recorder dumps "
+                        "(breaker-open, SLO page, process death, "
+                        "/debug/flightrecz?dump=1); empty keeps the "
+                        "in-memory ring only")
+    def env_flightrec_size() -> int:
+        # defensive parse (the $GK_PROFILER_HZ lesson): a typo'd env
+        # value must not kill every process at parser build
+        raw = os.environ.get("GK_FLIGHTREC_SIZE", "512")
+        try:
+            return int(raw)
+        except ValueError:
+            log.warning("GK_FLIGHTREC_SIZE=%r is not an integer; "
+                        "using 512", raw)
+            return 512
+
+    p.add_argument("--flightrec-size", type=int,
+                   default=env_flightrec_size(),
+                   help="bounded flight-recorder event ring size")
     # graceful degradation (docs/failure-modes.md)
     p.add_argument("--admission-deadline-budget-ms", type=float, default=0.0,
                    help="per-request admission deadline budget in ms; work "
@@ -555,6 +576,18 @@ class App:
         from .ops.deltasweep import BG_STOP
 
         BG_STOP.clear()  # re-arm background workers after a stop()
+        # black-box flight recorder FIRST: the snapshot restore below and
+        # every later subsystem may record incident events; with a dump
+        # dir configured the process-death hook (atexit + chained
+        # SIGTERM) makes a crash leave one ordered artifact behind
+        from .obs import flightrec
+
+        flightrec.get_recorder().configure(
+            dump_dir=getattr(args, "flightrec_dir", "") or None,
+            maxlen=getattr(args, "flightrec_size", None),
+        )
+        if getattr(args, "flightrec_dir", ""):
+            flightrec.get_recorder().install_exit_hook()
         # cert bootstrap gates everything (main.go:219-220); write_cert_files
         # runs ensure_certs synchronously, so readiness is set before start()
         # spins the refresh thread

@@ -341,6 +341,37 @@ RETRY_DENIED_M = Measure(
     "Front-door retries denied because the retry budget bucket was "
     "empty (the request fails over to the explicit 502 path instead)",
 )
+# ---- engine observability plane (ISSUE 13) ----------------------------------
+# Route-explainability counter fed per BATCH decision by the driver's
+# route ledger (obs/routeledger.py); compile/device telemetry gauges fed
+# by obs/compilestats.py from the aot/async/xla compile paths and the
+# driver's device-placement chokepoints.
+ROUTE_DECISIONS_M = Measure(
+    "route_decisions",
+    "Evaluation routing decisions by chosen tier (device, np, interp) "
+    "and deciding reason (latency, load_aware, saturated, brownout_pin, "
+    "breaker_open, compile_pending, device_failed, forced_device, "
+    "uncalibrated_prior) — one per evaluated batch, never per review",
+)
+COMPILE_LAG_M = Measure(
+    "compile_epoch_lag",
+    "Constraint-side mutation epochs the async background compiler is "
+    "behind the live epoch (0 = the compiled executable is current; the "
+    "backlog the audit wait loop otherwise infers blind)",
+)
+DEVICE_BYTES_M = Measure(
+    "device_bytes",
+    "Device-resident bytes by component: the packed [C,R] audit arrays "
+    "(audit_pack / audit_pack_mesh with per-shard slab share) and the "
+    "replicated constraint side, recorded at each placement",
+    unit="By",
+)
+XLA_COUNTERS_M = Measure(
+    "xlacache_counters_available",
+    "1 when jax's persistent-compilation-cache monitoring events exist "
+    "on this build (cache_requests_total{cache=xlacache} is live), 0 "
+    "when they are absent and that instrumentation is silently missing",
+)
 PROFILER_SAMPLES_M = Measure(
     "profiler_samples",
     "Thread-stack samples collected by the always-on sampling profiler "
@@ -430,7 +461,7 @@ def catalog_views():
         View("tpu_pack_seconds", PACK_M, AGG_DISTRIBUTION,
              tag_keys=("path",), buckets=_STAGE_BUCKETS),
         View("tpu_compile_seconds", COMPILE_M, AGG_DISTRIBUTION,
-             buckets=_STAGE_BUCKETS),
+             tag_keys=("path",), buckets=_STAGE_BUCKETS),
         View("tpu_dispatch_seconds", DISPATCH_M, AGG_DISTRIBUTION,
              tag_keys=("path", "tier"), buckets=_STAGE_BUCKETS),
         View("cache_requests_total", CACHE_M, AGG_COUNT,
@@ -498,6 +529,13 @@ def catalog_views():
         View("brownout_level", BROWNOUT_M, AGG_LAST_VALUE),
         View("frontdoor_retry_tokens", RETRY_TOKENS_M, AGG_LAST_VALUE),
         View("frontdoor_retries_denied_total", RETRY_DENIED_M, AGG_COUNT),
+        View("route_decisions_total", ROUTE_DECISIONS_M, AGG_COUNT,
+             tag_keys=("tier", "reason")),
+        View("compile_epoch_lag", COMPILE_LAG_M, AGG_LAST_VALUE),
+        View("device_bytes", DEVICE_BYTES_M, AGG_LAST_VALUE,
+             tag_keys=("component",)),
+        View("xlacache_counters_available", XLA_COUNTERS_M,
+             AGG_LAST_VALUE),
     ]
 
 
@@ -743,6 +781,12 @@ def record_snapshot_outcome(outcome: str):
         _global().record(SNAPSHOT_RESTORE_M, 1.0, {"outcome": outcome})
     except Exception:  # telemetry never blocks eval
         record_dropped("record_snapshot_outcome")
+    try:
+        from ..obs import flightrec
+
+        flightrec.record(flightrec.SNAPSHOT_RESTORE, outcome=outcome)
+    except Exception:  # the recorder must never fail a restore
+        record_dropped("record_snapshot_outcome.flightrec")
 
 
 def record_render_cells(counts: Dict[str, int]):
@@ -930,6 +974,12 @@ def record_shed(reason: str, n: int = 1):
         note_shed(n)
     except Exception:  # the ladder signal must never fail the refusal
         record_dropped("record_shed.brownout")
+    try:
+        from ..obs import flightrec
+
+        flightrec.note_shed(reason, n)  # coalesced into burst events
+    except Exception:  # the recorder must never fail the refusal
+        record_dropped("record_shed.flightrec")
 
 
 def record_brownout_level(level: int):
@@ -955,6 +1005,45 @@ def record_retry_denied():
         _global().record(RETRY_DENIED_M, 1.0)
     except Exception:  # telemetry never blocks the wire path
         record_dropped("record_retry_denied")
+
+
+def record_route_decision(tier: str, reason: str):
+    """One routing decision (route_decisions_total{tier,reason}; fed per
+    batch by obs/routeledger.py).  Guarded like record_stage."""
+    try:
+        _global().record(
+            ROUTE_DECISIONS_M, 1.0, {"tier": tier, "reason": reason}
+        )
+    except Exception:  # telemetry never blocks eval
+        record_dropped("record_route_decision")
+
+
+def record_compile_lag(lag: int):
+    """The async compiler's epoch backlog (compile_epoch_lag gauge)."""
+    try:
+        _global().record(COMPILE_LAG_M, float(lag))
+    except Exception:  # telemetry never blocks a mutation
+        record_dropped("record_compile_lag")
+
+
+def record_device_bytes(component: str, nbytes: int):
+    """Device-resident bytes for one placement component
+    (device_bytes{component} gauge, fed by obs/compilestats.py)."""
+    try:
+        _global().record(
+            DEVICE_BYTES_M, float(nbytes), {"component": component}
+        )
+    except Exception:  # telemetry never blocks a placement
+        record_dropped("record_device_bytes")
+
+
+def record_xla_counters_available(ok: bool):
+    """Whether jax's persistent-cache monitoring counters exist on this
+    build (the xlacache silent-absence contract, ops/xlacache.py)."""
+    try:
+        _global().record(XLA_COUNTERS_M, 1.0 if ok else 0.0)
+    except Exception:  # telemetry never blocks cache setup
+        record_dropped("record_xla_counters_available")
 
 
 def record_cache(cache: str, hit: bool, n: int = 1):

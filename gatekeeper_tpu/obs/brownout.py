@@ -242,6 +242,19 @@ class BrownoutController:
                 direction="up" if new > old else "down",
                 **self.last_signals,
             )
+            # flight recorder: ladder edges are incident chronology — a
+            # post-mortem reads them interleaved with breaker/shed/route
+            # events from ONE artifact (obs/flightrec.py)
+            try:
+                from . import flightrec
+
+                flightrec.record(
+                    flightrec.BROWNOUT_STEP, old=old, new=new,
+                    level_name=LEVELS[new], **self.last_signals,
+                )
+            except Exception:  # the recorder must never break the ladder
+                log.debug("brownout flightrec record failed",
+                          exc_info=True)
             for cb in list(self._on_change):
                 try:
                     cb(old, new)

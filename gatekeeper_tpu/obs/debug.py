@@ -11,6 +11,15 @@ Endpoints (docs/tracing.md):
   /debug/costs?top=              per-template cost attribution (obs/costs.py)
   /debug/slo                     SLO burn-rate status (obs/slo.py)
   /debug/profilez?reset=         collapsed-stack CPU profile (obs/profiler.py)
+  /debug/routez?limit=           route-decision ledger: recent pricing
+                                 decisions, live calibration, per-shape
+                                 tier-win table (obs/routeledger.py)
+  /debug/compilez?limit=         compile/device telemetry: provenance
+                                 mix, epoch lag, device memory
+                                 (obs/compilestats.py)
+  /debug/flightrecz?limit=&dump= flight-recorder event ring; dump=1 also
+                                 writes the on-disk artifact
+                                 (obs/flightrec.py)
   /debug/fleet-traces?min_ms=    assembled cross-process traces — present
                                  only where a fleet TraceCollector is
                                  installed (obs/fleetobs.py)
@@ -68,6 +77,9 @@ class DebugRouter:
             "/debug/costs": self._costs,
             "/debug/slo": self._slo,
             "/debug/profilez": self._profilez,
+            "/debug/routez": self._routez,
+            "/debug/compilez": self._compilez,
+            "/debug/flightrecz": self._flightrecz,
         }
 
     def endpoints(self) -> List[str]:
@@ -137,6 +149,43 @@ class DebugRouter:
         reset = _num(q, "reset", int, 0)
         body = obsprofiler.get_profiler().collapsed(reset=bool(reset))
         return 200, "text/plain; charset=utf-8", body.encode()
+
+    def _routez(self, q) -> Response:
+        from . import routeledger
+
+        limit = _num(q, "limit", int, None)
+        if limit is not None and limit < 0:
+            raise BadParam("limit must be a non-negative integer")
+        ledger = routeledger.get_active()
+        if ledger is None:
+            # no driver constructed (interp-only deployment): an empty,
+            # well-formed payload — not an error
+            return _json(200, {
+                "decisions": [], "tier_wins": [], "counts": {},
+                "calibration": None, "flips": 0, "enabled": False,
+            })
+        return _json(200, ledger.snapshot(limit=limit))
+
+    def _compilez(self, q) -> Response:
+        from . import compilestats
+
+        limit = _num(q, "limit", int, None)
+        if limit is not None and limit < 0:
+            raise BadParam("limit must be a non-negative integer")
+        return _json(200, compilestats.get_stats().snapshot(limit=limit))
+
+    def _flightrecz(self, q) -> Response:
+        from . import flightrec
+
+        limit = _num(q, "limit", int, None)
+        if limit is not None and limit < 0:
+            raise BadParam("limit must be a non-negative integer")
+        do_dump = _num(q, "dump", int, 0)
+        rec = flightrec.get_recorder()
+        payload = {"events": rec.events(limit=limit)}
+        if do_dump:
+            payload["dumped_to"] = rec.dump("debug_endpoint")
+        return _json(200, payload)
 
 
 _ROUTER = DebugRouter()

@@ -200,6 +200,7 @@ class SLOEngine:
                 self.record(name, False)  # a failing probe is a bad sample
         objectives = {}
         newly = []
+        cleared = []
         with self._lock:
             objs = list(self._objectives.values())
         for obj in objs:
@@ -222,6 +223,7 @@ class SLOEngine:
                         newly.append(key)
                     elif not firing and was:
                         self._alerts_active.discard(key)
+                        cleared.append(key)
             with self._lock:
                 good6, bad6 = self._counts(obj.name, WINDOWS["6h"])
             total6 = good6 + bad6
@@ -251,6 +253,30 @@ class SLOEngine:
         rid = replica_id()
         if rid:
             out["replica_id"] = rid
+        # flight recorder (obs/flightrec.py): burn-alert EDGES are
+        # incident chronology; an activation is a page, so it also dumps
+        # the ring — the artifact then spans cause (shed bursts, breaker
+        # trips) and effect (the page) in one causal order
+        if newly or cleared:
+            try:
+                from . import flightrec
+
+                for name, pair in newly:
+                    flightrec.record(
+                        flightrec.SLO_ALERT, objective=name, pair=pair,
+                        edge="activated",
+                        burn_rates=objectives.get(name, {}).get(
+                            "burn_rates"),
+                    )
+                for name, pair in cleared:
+                    flightrec.record(
+                        flightrec.SLO_ALERT, objective=name, pair=pair,
+                        edge="cleared",
+                    )
+                if newly:
+                    flightrec.dump("slo_page")
+            except Exception:  # the recorder must never break evaluation
+                _record_dropped("slo.flightrec")
         for key in newly:
             for cb in list(self._on_alert):
                 try:

@@ -71,13 +71,32 @@ def _record_cache(cache: str, hit: bool):
         log.debug("cache metric recording failed", exc_info=True)
 
 
-def _record_compile(seconds: float):
+def _record_compile(seconds: float, path: str):
     try:
         from ..metrics.catalog import COMPILE_M, record_stage
 
-        record_stage(COMPILE_M, seconds)
+        record_stage(COMPILE_M, seconds, {"path": path})
     except Exception:  # pragma: no cover
         log.debug("compile metric recording failed", exc_info=True)
+
+
+def _cost_analysis(compiled):
+    """(flops, bytes_accessed) from XLA's cost model, when this jax
+    build exposes it — (None, None) otherwise.  Never raises."""
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if not isinstance(ca, dict):
+            return None, None
+        flops = ca.get("flops")
+        nbytes = ca.get("bytes accessed")
+        return (
+            float(flops) if flops is not None else None,
+            float(nbytes) if nbytes is not None else None,
+        )
+    except Exception:
+        return None, None
 
 
 def enable(cache_dir: str, read_mostly: Optional[bool] = None) -> bool:
@@ -262,20 +281,30 @@ class aot_jit:
             bad = key in self._bad
             validated = key in self._validated
         if compiled is None and not bad:
+            import time as _time
+
+            from ..obs import compilestats
+
+            t_load = _time.perf_counter()
             compiled = load(key)
             if compiled is not None:
                 log.info("aot cache hit: %s", key)
                 _record_cache("aotcache", True)
+                # provenance telemetry: an AOT deserialize is the cheap
+                # restart path — /debug/compilez attributes cold start
+                # between it, persistent-cache compiles and cold compiles
+                compilestats.record_compile(
+                    self._tag, _time.perf_counter() - t_load, "aot",
+                )
             else:
                 _record_cache("aotcache", False)
                 # one trace+compile for this layout (the .compile()
                 # consults jax's persistent XLA cache when enabled), then
                 # persist the executable so the NEXT process skips the
                 # trace too
-                import time as _time
-
                 from ..obs import trace as obstrace
 
+                xla_hits0 = compilestats.get_stats().xla_counters()[0]
                 t0 = _time.perf_counter()
                 compiled = self._jitted.lower(*args).compile()
                 t1 = _time.perf_counter()
@@ -283,7 +312,24 @@ class aot_jit:
                     "xla.compile", t0, t1, stage=obstrace.COMPILE,
                     tag=self._tag,
                 )
-                _record_compile(t1 - t0)
+                _record_compile(t1 - t0, self._tag)
+                # cold vs persistent-cache-warm: jax's monitoring counters
+                # tick during .compile() when the persistent cache
+                # answered; without the counters the split is unknowable
+                # (ops/xlacache.py exports that absence explicitly)
+                stats = compilestats.get_stats()
+                if stats.xla_counters_available:
+                    prov = (
+                        "persistent"
+                        if stats.xla_counters()[0] > xla_hits0 else "cold"
+                    )
+                else:
+                    prov = "unknown"
+                flops, nbytes = _cost_analysis(compiled)
+                compilestats.record_compile(
+                    self._tag, t1 - t0, prov,
+                    flops=flops, bytes_accessed=nbytes,
+                )
                 save(key, compiled)
                 with self._mu:
                     self._validated.add(key)  # it just compiled here

@@ -156,8 +156,17 @@ class AsyncCompiler:
                         self._ready_epoch = epoch
                         self._cond.notify_all()
 
+    def epoch_lag(self) -> int:
+        """Mutation epochs the compiled executable is behind the live
+        constraint side (0 = current) — the compile_epoch_lag gauge's
+        source (obs/compilestats.py)."""
+        return max(self._driver._cs_epoch - self._ready_epoch, 0)
+
     def _compile_epoch(self, epoch: int):
+        import time as _time
+
         d = self._driver
+        t_start = _time.perf_counter()
         # host-side build under the driver lock (ms): constraint-side pack +
         # probe review pack + column extraction.  The produced arrays are
         # fresh locals (packing always allocates), safe to use un-locked.
@@ -191,3 +200,15 @@ class AsyncCompiler:
             if d._cs_epoch == epoch:
                 self._ready_epoch = epoch
                 self._cond.notify_all()
+        # per-epoch compile telemetry (obs/compilestats.py): the whole
+        # warm dispatch's wall time (pack + trace + XLA compile + first
+        # dispatch) attributed to this epoch, plus the backlog AFTER it
+        # landed — per-executable cold/warm classification is recorded
+        # separately by aot_jit inside the dispatch
+        from ..metrics.catalog import COMPILE_M, record_stage
+        from ..obs import compilestats
+
+        epoch_s = _time.perf_counter() - t_start
+        compilestats.record_compile("epoch", epoch_s, "async", epoch=epoch)
+        record_stage(COMPILE_M, epoch_s, {"path": "epoch"})
+        compilestats.record_epoch_lag(self.epoch_lag())

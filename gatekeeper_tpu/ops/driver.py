@@ -340,6 +340,18 @@ class TpuDriver(InterpDriver):
         # cheapest SUSTAINABLE (max-throughput) tier regardless of
         # per-batch latency or hint freshness — drain the queue first
         self._brownout_pin = False
+        # route-decision ledger (obs/routeledger.py): every batch's
+        # pricing decision — shape, offered λ, the priced tier table,
+        # chosen tier, overriding reason — bounded, serving
+        # /debug/routez and route_decisions_total{tier,reason}.
+        # GK_ROUTE_LEDGER=0 disables recording (bench overhead arm).
+        from ..obs.routeledger import RouteLedger, set_active
+
+        self.route_ledger = RouteLedger().attach(self)
+        self.route_ledger.enabled = (
+            os.environ.get("GK_ROUTE_LEDGER", "1") != "0"
+        )
+        set_active(self.route_ledger)
         # incremental host-serving constraint side (ops/npside.py):
         # admission-sized batches evaluate the same VExpr IR in numpy —
         # no dispatch RTT, no compile, O(1) maintenance per mutation.
@@ -403,6 +415,11 @@ class TpuDriver(InterpDriver):
     def _epoch_bumped(self):
         if self._compiler is not None:
             self._compiler.kick()
+            # the async-compile backlog, observable: mutation epoch vs
+            # compiled epoch (obs/compilestats.py; compile_epoch_lag)
+            from ..obs import compilestats
+
+            compilestats.record_epoch_lag(self._compiler.epoch_lag())
 
     # ---- circuit breaker ---------------------------------------------------
 
@@ -442,6 +459,23 @@ class TpuDriver(InterpDriver):
                 " (serving from the interpreter tier)"
                 if new != "closed" else "",
             )
+            # flight recorder (obs/flightrec.py): the trip/recovery edge
+            # lands in the incident ring, and an OPEN edge dumps the ring
+            # to disk — the one artifact a post-mortem starts from.
+            # Guarded: this runs INSIDE the device-failure handling path,
+            # where a recorder defect must degrade, never crash a request
+            try:
+                from ..obs import flightrec
+
+                flightrec.record(
+                    flightrec.BREAKER_TRANSITION, old=old, new=new,
+                    trips=self.breaker.trips,
+                )
+                if new == "open":
+                    flightrec.dump("breaker_open")
+            except Exception:
+                log.debug("flight-recorder feed failed on breaker edge",
+                          exc_info=True)
         try:
             from ..metrics.catalog import record_breaker
 
@@ -995,6 +1029,15 @@ class TpuDriver(InterpDriver):
             width, new,
             " (single-device path)" if new == 1 else "",
         )
+        try:  # guarded: degradation must proceed even recorder-less
+            from ..obs import flightrec
+
+            flightrec.record(
+                flightrec.MESH_DEGRADE, from_width=width, to_width=new,
+            )
+        except Exception:
+            log.debug("flight-recorder feed failed on mesh degrade",
+                      exc_info=True)
         return new
 
     def _dispatch(self, fn, rv_arrays, cp_arrays, cols, group_params, rows,
@@ -1053,6 +1096,16 @@ class TpuDriver(InterpDriver):
             from ..parallel.mesh import replicate_tree
 
             placed = replicate_tree(mesh, (cp_arrays, group_params))
+        # device-memory accounting (obs/compilestats.py): the replicated
+        # constraint side's footprint, refreshed per placement (cache
+        # misses only — epoch/vocab churn, not the hot path)
+        from ..obs import compilestats
+
+        compilestats.record_device_bytes(
+            "constraint_side",
+            compilestats.tree_nbytes((cp_arrays, group_params)),
+            replicas=1 if mesh is None else int(mesh.devices.size),
+        )
         # never cache under a key the live epoch has moved past: a later
         # eval with an unchanged vocab would hit misaligned mask rows
         if cs_key[0] == self._cs_epoch:
@@ -1834,6 +1887,91 @@ class TpuDriver(InterpDriver):
     # max_batch default): tier capacity is measured at this batch size
     ROUTE_MAX_BATCH = 256.0
 
+    def _route_decision(self, cells: int, n_reviews: int = 1,
+                        want_priced: bool = True):
+        """The pricing behind :meth:`_route_eval` -> (route, reason,
+        lam, priced): the chosen tier, the reason that decided it
+        (obs/routeledger.py REASONS), the offered-load hint consulted,
+        and the priced tier table [{tier, floor_ms, per_review_ms,
+        predicted_ms, mu_rps}] — what `/debug/routez` explains a
+        decision with.  Pure: recording is the caller's job, so the
+        breaker/compile overrides in _review_batch_eval can amend the
+        effective tier before one ledger entry lands.
+
+        want_priced=False (a disabled ledger) skips the table build, and
+        the service models/mu are computed lazily — the calibrated
+        latency fast path then pays exactly what it did pre-ledger."""
+        if self.DEVICE_MIN_CELLS == 0:
+            return "device", "forced_device", None, []
+        cal = self._route_cal
+        np_on = self.np_serve_enabled
+        if cal is None:
+            if cells >= self.DEVICE_MIN_CELLS:
+                return "device", "uncalibrated_prior", None, []
+            route = (
+                "np" if np_on and cells >= self.NP_MIN_CELLS else "interp"
+            )
+            return route, "uncalibrated_prior", None, []
+        device_ms = cal["rtt_ms"] + cells / cal["device_cells_per_ms"]
+        interp_ms = cells / cal["interp_cells_per_ms"]
+        costs = [(interp_ms, "interp"), (device_ms, "device")]
+        if np_on and "np_floor_ms" in cal:
+            costs.append(
+                (cal["np_floor_ms"] + cells / cal["np_cells_per_ms"], "np")
+            )
+        per_review = max(cells // max(n_reviews, 1), 1)
+        B = self.ROUTE_MAX_BATCH
+        state: dict = {}
+
+        def tier_mu():
+            if "mu" not in state:
+                state["models"] = self._tier_models(per_review)
+                state["mu"] = {
+                    tier: B / max(floor + B * per_ms, 1e-9)
+                    for tier, floor, per_ms in state["models"]
+                }
+            return state["mu"]
+
+        def priced():
+            if not want_priced:
+                return []
+            mu = tier_mu()
+            predicted = {tier: ms for ms, tier in costs}
+            return [
+                {
+                    "tier": tier,
+                    "floor_ms": round(floor, 4),
+                    "per_review_ms": round(per_ms, 6),
+                    "predicted_ms": round(predicted.get(tier, 0.0), 4),
+                    # mu is per-ms; export reviews/s for readability
+                    "mu_rps": round(mu[tier] * 1e3, 1),
+                }
+                for tier, floor, per_ms in state["models"]
+            ]
+
+        if self._brownout_pin:
+            # brownout pin: the max-throughput tier at the coalesced
+            # batch size, unconditionally — the queue drains fastest
+            # there, which is the only latency that matters mid-brownout
+            mu = tier_mu()
+            if mu:
+                chosen = max(mu.items(), key=lambda kv: kv[1])[0]
+                return chosen, "brownout_pin", self._load_hint(), priced()
+        lam = self._load_hint()
+        if lam:
+            mu = tier_mu()
+            lam_pms = lam / 1e3  # reviews per ms
+            sustainable = [
+                (ms, tier) for ms, tier in costs
+                if mu.get(tier, 0.0) >= lam_pms * self.LOAD_HEADROOM
+            ]
+            if sustainable:
+                return min(sustainable)[1], "load_aware", lam, priced()
+            if mu:  # saturated everywhere: drain via max throughput
+                chosen = max(mu.items(), key=lambda kv: kv[1])[0]
+                return chosen, "saturated", lam, priced()
+        return min(costs)[1], "latency", lam, priced()
+
     def _route_eval(self, cells: int, n_reviews: int = 1) -> str:
         """Predicted-cheapest path for a request of `cells` =
         reviews x constraints: "device" | "np" | "interp".
@@ -1847,52 +1985,17 @@ class TpuDriver(InterpDriver):
         tiers that cannot carry the offered rate (with headroom) are
         excluded even when they'd win this batch's latency, and when no
         tier sustains it the highest-throughput tier is chosen so the
-        queue drains fastest."""
-        if self.DEVICE_MIN_CELLS == 0:
-            return "device"
-        cal = self._route_cal
-        np_on = self.np_serve_enabled
-        if cal is None:
-            if cells >= self.DEVICE_MIN_CELLS:
-                return "device"
-            return "np" if np_on and cells >= self.NP_MIN_CELLS else "interp"
-        device_ms = cal["rtt_ms"] + cells / cal["device_cells_per_ms"]
-        interp_ms = cells / cal["interp_cells_per_ms"]
-        costs = [(interp_ms, "interp"), (device_ms, "device")]
-        if np_on and "np_floor_ms" in cal:
-            costs.append(
-                (cal["np_floor_ms"] + cells / cal["np_cells_per_ms"], "np")
-            )
-        if self._brownout_pin:
-            # brownout pin: the max-throughput tier at the coalesced
-            # batch size, unconditionally — the queue drains fastest
-            # there, which is the only latency that matters mid-brownout
-            per_review = max(cells // max(n_reviews, 1), 1)
-            B = self.ROUTE_MAX_BATCH
-            mu = {
-                tier: B / max(floor + B * per_ms, 1e-9)
-                for tier, floor, per_ms in self._tier_models(per_review)
-            }
-            if mu:
-                return max(mu.items(), key=lambda kv: kv[1])[0]
-        lam = self._load_hint()
-        if lam:
-            per_review = max(cells // max(n_reviews, 1), 1)
-            lam_pms = lam / 1e3  # reviews per ms
-            B = self.ROUTE_MAX_BATCH
-            mu = {
-                tier: B / max(floor + B * per_ms, 1e-9)
-                for tier, floor, per_ms in self._tier_models(per_review)
-            }
-            sustainable = [
-                (ms, tier) for ms, tier in costs
-                if mu.get(tier, 0.0) >= lam_pms * self.LOAD_HEADROOM
-            ]
-            if sustainable:
-                return min(sustainable)[1]
-            if mu:  # saturated everywhere: drain via max throughput
-                return max(mu.items(), key=lambda kv: kv[1])[0]
-        return min(costs)[1]
+        queue drains fastest.
+
+        Every decision lands in the route ledger (obs/routeledger.py —
+        /debug/routez, route_decisions_total)."""
+        route, reason, lam, priced = self._route_decision(
+            cells, n_reviews, want_priced=self.route_ledger.enabled
+        )
+        self.route_ledger.record(
+            route, reason, cells, n_reviews, lam, priced
+        )
+        return route
 
 
     # batches up to this size are admission traffic: they probe and feed
@@ -1972,35 +2075,57 @@ class TpuDriver(InterpDriver):
         """Route and evaluate (no memo probe: review_batch already served
         the hits)."""
         n_constraints = self._n_constraints_total()
-        route = self._route_eval(
-            len(reviews) * max(n_constraints, 1), n_reviews=len(reviews)
+        cells = len(reviews) * max(n_constraints, 1)
+        route, reason, lam, priced = self._route_decision(
+            cells, n_reviews=len(reviews),
+            want_priced=self.route_ledger.enabled,
         )
-        if route != "device" or (
-            # async ingestion: while the background XLA compile for the
-            # latest template/constraint epoch is in flight, admission
-            # reviews serve from the host paths instead of blocking
-            self._compiler is not None
-            and not self._compiler.ready()
-        ) or (
-            # circuit breaker: while open, every evaluation serves from
-            # the host tiers below — the degradation ladder's middle rung
-            # (docs/failure-modes.md); the background probe brings the
-            # device back without real traffic paying failed dispatches.
-            # Checked LAST so a granted half-open trial is always followed
-            # by the device attempt below (which records its outcome) —
-            # an earlier divert would leak the trial token
-            not self.breaker.allow()
-        ):
+        effective = route
+        if route == "device":
+            if self._compiler is not None and not self._compiler.ready():
+                # async ingestion: while the background XLA compile for
+                # the latest template/constraint epoch is in flight,
+                # admission reviews serve from the host paths instead of
+                # blocking
+                effective = "np" if self.np_serve_enabled else "interp"
+                reason = "compile_pending"
+            elif not self.breaker.allow():
+                # circuit breaker: while open, every evaluation serves
+                # from the host tiers below — the degradation ladder's
+                # middle rung (docs/failure-modes.md); the background
+                # probe brings the device back without real traffic
+                # paying failed dispatches.  Checked LAST (and only for a
+                # device route) so a granted half-open trial is always
+                # followed by the device attempt below (which records its
+                # outcome) — an earlier divert would leak the trial token
+                effective = "np" if self.np_serve_enabled else "interp"
+                reason = "breaker_open"
+        # one ledger entry per batch, recorded at the SERVE site so the
+        # entry names the tier that actually evaluated — override
+        # reasons (breaker_open/compile_pending) explain why a priced
+        # device win served host-side, and an np-ineligible batch that
+        # falls through to the interpreter is attributed to interp, not
+        # to the tier the pricing predicted (obs/routeledger.py)
+        def _record(tier):
+            self.route_ledger.record(
+                tier, reason, cells, len(reviews), lam, priced
+            )
+
+        if effective != "device":
             if tracing:
+                _record("interp")  # traced runs take the interp walk
                 return [
                     InterpDriver.review(self, r, tracing=True)
                     for r in reviews
                 ]
-            if route != "interp":  # np predicted cheaper, or device busy
+            if effective != "interp":  # np predicted cheaper or diverted
                 out = self._np_review(reviews, memo_reviews)
                 if out is not None:
+                    _record("np")
                     return out
+            _record("interp")
             return self._interp_serve(reviews, memo_reviews)
+        _record("device")
         with self._lock:
             try:
                 ordered, mask, autoreject = self.compute_masks(reviews)
@@ -2053,7 +2178,14 @@ class TpuDriver(InterpDriver):
                             review, out[ri][0], mk[1] if mk else None,
                         )
                 return out
-        # device failed: interpreter-tier fallback, lock released.
+        # device failed: interpreter-tier fallback, lock released.  The
+        # amended, SERVE-SITE ledger entry makes the fallback
+        # attributable — a breaker-trip flight recording shows device ->
+        # device_failed -> breaker_open in causal order — and names the
+        # tier that actually evaluated (np may be ineligible for this
+        # batch).  No entry lands when the deadline check below raises:
+        # nothing served.
+        reason = "device_failed"
         # The budget check covers SAME-THREAD callers (embedders using
         # deadline.budget() around client.review); webhook traffic is
         # bounded upstream — the micro-batcher's event-wait timeout and
@@ -2066,6 +2198,7 @@ class TpuDriver(InterpDriver):
             )
         if tracing:
             # traced runs must still emit their trace lines
+            _record("interp")
             return [
                 InterpDriver.review(self, r, tracing=True) for r in reviews
             ]
@@ -2074,7 +2207,9 @@ class TpuDriver(InterpDriver):
         # when fallback latency matters most
         out = self._np_review(reviews, memo_reviews)
         if out is not None:
+            _record("np")
             return out
+        _record("interp")
         return self._interp_serve(reviews, memo_reviews)
 
     def _interp_serve(self, reviews: List[dict],
@@ -2616,6 +2751,12 @@ class TpuDriver(InterpDriver):
                 tree = jax.tree_util.tree_map(np.array, tree)
             placed = jax.device_put(tree)
             self._audit_dev = [ap.layout_gen, placed]
+            from ..obs import compilestats
+
+            compilestats.record_device_bytes(
+                "audit_pack", compilestats.tree_nbytes(tree),
+                rows=int(ap.capacity),
+            )
             self._warm_scatter(placed)
             return placed
         if dirty:
@@ -2687,6 +2828,15 @@ class TpuDriver(InterpDriver):
             # the mesh OBJECT rides in the cache: identity-is-liveness (a
             # recycled id() could alias a dead mesh, advisor r5)
             self._audit_dev_mesh = [ap.layout_gen, mesh, (rv_p, cols_p)]
+            from ..obs import compilestats
+
+            width = int(mesh.devices.size)
+            total = compilestats.tree_nbytes(tree)
+            compilestats.record_device_bytes(
+                "audit_pack_mesh", total, shards=width,
+                per_shard_bytes=total // max(width, 1),
+                rows=int(ap.capacity),
+            )
             return rv_p, cols_p
         if dirty:
             rows = np.fromiter(sorted(dirty), np.int32, len(dirty))

@@ -16,16 +16,26 @@ log = logging.getLogger("gatekeeper.xlacache")
 
 _enabled_dir = None
 _listener_installed = False
+_listener_failed = False  # logged-once guard for the absence warning
 
 
 def _install_cache_listener():
     """Best-effort hit/miss counters for jax's persistent compile cache:
     jax emits monitoring events on every cache consult; mirror them into
-    the metrics catalog's cache_requests_total counter.  Silently absent
-    on jax builds without the monitoring events."""
-    global _listener_installed
-    if _listener_installed:
+    the metrics catalog's cache_requests_total counter and the compile
+    telemetry (obs/compilestats.py cold-vs-warm provenance).
+
+    Absence contract (ISSUE 13 satellite, per the PR 10 counted-drops
+    discipline): on jax builds without the monitoring events this
+    instrumentation used to vanish SILENTLY — an operator staring at a
+    missing cache_requests_total{cache="xlacache"} row could not tell
+    "no cache traffic" from "no counters".  Now the absence logs once at
+    warning and exports ``xlacache_counters_available`` 0/1 either way."""
+    global _listener_installed, _listener_failed
+    if _listener_installed or _listener_failed:
         return
+    from ..obs import compilestats
+
     try:
         from jax._src import monitoring
 
@@ -34,13 +44,26 @@ def _install_cache_listener():
         def _on_event(event, **_kw):
             if event == "/jax/compilation_cache/cache_hits":
                 record_cache("xlacache", True)
+                compilestats.get_stats().note_xla_event(True)
             elif event == "/jax/compilation_cache/cache_misses":
                 record_cache("xlacache", False)
+                compilestats.get_stats().note_xla_event(False)
 
         monitoring.register_event_listener(_on_event)
         _listener_installed = True
+        compilestats.get_stats().set_xla_counters_available(True)
     except Exception:
-        log.debug("xla cache hit/miss listener unavailable", exc_info=True)
+        _listener_failed = True
+        # logged ONCE (the guard above keeps re-enables out) and
+        # exported: cache hit/miss telemetry is absent on this build,
+        # and compile provenance degrades to "unknown"
+        log.warning(
+            "jax persistent-cache monitoring events unavailable: "
+            "cache_requests_total{cache=\"xlacache\"} will not be "
+            "recorded and compile provenance degrades to 'unknown' "
+            "(xlacache_counters_available=0)", exc_info=True,
+        )
+        compilestats.get_stats().set_xla_counters_available(False)
 
 
 def enable(cache_dir: str) -> bool:

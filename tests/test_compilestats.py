@@ -1,0 +1,204 @@
+"""Compile/device telemetry (gatekeeper_tpu/obs/compilestats.py + the
+aot/async/xla compile-path feeds): provenance ring + mix, epoch lag,
+device-memory accounting, the xlacache counters-available contract, and
+the /debug/compilez endpoint (ISSUE 13)."""
+
+import json
+
+import pytest
+
+from gatekeeper_tpu.obs import compilestats
+from gatekeeper_tpu.obs.compilestats import CompileStats
+
+
+class TestStatsUnit:
+    def test_record_compile_ring_and_mix(self):
+        st = CompileStats()
+        st.record_compile("fused", 1.5, "cold", flops=2e9,
+                          bytes_accessed=1e6)
+        st.record_compile("fused", 0.002, "aot")
+        st.record_compile("epoch", 2.0, "async", epoch=7)
+        snap = st.snapshot()
+        assert snap["provenance_mix"] == {
+            "epoch|async": 1, "fused|aot": 1, "fused|cold": 1,
+        }
+        assert snap["compile_seconds_total"]["fused"] == pytest.approx(
+            1.502)
+        ev = snap["recent"][0]
+        assert ev["flops"] == 2e9 and ev["bytes_accessed"] == 1e6
+        assert snap["recent"][2]["epoch"] == 7
+
+    def test_ring_bounded_and_limit(self):
+        st = CompileStats(maxlen=16)
+        for i in range(40):
+            st.record_compile("fused", 0.001, "cold", epoch=i)
+        snap = st.snapshot(limit=4)
+        assert len(snap["recent"]) == 4
+        assert snap["recent"][-1]["epoch"] == 39
+        assert snap["provenance_mix"]["fused|cold"] == 40
+        # limit=0 means none, not everything (the [-0:] slice trap)
+        assert st.snapshot(limit=0)["recent"] == []
+
+    def test_epoch_lag_tracks_max(self):
+        st = CompileStats()
+        st.record_epoch_lag(3)
+        st.record_epoch_lag(1)
+        assert st.epoch_lag() == 1
+        snap = st.snapshot()
+        assert snap["compile_epoch_lag"] == 1
+        assert snap["compile_epoch_lag_max"] == 3
+
+    def test_device_bytes_by_component(self):
+        st = CompileStats()
+        st.record_device_bytes("audit_pack", 1024, rows=100)
+        st.record_device_bytes("audit_pack_mesh", 4096, shards=4,
+                               per_shard_bytes=1024)
+        snap = st.snapshot()
+        assert snap["device_bytes"]["audit_pack"]["bytes"] == 1024
+        assert snap["device_bytes"]["audit_pack_mesh"]["shards"] == 4
+
+    def test_xla_counters(self):
+        st = CompileStats()
+        assert st.xla_counters_available is None
+        st.note_xla_event(True)
+        st.note_xla_event(False)
+        st.note_xla_event(True)
+        assert st.xla_counters() == (2, 1)
+
+    def test_disabled_records_nothing(self):
+        st = CompileStats()
+        st.enabled = False
+        st.record_compile("fused", 1.0, "cold")
+        assert st.snapshot()["recent"] == []
+
+
+class TestGauges:
+    def test_lag_and_bytes_and_availability_exported(self):
+        from gatekeeper_tpu.metrics.views import global_registry
+
+        compilestats.record_epoch_lag(2)
+        compilestats.record_device_bytes("constraint_side", 512)
+        st = CompileStats()
+        st.set_xla_counters_available(False)
+        reg = global_registry()
+        assert reg.view_rows("compile_epoch_lag")
+        rows = reg.view_rows("device_bytes")
+        assert any(key == ("constraint_side",) for key in rows)
+        avail = reg.view_rows("xlacache_counters_available")
+        assert list(avail.values())[-1] == 0.0
+
+
+class TestXlaCacheListenerContract:
+    """The ISSUE 13 satellite: counter absence must log ONCE at warning
+    and export xlacache_counters_available, never vanish silently."""
+
+    @pytest.fixture()
+    def reset_listener_state(self):
+        from gatekeeper_tpu.ops import xlacache
+
+        saved = (xlacache._listener_installed, xlacache._listener_failed)
+        xlacache._listener_installed = False
+        xlacache._listener_failed = False
+        yield xlacache
+        xlacache._listener_installed, xlacache._listener_failed = saved
+
+    def test_available_counters_export_one(self, reset_listener_state):
+        xlacache = reset_listener_state
+        xlacache._install_cache_listener()
+        st = compilestats.get_stats()
+        # this container's jax ships the monitoring module, so the
+        # listener installs and availability is affirmative
+        assert xlacache._listener_installed
+        assert st.xla_counters_available is True
+
+    def test_absent_counters_log_once_and_export_zero(
+        self, reset_listener_state, monkeypatch, caplog
+    ):
+        import logging
+
+        xlacache = reset_listener_state
+        from jax._src import monitoring
+
+        def boom(_cb):
+            raise RuntimeError("no monitoring events on this build")
+
+        monkeypatch.setattr(monitoring, "register_event_listener", boom)
+        with caplog.at_level(logging.WARNING, logger="gatekeeper.xlacache"):
+            xlacache._install_cache_listener()
+            xlacache._install_cache_listener()  # second call: no re-log
+        warnings = [r for r in caplog.records
+                    if "monitoring events unavailable" in r.message]
+        assert len(warnings) == 1
+        assert compilestats.get_stats().xla_counters_available is False
+        from gatekeeper_tpu.metrics.views import global_registry
+
+        rows = global_registry().view_rows("xlacache_counters_available")
+        assert list(rows.values())[-1] == 0.0
+        # restore the truthful availability for later tests
+        xlacache._listener_failed = False
+        monkeypatch.undo()
+        xlacache._install_cache_listener()
+
+
+class TestDriverFeeds:
+    def test_epoch_lag_recorded_on_mutation_with_async_compiler(self):
+        from gatekeeper_tpu.client.client import Client
+        from gatekeeper_tpu.ops.driver import TpuDriver
+        from gatekeeper_tpu.util.synthetic import make_templates
+
+        templates, constraints = make_templates(2)
+        c = Client(driver=TpuDriver(async_compile=True))
+        try:
+            c.add_template(templates[0])
+            c.add_constraint(constraints[0])
+            # a mutation just bumped the epoch ahead of the compiler
+            assert c.driver._compiler.epoch_lag() >= 0
+            assert c.driver.wait_ready(timeout=120.0)
+            assert c.driver._compiler.epoch_lag() == 0
+            # the background epoch warm landed in the stats ring
+            mix = compilestats.get_stats().provenance_mix()
+            assert any(k.startswith("epoch|async") for k in mix)
+        finally:
+            c.driver._compiler.stop()
+
+    def test_audit_placement_records_device_bytes(self):
+        from gatekeeper_tpu.client.client import Client
+        from gatekeeper_tpu.ops.driver import TpuDriver
+        from gatekeeper_tpu.util.synthetic import make_pods, make_templates
+
+        templates, constraints = make_templates(2)
+        c = Client(driver=TpuDriver())
+        c.driver.set_mesh(False)  # single-device placement path
+        for t, k in zip(templates, constraints):
+            c.add_template(t)
+            c.add_constraint(k)
+        for p in make_pods(8, seed=3):
+            c.add_data(p)
+        c.driver.audit_capped(5)
+        snap = compilestats.get_stats().snapshot()
+        assert "audit_pack" in snap["device_bytes"]
+        assert snap["device_bytes"]["audit_pack"]["bytes"] > 0
+        assert "constraint_side" in snap["device_bytes"]
+
+
+class TestCompilezEndpoint:
+    def test_compilez_serves_summary(self):
+        from gatekeeper_tpu.obs.debug import get_router
+
+        compilestats.get_stats().record_compile("fused", 0.5, "cold")
+        code, ctype, body = get_router().handle("/debug/compilez",
+                                                "limit=3")
+        assert code == 200 and ctype == "application/json"
+        payload = json.loads(body)
+        for key in ("recent", "provenance_mix", "compile_epoch_lag",
+                    "device_bytes", "xlacache"):
+            assert key in payload
+        assert len(payload["recent"]) <= 3
+
+    @pytest.mark.parametrize("query", ["limit=abc", "limit=-1"])
+    def test_bad_params_are_json_400(self, query):
+        from gatekeeper_tpu.obs.debug import get_router
+
+        code, ctype, body = get_router().handle("/debug/compilez", query)
+        assert code == 400 and ctype == "application/json"
+        assert "must be" in json.loads(body)["error"]

@@ -20,7 +20,8 @@ class TestRouterDirect:
     def test_known_endpoints_listed(self):
         eps = get_router().endpoints()
         for p in ("/debug/traces", "/debug/stacks", "/debug/costs",
-                  "/debug/slo"):
+                  "/debug/slo", "/debug/routez", "/debug/compilez",
+                  "/debug/flightrecz"):
             assert p in eps
 
     @pytest.mark.parametrize("path,query", [
@@ -29,6 +30,12 @@ class TestRouterDirect:
         ("/debug/traces", "min_ms=1&limit=1.5"),  # limit must be an int
         ("/debug/costs", "top=abc"),
         ("/debug/costs", "top=1.5"),
+        # ISSUE 13 endpoints inherit the same hardened-parsing contract
+        ("/debug/routez", "limit=abc"),
+        ("/debug/routez", "limit=1.5"),
+        ("/debug/compilez", "limit=abc"),
+        ("/debug/flightrecz", "limit=abc"),
+        ("/debug/flightrecz", "dump=yes"),
     ])
     def test_non_numeric_params_are_json_400(self, path, query):
         code, ctype, body = handle(path, query)
@@ -75,6 +82,17 @@ class TestRouterDirect:
         finally:
             ledger.clear()
             ledger.enabled = was
+
+    def test_new_endpoints_answer_json_200(self):
+        """The three ISSUE 13 endpoints serve well-formed JSON on both
+        the bare path and with a numeric limit."""
+        for path in ("/debug/routez", "/debug/compilez",
+                     "/debug/flightrecz"):
+            for query in ("", "limit=2"):
+                code, ctype, body = handle(path, query)
+                assert code == 200, (path, query)
+                assert ctype == "application/json"
+                json.loads(body)
 
     def test_slo_payload_shape(self):
         code, _ctype, body = handle("/debug/slo")

@@ -1,0 +1,262 @@
+"""Black-box flight recorder (gatekeeper_tpu/obs/flightrec.py): ring
+bounds and causal ordering, shed-burst coalescing, atomic dumps with
+retention, the event-source feeds (snapshot/shed/brownout/breaker), and
+the /debug/flightrecz endpoint contract (ISSUE 13)."""
+
+import json
+import os
+
+import pytest
+
+from gatekeeper_tpu.obs import flightrec
+from gatekeeper_tpu.obs.flightrec import FlightRecorder
+
+
+@pytest.fixture()
+def clean_singleton():
+    """Isolate tests that drive the module-level recorder (subsystem
+    feeds record into it from anywhere)."""
+    rec = flightrec.get_recorder()
+    rec.clear()
+    yield rec
+    rec.clear()
+    rec.configure(dump_dir="")
+
+
+class TestRing:
+    def test_events_carry_seq_in_causal_order(self):
+        rec = FlightRecorder()
+        rec.record(flightrec.BREAKER_TRANSITION, old="closed", new="open")
+        rec.record(flightrec.MESH_DEGRADE, from_width=4, to_width=2)
+        rec.record(flightrec.BREAKER_TRANSITION, old="open", new="closed")
+        events = rec.events()
+        assert [e["type"] for e in events] == [
+            "breaker_transition", "mesh_degrade", "breaker_transition",
+        ]
+        seqs = [e["seq"] for e in events]
+        assert seqs == sorted(seqs) and len(set(seqs)) == 3
+        for e in events:
+            assert "t" in e and "mono" in e and "replica_id" in e
+
+    def test_ring_is_bounded_keeping_newest(self):
+        rec = FlightRecorder(maxlen=16)
+        for i in range(50):
+            rec.record(flightrec.ROUTE_FLIP, i=i)
+        events = rec.events()
+        assert len(events) == 16
+        assert events[-1]["i"] == 49 and events[0]["i"] == 34
+
+    def test_limit_keeps_newest(self):
+        rec = FlightRecorder()
+        for i in range(5):
+            rec.record(flightrec.ROUTE_FLIP, i=i)
+        got = rec.events(limit=2)
+        assert [e["i"] for e in got] == [3, 4]
+        # limit=0 means none, not everything (the [-0:] slice trap)
+        assert rec.events(limit=0) == []
+
+    def test_recorder_defect_never_raises(self):
+        rec = FlightRecorder()
+        rec._ring = None  # induced defect
+        rec.record(flightrec.ROUTE_FLIP)  # must swallow (counted drop)
+
+
+class TestShedBursts:
+    def test_sheds_coalesce_into_one_burst_event(self):
+        rec = FlightRecorder()
+        for _ in range(7):
+            rec.note_shed("queue_full")
+        rec.note_shed("door_inflight", n=3)
+        events = rec.events()  # flushes pending windows
+        bursts = {e["reason"]: e for e in events
+                  if e["type"] == flightrec.SHED_BURST}
+        assert bursts["queue_full"]["count"] == 7
+        assert bursts["door_inflight"]["count"] == 3
+        assert len(events) == 2  # never one entry per shed
+
+    def test_new_window_emits_new_burst(self, monkeypatch):
+        rec = FlightRecorder()
+        rec.note_shed("queue_full", 2)
+        # age the pending window past SHED_WINDOW_S without sleeping
+        with rec._lock:
+            rec._sheds["queue_full"][1] -= flightrec.SHED_WINDOW_S + 1.0
+        rec.note_shed("queue_full", 5)  # flushes the old window first
+        events = [e for e in rec.events()
+                  if e["type"] == flightrec.SHED_BURST]
+        assert [e["count"] for e in events] == [2, 5]
+
+
+class TestDump:
+    def test_dump_writes_atomic_json_artifact(self, tmp_path):
+        rec = FlightRecorder()
+        rec.configure(dump_dir=str(tmp_path))
+        rec.record(flightrec.BREAKER_TRANSITION, old="closed", new="open")
+        rec.note_shed("queue_full", 4)
+        path = rec.dump("unit_test")
+        assert path and os.path.exists(path)
+        with open(path) as f:
+            payload = json.load(f)
+        assert payload["reason"] == "unit_test"
+        assert payload["event_count"] == len(payload["events"]) == 2
+        types = {e["type"] for e in payload["events"]}
+        assert types == {"breaker_transition", "shed_burst"}
+        assert not [n for n in os.listdir(tmp_path) if ".tmp." in n]
+        assert rec.dumps == 1 and rec.last_dump_path == path
+
+    def test_dump_without_dir_is_noop(self):
+        rec = FlightRecorder()
+        rec.record(flightrec.MESH_DEGRADE, from_width=2, to_width=1)
+        assert rec.dump("nowhere") is None
+
+    def test_retention_keeps_newest_dumps(self, tmp_path):
+        rec = FlightRecorder()
+        rec.configure(dump_dir=str(tmp_path), retain=3)
+        rec.record(flightrec.ROUTE_FLIP)
+        for _ in range(6):
+            rec.dump("retention")
+        files = [n for n in os.listdir(tmp_path)
+                 if n.startswith("flightrec-")]
+        assert len(files) == 3
+        # the newest dump survives
+        assert os.path.basename(rec.last_dump_path) in files
+
+
+class TestExitHook:
+    def test_atexit_dump_on_process_death(self, tmp_path):
+        """A dying process with a configured dir leaves one artifact
+        behind (the atexit half of the death hook)."""
+        import subprocess
+        import sys
+
+        code = (
+            "from gatekeeper_tpu.obs import flightrec\n"
+            f"rec = flightrec.get_recorder().configure(dump_dir={str(tmp_path)!r})\n"
+            "rec.install_exit_hook()\n"
+            "rec.record(flightrec.BREAKER_TRANSITION, old='closed',"
+            " new='open')\n"
+        )
+        subprocess.run(
+            [sys.executable, "-c", code], check=True, timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        dumps = [n for n in os.listdir(tmp_path)
+                 if "process_exit" in n and n.endswith(".json")]
+        assert len(dumps) == 1
+        with open(tmp_path / dumps[0]) as f:
+            payload = json.load(f)
+        assert payload["reason"] == "process_exit"
+        assert payload["events"][0]["type"] == "breaker_transition"
+
+    def test_clean_exit_with_no_events_dumps_nothing(self, tmp_path):
+        import subprocess
+        import sys
+
+        code = (
+            "from gatekeeper_tpu.obs import flightrec\n"
+            f"rec = flightrec.get_recorder().configure(dump_dir={str(tmp_path)!r})\n"
+            "rec.install_exit_hook()\n"
+        )
+        subprocess.run(
+            [sys.executable, "-c", code], check=True, timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert not list(tmp_path.iterdir())
+
+
+class TestEventSources:
+    def test_snapshot_outcome_feeds_recorder(self, clean_singleton):
+        from gatekeeper_tpu.metrics.catalog import record_snapshot_outcome
+
+        record_snapshot_outcome("fallback")
+        events = clean_singleton.events()
+        assert any(
+            e["type"] == flightrec.SNAPSHOT_RESTORE
+            and e["outcome"] == "fallback"
+            for e in events
+        )
+
+    def test_record_shed_feeds_recorder(self, clean_singleton):
+        from gatekeeper_tpu.metrics.catalog import record_shed
+
+        record_shed("deadline_expired", 5)
+        events = clean_singleton.events()
+        bursts = [e for e in events if e["type"] == flightrec.SHED_BURST]
+        assert bursts and bursts[0]["count"] == 5
+        assert bursts[0]["reason"] == "deadline_expired"
+
+    def test_brownout_step_feeds_recorder(self, clean_singleton):
+        from gatekeeper_tpu.obs.brownout import BrownoutController
+
+        t = [1000.0]
+        ctl = BrownoutController(clock=lambda: t[0])
+        ctl.set_providers(queue_frac=lambda: 1.0)
+        ctl.tick()
+        t[0] += ctl.UP_AFTER_S + 0.1
+        ctl.tick()
+        assert ctl.level == 1
+        events = clean_singleton.events()
+        steps = [e for e in events if e["type"] == flightrec.BROWNOUT_STEP]
+        assert steps and steps[-1]["new"] == 1 and steps[-1]["old"] == 0
+
+    def test_slo_alert_edge_feeds_recorder_and_dumps(
+        self, clean_singleton, tmp_path
+    ):
+        from gatekeeper_tpu.obs.slo import SLOEngine
+
+        clean_singleton.configure(dump_dir=str(tmp_path))
+        t = [50_000.0]
+        eng = SLOEngine(clock=lambda: t[0])
+        eng.add_objective("x", 0.999)
+        eng.record("x", False, n=eng.min_alert_events)
+        eng.evaluate()
+        events = clean_singleton.events()
+        alerts = [e for e in events if e["type"] == flightrec.SLO_ALERT]
+        assert alerts and alerts[0]["edge"] == "activated"
+        assert alerts[0]["objective"] == "x"
+        # the activation paged: an automatic dump landed on disk
+        dumps = [n for n in os.listdir(tmp_path)
+                 if "slo_page" in n and n.endswith(".json")]
+        assert dumps
+        # the clear edge records too (events age out of every window);
+        # both SRE pairs (fast, slow) fire, so each edge appears per pair
+        t[0] += 22_000.0
+        eng.evaluate()
+        edges = [e["edge"] for e in clean_singleton.events()
+                 if e["type"] == flightrec.SLO_ALERT]
+        assert "cleared" in edges
+        assert edges.index("cleared") > edges.index("activated")
+
+
+class TestDebugEndpoint:
+    def test_flightrecz_serves_ring(self, clean_singleton):
+        from gatekeeper_tpu.obs.debug import get_router
+
+        clean_singleton.record(flightrec.MESH_DEGRADE,
+                               from_width=8, to_width=4)
+        code, ctype, body = get_router().handle("/debug/flightrecz",
+                                                "limit=10")
+        assert code == 200 and ctype == "application/json"
+        payload = json.loads(body)
+        assert payload["events"][-1]["type"] == "mesh_degrade"
+        assert "dumped_to" not in payload
+
+    def test_flightrecz_dump_param(self, clean_singleton, tmp_path):
+        from gatekeeper_tpu.obs.debug import get_router
+
+        clean_singleton.configure(dump_dir=str(tmp_path))
+        clean_singleton.record(flightrec.ROUTE_FLIP, from_tier="np",
+                               to_tier="device")
+        code, _ctype, body = get_router().handle("/debug/flightrecz",
+                                                 "dump=1")
+        payload = json.loads(body)
+        assert code == 200
+        assert payload["dumped_to"] and os.path.exists(
+            payload["dumped_to"])
+
+    @pytest.mark.parametrize("query", ["limit=abc", "dump=x", "limit=-1"])
+    def test_bad_params_are_json_400(self, query):
+        from gatekeeper_tpu.obs.debug import get_router
+
+        code, ctype, body = get_router().handle("/debug/flightrecz", query)
+        assert code == 400 and ctype == "application/json"
+        assert "must be" in json.loads(body)["error"]

@@ -1,0 +1,237 @@
+"""Route-decision ledger (gatekeeper_tpu/obs/routeledger.py + the
+driver's _route_eval/_review_batch_eval recording): decision entries
+with priced tables and reasons, override reasons for breaker/compile
+diverts, the per-shape tier-win table, bounded shapes, route flips into
+the flight recorder, and the /debug/routez endpoint (ISSUE 13)."""
+
+import json
+
+import pytest
+
+from gatekeeper_tpu.client.client import Client
+from gatekeeper_tpu.obs import flightrec, routeledger
+from gatekeeper_tpu.obs.routeledger import RouteLedger
+from gatekeeper_tpu.ops.driver import TpuDriver
+
+
+def make_client(n=3):
+    from gatekeeper_tpu.util.synthetic import make_templates
+
+    templates, constraints = make_templates(n)
+    c = Client(driver=TpuDriver())
+    for t, k in zip(templates, constraints):
+        c.add_template(t)
+        c.add_constraint(k)
+    return c
+
+
+def review(i=0):
+    return {
+        "uid": f"u{i}",
+        "kind": {"group": "", "version": "v1", "kind": "Pod"},
+        "name": f"pod-{i}", "namespace": "default",
+        "operation": "CREATE",
+        "object": {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": f"pod-{i}", "namespace": "default",
+                         "labels": {"i": str(i)}},
+            "spec": {"containers": [{"name": "c",
+                                     "image": f"img.io/x:{i}"}]},
+        },
+    }
+
+
+class TestLedgerUnit:
+    def test_record_builds_entry_and_wins(self):
+        led = RouteLedger()
+        led.record("np", "latency", cells=200, n_reviews=2, lam=100.0,
+                   priced=[{"tier": "np", "floor_ms": 1.0,
+                            "per_review_ms": 0.1, "predicted_ms": 1.2,
+                            "mu_rps": 5000.0}])
+        snap = led.snapshot()
+        (entry,) = snap["decisions"]
+        assert entry["tier"] == "np" and entry["reason"] == "latency"
+        assert entry["per_review_cells"] == 100
+        assert entry["lam"] == 100.0
+        assert entry["priced"][0]["tier"] == "np"
+        (row,) = snap["tier_wins"]
+        assert row == {"per_review_cells": 100, "n_reviews": 2,
+                       "cells": 200, "wins": {"np": 1}}
+        assert snap["counts"] == {"np|latency": 1}
+
+    def test_shapes_are_bounded_with_overflow_counted(self):
+        led = RouteLedger()
+        for i in range(routeledger.MAX_SHAPES + 10):
+            led.record("interp", "latency", cells=i + 1, n_reviews=1,
+                       lam=None)
+        snap = led.snapshot()
+        assert len(snap["tier_wins"]) == routeledger.MAX_SHAPES
+        assert snap["tier_wins_overflow"] == 10
+
+    def test_flip_feeds_flight_recorder(self):
+        rec = flightrec.get_recorder()
+        rec.clear()
+        led = RouteLedger()
+        led.record("device", "latency", 100, 1, None)
+        led.record("device", "latency", 100, 1, None)
+        assert led.flips == 0
+        led.record("np", "breaker_open", 100, 1, None)
+        assert led.flips == 1
+        flips = [e for e in rec.events()
+                 if e["type"] == flightrec.ROUTE_FLIP]
+        assert flips and flips[-1]["from_tier"] == "device"
+        assert flips[-1]["to_tier"] == "np"
+        assert flips[-1]["reason"] == "breaker_open"
+        rec.clear()
+
+    def test_limit_zero_returns_no_decisions(self):
+        led = RouteLedger()
+        for i in range(3):
+            led.record("np", "latency", 10 + i, 1, None)
+        assert led.snapshot(limit=0)["decisions"] == []
+        assert len(led.snapshot(limit=2)["decisions"]) == 2
+
+    def test_disabled_ledger_records_nothing(self):
+        led = RouteLedger()
+        led.enabled = False
+        led.record("np", "latency", 10, 1, None)
+        assert led.snapshot()["decisions"] == []
+
+    def test_route_decisions_counter_exported(self):
+        from gatekeeper_tpu.metrics.views import global_registry
+
+        led = RouteLedger()
+        led.record("interp", "uncalibrated_prior", 5, 1, None)
+        rows = global_registry().view_rows("route_decisions_total")
+        assert any(
+            key == ("interp", "uncalibrated_prior") for key in rows
+        )
+
+
+class TestDriverRecording:
+    def test_route_eval_records_with_reason(self):
+        c = make_client()
+        d = c.driver
+        d.route_ledger.clear()
+        route = d._route_eval(10_000)
+        snap = d.route_ledger.snapshot()
+        assert snap["decisions"][-1]["tier"] == route
+        assert snap["decisions"][-1]["reason"] == "uncalibrated_prior"
+
+    def test_calibrated_decision_carries_priced_table(self):
+        c = make_client()
+        d = c.driver
+        d._route_cal = {
+            "rtt_ms": 5.0, "device_cells_per_ms": 100.0,
+            "interp_cells_per_ms": 10.0,
+            "np_floor_ms": 1.0, "np_cells_per_ms": 50.0,
+        }
+        d.route_ledger.clear()
+        d._route_eval(1000, n_reviews=4)
+        entry = d.route_ledger.snapshot()["decisions"][-1]
+        assert entry["reason"] == "latency"
+        tiers = {p["tier"] for p in entry["priced"]}
+        assert tiers == {"interp", "device", "np"}
+        for p in entry["priced"]:
+            assert p["mu_rps"] > 0 and p["predicted_ms"] >= 0
+
+    def test_brownout_pin_reason(self):
+        c = make_client()
+        d = c.driver
+        d._route_cal = {
+            "rtt_ms": 5.0, "device_cells_per_ms": 100.0,
+            "interp_cells_per_ms": 10.0,
+        }
+        d.set_brownout_pin(True)
+        d.route_ledger.clear()
+        d._route_eval(100)
+        assert (d.route_ledger.snapshot()["decisions"][-1]["reason"]
+                == "brownout_pin")
+        d.set_brownout_pin(False)
+
+    def test_breaker_open_override_recorded(self):
+        c = make_client()
+        d = c.driver
+        d.DEVICE_MIN_CELLS = 0  # price says device, always
+        d.route_ledger.clear()
+        d.breaker.trip()
+        try:
+            out = c.review(review(1))
+            assert out is not None  # served host-side
+            entry = d.route_ledger.snapshot()["decisions"][-1]
+            assert entry["reason"] == "breaker_open"
+            assert entry["tier"] in ("np", "interp")
+        finally:
+            d.breaker.record_success()  # close again
+
+    def test_device_failure_records_amended_decision(self):
+        from gatekeeper_tpu import faults
+        from gatekeeper_tpu.faults import FaultRule
+
+        c = make_client()
+        d = c.driver
+        d.DEVICE_MIN_CELLS = 0
+        c.review(review(0))  # warm the device path
+        d.route_ledger.clear()
+        plane = faults.install(seed=3)
+        plane.add(faults.TPU_DISPATCH,
+                  FaultRule(mode=faults.ERROR, probability=1.0, count=1))
+        try:
+            out = c.review(review(2))
+            assert out is not None
+        finally:
+            faults.uninstall()
+        reasons = [e["reason"] for e in
+                   d.route_ledger.snapshot()["decisions"]]
+        assert "device_failed" in reasons
+
+    def test_load_aware_reasons(self):
+        c = make_client()
+        d = c.driver
+        d._route_cal = {
+            "rtt_ms": 5.0, "device_cells_per_ms": 1000.0,
+            "interp_cells_per_ms": 10.0,
+            "np_floor_ms": 1.0, "np_cells_per_ms": 50.0,
+        }
+        d.route_ledger.clear()
+        d.set_offered_load(100.0)  # modest: sustainable tiers exist
+        d._route_eval(300, n_reviews=1)
+        assert (d.route_ledger.snapshot()["decisions"][-1]["reason"]
+                == "load_aware")
+        d.set_offered_load(10_000_000.0)  # nothing sustains this
+        d._route_eval(300, n_reviews=1)
+        assert (d.route_ledger.snapshot()["decisions"][-1]["reason"]
+                == "saturated")
+        d.set_offered_load(None)
+
+
+class TestRoutezEndpoint:
+    def test_routez_serves_active_driver(self):
+        from gatekeeper_tpu.obs.debug import get_router
+
+        c = make_client()
+        d = c.driver
+        d.route_ledger.clear()
+        d._route_eval(77)
+        code, ctype, body = get_router().handle("/debug/routez", "limit=5")
+        assert code == 200 and ctype == "application/json"
+        payload = json.loads(body)
+        assert payload["decisions"][-1]["cells"] == 77
+        assert payload["calibration"] is None
+        # calibration + curves appear once calibrated
+        d._route_cal = {
+            "rtt_ms": 5.0, "device_cells_per_ms": 100.0,
+            "interp_cells_per_ms": 10.0,
+        }
+        payload = json.loads(get_router().handle("/debug/routez")[2])
+        assert payload["calibration"]["rtt_ms"] == 5.0
+        assert "curves_ms_per_review" in payload
+
+    @pytest.mark.parametrize("query", ["limit=abc", "limit=-2",
+                                       "limit=1.5"])
+    def test_bad_params_are_json_400(self, query):
+        from gatekeeper_tpu.obs.debug import get_router
+
+        code, ctype, body = get_router().handle("/debug/routez", query)
+        assert code == 400 and ctype == "application/json"
+        assert "must be" in json.loads(body)["error"]

@@ -482,3 +482,80 @@ class TestAuditTracing:
         disp = [s for s in t["spans"] if s["name"] == "audit.dispatch"][0]
         assert disp["attrs"]["tier"] == "tpu"
         assert disp["attrs"]["shards"] >= 1
+
+
+class TestActiveSpansConcurrency:
+    """The cross-thread active_spans registry (profiler stage tagging,
+    PR 11) under concurrent activate/deactivate churn: snapshots must
+    stay iterable while N request threads mutate the registry, nesting
+    must restore the outer span exactly, and finished threads must leave
+    no entry behind (ISSUE 13 satellite)."""
+
+    N_THREADS = 8
+    ITERS = 300
+
+    def test_churn_vs_snapshot_reader(self):
+        stop = threading.Event()
+        errors = []
+
+        def reader():
+            # the sampler's view: iterate snapshots continuously while
+            # workers churn — a live-dict iteration would RuntimeError
+            while not stop.is_set():
+                try:
+                    for ident, span in obs.active_spans().items():
+                        assert isinstance(ident, int)
+                        assert span.name  # a Span, never a torn entry
+                except Exception as e:  # pragma: no cover - failure path
+                    errors.append(e)
+                    return
+
+        def worker(idx):
+            ident = threading.get_ident()
+            try:
+                for i in range(self.ITERS):
+                    tr = obs.Trace(export=False)
+                    outer = obs.Span(f"outer-{idx}", tr)
+                    state = obs.activate(outer)
+                    assert obs.active_spans()[ident] is outer
+                    # nested context-manager activation (the _SpanCtx /
+                    # _UseCtx path every traced request takes)
+                    with obs.use_span(obs.Span(f"inner-{idx}", tr)) as sp:
+                        assert obs.active_spans()[ident] is sp
+                    # the nested exit restored the OUTER span
+                    assert obs.active_spans()[ident] is outer
+                    obs.deactivate(state)
+                    assert ident not in obs.active_spans()
+            except Exception as e:  # pragma: no cover - failure path
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=worker, args=(i,), daemon=True)
+            for i in range(self.N_THREADS)
+        ]
+        sampler = threading.Thread(target=reader, daemon=True)
+        sampler.start()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60.0)
+            assert not t.is_alive(), "worker wedged"
+        stop.set()
+        sampler.join(timeout=10.0)
+        assert not sampler.is_alive(), "sampler reader wedged"
+        assert errors == []
+        # no finished worker left a registry entry behind
+        live = {t.ident for t in threads}
+        assert not live & set(obs.active_spans())
+
+    def test_deactivate_out_of_order_restores_previous(self):
+        tr = obs.Trace(export=False)
+        ident = threading.get_ident()
+        a, b = obs.Span("a", tr), obs.Span("b", tr)
+        sa = obs.activate(a)
+        sb = obs.activate(b)
+        assert obs.active_spans()[ident] is b
+        obs.deactivate(sb)
+        assert obs.active_spans()[ident] is a
+        obs.deactivate(sa)
+        assert ident not in obs.active_spans()

@@ -33,6 +33,13 @@ tests/test_observability_check.py; also runnable standalone):
    discipline — ONE HELP/TYPE header per family, no exemplars, no
    ``# EOF`` — inject ``replica_id`` into unlabelled remote samples, and
    leave samples that already carry a replica_id untouched.
+8. Flight-recorder conformance (ISSUE 13): every event type in
+   obs/flightrec.py EVENT_TYPES must be documented in
+   docs/observability.md (the incident-chronology table is an operator
+   contract), every documented ``/debug/*`` endpoint the shared router
+   serves must appear there too, and the route ledger's REASONS must
+   each be documented in docs/metrics.md (the route_decisions_total
+   reason taxonomy).
 
 Run: python tools/check_observability.py   (exit 0 clean, 1 with findings)
 """
@@ -55,6 +62,12 @@ HOT_PATH_MODULES = (
     "gatekeeper_tpu/obs/debug.py",
     "gatekeeper_tpu/obs/profiler.py",
     "gatekeeper_tpu/obs/fleetobs.py",
+    "gatekeeper_tpu/obs/flightrec.py",
+    "gatekeeper_tpu/obs/routeledger.py",
+    "gatekeeper_tpu/obs/compilestats.py",
+    "gatekeeper_tpu/obs/brownout.py",
+    "gatekeeper_tpu/ops/xlacache.py",
+    "gatekeeper_tpu/ops/asynccompile.py",
     "gatekeeper_tpu/fleet/frontdoor.py",
     "gatekeeper_tpu/metrics/views.py",
     "gatekeeper_tpu/metrics/exporter.py",
@@ -330,6 +343,47 @@ def check_federated_format() -> list:
     return problems
 
 
+def check_flightrec_conformance() -> list:
+    """The flight recorder's event-type table, the shared router's
+    endpoint surface, and the route ledger's reason taxonomy must all be
+    documented — they are operator contracts (ISSUE 13)."""
+    from gatekeeper_tpu.obs import flightrec, routeledger
+    from gatekeeper_tpu.obs.debug import get_router
+
+    problems = []
+    doc_path = os.path.join(REPO, "docs", "observability.md")
+    try:
+        with open(doc_path) as f:
+            doc = f.read()
+    except OSError as e:
+        return [f"docs/observability.md unreadable: {e}"]
+    for etype in flightrec.EVENT_TYPES:
+        if f"`{etype}`" not in doc:
+            problems.append(
+                f"flight-recorder event type {etype!r} is not documented "
+                "in docs/observability.md (the incident-chronology table)"
+            )
+    for endpoint in get_router().endpoints():
+        if endpoint not in doc:
+            problems.append(
+                f"debug endpoint {endpoint!r} is not mentioned in "
+                "docs/observability.md (the surface map)"
+            )
+    metrics_path = os.path.join(REPO, "docs", "metrics.md")
+    try:
+        with open(metrics_path) as f:
+            mdoc = f.read()
+    except OSError as e:
+        return problems + [f"docs/metrics.md unreadable: {e}"]
+    for reason in routeledger.REASONS:
+        if f"`{reason}`" not in mdoc:
+            problems.append(
+                f"route-decision reason {reason!r} is not documented in "
+                "docs/metrics.md (route_decisions_total taxonomy)"
+            )
+    return problems
+
+
 def run_checks() -> list:
     sys.path.insert(0, REPO)
     return (
@@ -340,6 +394,7 @@ def run_checks() -> list:
         + check_label_cardinality()
         + check_wire_stages()
         + check_federated_format()
+        + check_flightrec_conformance()
     )
 
 
