@@ -1448,6 +1448,205 @@ print(json.dumps({"curve": curve}))
     return out
 
 
+def bench_referential() -> dict:
+    """Referential policies (ISSUE 14): the cross-resource join/aggregate
+    kernel subsystem.  Subprocess on the virtual 8-device CPU mesh:
+
+    - parity: a referential corpus (unique-key / required-reference /
+      count-quota) audited at widths 1 and 4 must be BYTE-identical to
+      the interpreter oracle (verdicts + rendered messages + totals),
+      with GK_JOIN_ASSERT armed and every family served by a join plan
+      (the `join_plan` route-ledger reason present, never interp
+      fallback);
+    - throughput: warm steady-state full join sweep wall time -> rows/s
+      at the full-scale corpus;
+    - delta locality: a CHURN-row batch rides the O(key-group) delta
+      path — dispatch rows == dirty + affected readers — and the
+      delta-vs-full speedup is recorded.
+
+    Recorded as REF_r14.json."""
+    import subprocess
+
+    n_t = int(os.environ.get("BENCH_REF_TEMPLATES", "24"))
+    n_r = int(os.environ.get("BENCH_REF_ROWS", "6000"))
+    p_t = int(os.environ.get("BENCH_REF_PARITY_TEMPLATES", "6"))
+    p_r = int(os.environ.get("BENCH_REF_PARITY_ROWS", "240"))
+    churn = int(os.environ.get("BENCH_REF_CHURN", "20"))
+    code = (
+        f"N_T, N_R, P_T, P_R, CHURN = {n_t}, {n_r}, {p_t}, {p_r}, {churn}\n"
+        + r"""
+import json, sys, time
+sys.path.insert(0, ".")
+from gatekeeper_tpu.ops.driver import TpuDriver
+TpuDriver.DELTA_MASK_WAIT_S = 300.0
+from gatekeeper_tpu.util.synthetic import (
+    audit_result_sig as sig, build_referential_driver,
+    build_referential_oracle, make_referential_objects,
+)
+CAP = 4096
+
+# --- parity at widths 1 and 4 vs the interpreter oracle ---
+oracle = build_referential_oracle(P_T, P_R)
+t0 = time.perf_counter()
+oracle_r, oracle_t, _ = oracle.driver.audit_capped(CAP)
+oracle_s = time.perf_counter() - t0
+oracle_sig = sig(oracle_r)
+parity = {}
+for w in (1, 4):
+    c = build_referential_driver(P_T, P_R)
+    d = c.driver
+    d.set_mesh(w > 1, width=w)
+    res, tot, _ = d.audit_capped(CAP)
+    st = dict(d.last_sweep_stats)
+    counts = d.route_ledger.snapshot()["counts"]
+    parity[str(w)] = {
+        "parity": sig(res) == oracle_sig and tot == oracle_t,
+        "join_plans": st.get("join_plans"),
+        "join_plan_routed": any(
+            k.endswith("|join_plan") for k in counts
+        ),
+    }
+
+# --- full-scale join sweep throughput + delta locality ---
+client = build_referential_driver(N_T, N_R)
+d = client.driver
+client.audit_capped(20)  # compile + place + index build
+full_ts = []
+for _ in range(3):
+    d._audit_cache = None
+    d._delta_state = None  # honest steady state; placements stay warm
+    t0 = time.perf_counter()
+    client.audit_capped(20)
+    full_ts.append(time.perf_counter() - t0)
+full_s = min(full_ts)
+rows = d.last_sweep_stats["rows"]
+
+client.audit_capped(20)  # rebase the delta basis + join index
+objs = make_referential_objects(N_R, 1)
+ingresses = [o for o in objs if o["kind"] == "Ingress"]
+pods = [o for o in objs if o["kind"] == "Pod"
+        and str(o["metadata"]["labels"]["team"]).startswith("team-")]
+
+def churn_hosts(batch, tag):
+    for o in batch:
+        o = dict(o)
+        o["spec"] = {"rules": [{"host": f"moved-{tag}-{o['metadata']['name']}.corp.io"}]}
+        client.add_data(o)
+
+def churn_neutral(batch, tag):
+    # content churn that leaves every join key unchanged — the common
+    # production case (status/annotation updates)
+    for o in batch:
+        o = dict(o)
+        o["metadata"] = {**o["metadata"],
+                         "annotations": {"touched": tag}}
+        client.add_data(o)
+
+# prime the delta executable's row-width bucket (one-time XLA compile,
+# shared by every later churn batch of this magnitude)
+churn_neutral(pods[:CHURN], "prime")
+client.audit_capped(20)
+assert d.last_sweep_stats.get("delta_rows") is not None, d.last_sweep_stats
+
+# (a) NEUTRAL churn: keys unchanged -> zero affected readers, zero
+# re-renders; the delta-vs-full dispatch win in its pure form
+churn_neutral(pods[CHURN:2 * CHURN], "live")
+t0 = time.perf_counter()
+client.audit_capped(20)
+neutral_s = time.perf_counter() - t0
+nstats = dict(d.last_sweep_stats)
+
+# (b) KEY churn: hosts move -> the old/new key groups' readers
+# co-dispatch and re-render.  Compared against a FULL sweep doing the
+# SAME work (same churn magnitude, basis dropped), since both arms pay
+# the interpreter re-render of the legitimately-invalidated cells.
+churn_hosts(ingresses[:CHURN], "key")
+t0 = time.perf_counter()
+client.audit_capped(20)
+key_delta_s = time.perf_counter() - t0
+kstats = dict(d.last_sweep_stats)
+
+churn_hosts(ingresses[CHURN:2 * CHURN], "full")
+d._audit_cache = None
+d._delta_state = None
+t0 = time.perf_counter()
+client.audit_capped(20)
+key_full_s = time.perf_counter() - t0
+
+print(json.dumps({
+    "parity": parity,
+    "oracle_sweep_s": round(oracle_s, 4),
+    "full_sweep_s": round(full_s, 4),
+    "rows": rows,
+    "join_rows_per_s": round(rows / full_s, 1),
+    "delta_neutral_s": round(neutral_s, 4),
+    "delta_neutral_rows": nstats.get("delta_rows"),
+    "delta_neutral_affected": nstats.get("join_affected_rows"),
+    "delta_vs_full_speedup": round(full_s / max(neutral_s, 1e-9), 2),
+    "delta_keychurn_s": round(key_delta_s, 4),
+    "delta_keychurn_rows": kstats.get("delta_rows"),
+    "join_affected_rows": kstats.get("join_affected_rows"),
+    "full_after_keychurn_s": round(key_full_s, 4),
+    "keychurn_speedup": round(key_full_s / max(key_delta_s, 1e-9), 2),
+}))
+"""
+    )
+    from gatekeeper_tpu.parallel.mesh import virtual_mesh_env
+
+    env = virtual_mesh_env(8)
+    env["GK_JOIN_ASSERT"] = "1"
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=1800)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"referential subprocess failed: {proc.stderr[-2000:]}")
+    data = json.loads(proc.stdout.strip().splitlines()[-1])
+    parity_all = all(
+        v["parity"] and v["join_plan_routed"]
+        for v in data["parity"].values()
+    )
+    log(f"referential: parity_all={parity_all} "
+        f"join sweep {data['full_sweep_s']*1000:.0f}ms "
+        f"({data['join_rows_per_s']:.0f} rows/s at {n_t}x{n_r}); "
+        f"neutral churn delta {data['delta_neutral_rows']} rows in "
+        f"{data['delta_neutral_s']*1000:.0f}ms "
+        f"({data['delta_vs_full_speedup']}x vs full); key churn "
+        f"{data['delta_keychurn_rows']} rows "
+        f"({data['join_affected_rows']} group readers) in "
+        f"{data['delta_keychurn_s']*1000:.0f}ms vs full "
+        f"{data['full_after_keychurn_s']*1000:.0f}ms "
+        f"({data['keychurn_speedup']}x)")
+    out = {
+        "metric": f"referential join sweep parity+throughput ({n_t}x{n_r})",
+        "value": 1.0 if parity_all else 0.0,
+        "unit": "parity",
+        "vs_baseline": 0,
+        "referential_parity": parity_all,
+        "join_rows_per_s": data["join_rows_per_s"],
+        "delta_vs_full_speedup": data["delta_vs_full_speedup"],
+        **data,
+    }
+    record = {
+        "config": {
+            "templates": n_t, "rows": n_r,
+            "parity_templates": p_t, "parity_rows": p_r,
+            "churn_rows": churn,
+            "families": ["unique-key", "required-reference",
+                         "count-quota"],
+            "mesh": "virtual 8-device CPU (subprocess), widths 1+4",
+        },
+        "parity": parity_all,
+        **data,
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "REF_r14.json")
+    with open(path, "w") as f:
+        json.dump(record, f, indent=2, sort_keys=True)
+        f.write("\n")
+    log(f"referential recorded: {path}")
+    return out
+
+
 def bench_multihost() -> dict:
     """Two REAL OS processes joined via jax.distributed (gRPC coordinator,
     the DCN control-plane analogue), 4 virtual CPU devices each, one
@@ -3479,6 +3678,7 @@ CONFIGS = {
     "mesh": bench_mesh,
     "mesh_curve": bench_mesh_curve,
     "multihost": bench_multihost,
+    "referential": bench_referential,
     "fleet": bench_fleet,
     "chaos_fleet": bench_chaos_fleet,
     "overload": bench_overload,
@@ -3502,6 +3702,7 @@ _FOLDED = [
     ("warm_resume", "warm_resume_speedup"),
     ("mesh", "mesh_scaling_x8"),
     ("mesh_curve", "mesh_curve_parity"),
+    ("referential", "referential_parity"),
     ("multihost", "multihost_sweep_s"),
     ("fleet", "fleet_reviews_per_s"),
     ("chaos_fleet", "chaos_failed_admissions"),

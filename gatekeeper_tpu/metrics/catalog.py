@@ -353,6 +353,23 @@ ROUTE_DECISIONS_M = Measure(
     "breaker_open, compile_pending, device_failed, forced_device, "
     "uncalibrated_prior) — one per evaluated batch, never per review",
 )
+JOIN_PLANS_M = Measure(
+    "join_plans",
+    "Active cross-resource join plans (referential policies classified "
+    "into vectorized join/aggregate kernels, ops/joinkernel.py)",
+)
+JOIN_AFFECTED_M = Measure(
+    "join_delta_affected_rows",
+    "Reader rows co-dispatched by a delta sweep because a churned row "
+    "changed their join key group's aggregate — the key-group locality "
+    "cost beyond raw churn",
+)
+JOIN_DIVERGENCE_M = Measure(
+    "join_plan_divergence",
+    "Cells an exact join plan flagged whose interpreter-oracle render "
+    "was empty (interned-key/aggregate divergence; raises under "
+    "GK_JOIN_ASSERT=1)",
+)
 COMPILE_LAG_M = Measure(
     "compile_epoch_lag",
     "Constraint-side mutation epochs the async background compiler is "
@@ -531,6 +548,9 @@ def catalog_views():
         View("frontdoor_retries_denied_total", RETRY_DENIED_M, AGG_COUNT),
         View("route_decisions_total", ROUTE_DECISIONS_M, AGG_COUNT,
              tag_keys=("tier", "reason")),
+        View("join_plans", JOIN_PLANS_M, AGG_LAST_VALUE),
+        View("join_delta_affected_rows_total", JOIN_AFFECTED_M, AGG_COUNT),
+        View("join_plan_divergence_total", JOIN_DIVERGENCE_M, AGG_COUNT),
         View("compile_epoch_lag", COMPILE_LAG_M, AGG_LAST_VALUE),
         View("device_bytes", DEVICE_BYTES_M, AGG_LAST_VALUE,
              tag_keys=("component",)),
@@ -1016,6 +1036,40 @@ def record_route_decision(tier: str, reason: str):
         )
     except Exception:  # telemetry never blocks eval
         record_dropped("record_route_decision")
+
+
+def set_join_plans(n: int):
+    """Active referential join plans (join_plans gauge; set when the
+    driver's join index syncs, ops/joinkernel.py)."""
+    try:
+        _global().record(JOIN_PLANS_M, float(n))
+    except Exception:  # telemetry never blocks a sweep
+        record_dropped("set_join_plans")
+
+
+def record_join_affected(rows: int):
+    """Key-group reader rows co-dispatched by one delta sweep
+    (join_delta_affected_rows_total)."""
+    try:
+        _global().record(JOIN_AFFECTED_M, float(rows), count=int(rows))
+    except Exception:  # telemetry never blocks a sweep
+        record_dropped("record_join_affected")
+
+
+def record_join_divergence(kind: str):
+    """One exact-join-plan cell the oracle refused to render
+    (join_plan_divergence_total); the template kind goes to the log, not
+    a label (unbounded cardinality)."""
+    try:
+        _global().record(JOIN_DIVERGENCE_M, 1.0)
+        import logging
+
+        logging.getLogger("gatekeeper.joinkernel").warning(
+            "join-plan divergence: %s flagged a cell the interpreter "
+            "renders empty", kind,
+        )
+    except Exception:  # telemetry never blocks rendering
+        record_dropped("record_join_divergence")
 
 
 def record_compile_lag(lag: int):

@@ -66,6 +66,10 @@ REASONS = (
     "breaker_open",
     "compile_pending",
     "device_failed",
+    # a referential (cross-resource join) audit sweep dispatched through
+    # the vectorized join kernels (ops/joinkernel.py) — recorded so join
+    # dispatches are never misattributed to the row-local tiers
+    "join_plan",
 )
 
 
@@ -92,19 +96,26 @@ class RouteLedger:
     # ---- recording ---------------------------------------------------------
 
     def record(self, tier: str, reason: str, cells: int, n_reviews: int,
-               lam: Optional[float], priced: Optional[List[dict]] = None):
+               lam: Optional[float], priced: Optional[List[dict]] = None,
+               track_flips: bool = True):
         """One routing decision.  Guarded: the ledger must never fail the
-        evaluation it describes."""
+        evaluation it describes.  ``track_flips=False`` records the entry
+        and counters without touching the serving-tier flip tracker —
+        audit-class dispatches (join_plan sweeps) interleave with review
+        traffic and would otherwise fabricate a route_flip incident event
+        per audit interval."""
         if not self.enabled:
             return
         try:
-            self._record(tier, reason, cells, n_reviews, lam, priced)
+            self._record(tier, reason, cells, n_reviews, lam, priced,
+                         track_flips)
         except Exception:
             from ..metrics.catalog import record_dropped
 
             record_dropped("routeledger.record")
 
-    def _record(self, tier, reason, cells, n_reviews, lam, priced):
+    def _record(self, tier, reason, cells, n_reviews, lam, priced,
+                track_flips=True):
         per_review = max(int(cells) // max(int(n_reviews), 1), 1)
         entry = {
             "t": round(time.time(), 6),  # wall-clock: ok (render stamp)
@@ -133,10 +144,11 @@ class RouteLedger:
                 wins[tier] = wins.get(tier, 0) + 1
             key = (tier, reason)
             self._counts[key] = self._counts.get(key, 0) + 1
-            if self._last_tier is not None and self._last_tier != tier:
-                flipped = (self._last_tier, tier)
-                self.flips += 1
-            self._last_tier = tier
+            if track_flips:
+                if self._last_tier is not None and self._last_tier != tier:
+                    flipped = (self._last_tier, tier)
+                    self.flips += 1
+                self._last_tier = tier
         from ..metrics.catalog import record_route_decision
 
         record_route_decision(tier, reason)
@@ -192,6 +204,19 @@ class RouteLedger:
         cal = getattr(driver, "_route_cal", None) if driver is not None \
             else None
         out["calibration"] = dict(cal) if cal else None
+        if driver is not None and hasattr(driver, "join_plan_shapes"):
+            try:
+                shapes = driver.join_plan_shapes()
+            except Exception:
+                from ..metrics.catalog import record_dropped
+
+                record_dropped("routeledger.join_plan_shapes")
+                shapes = []
+            if shapes:
+                # referential workloads: the join-plan table (aggregate
+                # family, provider kind/scope, live group/provider/reader
+                # counts) so /debug/routez explains join_plan dispatches
+                out["join_plans"] = shapes
         if driver is not None and cal:
             # the live service-model curves over a per-review-cells grid:
             # predicted single-batch latency per tier — the crossover plot

@@ -109,6 +109,13 @@ class AuditPackCache:
         # and a full re-upload is required.
         self.dirty: set = set()
         self.layout_gen = 0
+        # bumped ONLY when row identities are reassigned (full rebuild /
+        # snapshot adoption) — distinct from layout_gen, which also bumps
+        # on capacity/width growth where row ids stay stable.  The join
+        # index (ops/joinkernel.py JoinState) keys on this: across growth
+        # it can diff old-vs-new key groups by row id; across a rebuild
+        # it must start fresh (every row generation was reset anyway).
+        self.rebuild_gen = 0
         # second dirty channel, drained by the incremental delta sweep
         # (ops/deltasweep.py) independently of the device-scatter channel
         # above, so neither consumer starves the other and the delta path
@@ -148,6 +155,17 @@ class AuditPackCache:
         self.dirty = set()
         self.delta_dirty = set()
         self.layout_gen += 1
+        self.rebuild_gen += 1
+
+    def bump_row_gen(self, rows):
+        """Invalidate the render-cache generations of `rows` WITHOUT
+        marking them dirty: their packed content is unchanged (the device
+        state is current), but something they render from — a join key
+        group's aggregate — moved (ops/joinkernel.py)."""
+        for r in rows:
+            if 0 <= r < len(self.row_gen):
+                self._gen += 1
+                self.row_gen[r] = self._gen
 
     def take_dirty(self) -> set:
         d = self.dirty
@@ -245,6 +263,7 @@ class AuditPackCache:
         self.dirty = set()
         self.delta_dirty = set()
         self.layout_gen += 1
+        self.rebuild_gen += 1
 
     # ---- incremental ------------------------------------------------------
 

@@ -87,7 +87,7 @@ _ABSENT = _Absent()
 
 @dataclass(frozen=True)
 class ColumnSpec:
-    kind: str  # "scalar" | "slot" | "keyset"
+    kind: str  # "scalar" | "slot" | "keyset" | "joinkey"
     iter_paths: Tuple[Path, ...]  # slot/keyset entity sources ([] allowed)
     rel_path: Path = ()  # []-free value path (scalar: the full path)
     exclude: Tuple[str, ...] = ()  # keyset: excluded key literals
@@ -136,6 +136,44 @@ def _encode(values: List[Any], interner: Interner, shape) -> Dict[str, np.ndarra
         "sid": sid.reshape(shape),
         "num": num.reshape(shape),
     }
+
+
+def _extract_joinkey(
+    resources, spec: "ColumnSpec", interner: Interner, rows: int
+) -> Dict[str, np.ndarray]:
+    """Cross-resource join-key column (ops/joinkernel.py): values at the
+    spec's path, NORMALIZED through the one type-tagged key form
+    (normalize_join_key) and interned — so an int label value and its
+    string twin can never coerce into one key group.  Scalar keys ->
+    {"sid" [R]}; slot keys (iteration paths) -> {"sid", "mask"} [R, S]
+    with the slot width bucketed exactly like slot columns over the same
+    iteration group (shared axes stay aligned)."""
+    from .joinkernel import UNKNOWN_KEY, intern_join_key
+
+    if not spec.iter_paths:  # scalar key
+        sid = np.full(rows, Interner.MISSING, np.int32)
+        for i, r in enumerate(resources):
+            hits: List[Any] = []
+            _walk(r, spec.rel_path, 0, hits)
+            if hits:
+                sid[i] = intern_join_key(hits[0], interner)
+        return {"sid": sid}
+    ents: List[List[Any]] = []
+    for r in resources:
+        hits: List[Any] = []
+        for p in spec.iter_paths:
+            _walk(r, p, 0, hits)
+        ents.append(hits)
+    width = _bucket(max((len(e) for e in ents), default=0), 1)
+    sid = np.full((rows, width), Interner.MISSING, np.int32)
+    mask = np.zeros((rows, width), bool)
+    for i, row_ents in enumerate(ents):
+        for j, ent in enumerate(row_ents):
+            mask[i, j] = True
+            v = _get_rel(ent, spec.rel_path)
+            if v is not _ABSENT:
+                sid[i, j] = intern_join_key(v, interner)
+    return {"sid": sid, "mask": mask}
 
 
 def _extract_columns_native(
@@ -193,6 +231,12 @@ def _extract_columns_native(
                 cols_idx = np.arange(len(flat)) - np.repeat(starts, counts)
                 arr[rows_idx, cols_idx] = flat
             out[spec.key] = {"ids": arr}
+        elif spec.kind == "joinkey":
+            # normalized-key extraction stays host-Python on the native
+            # path too: the normalization contract lives in ONE place
+            # (joinkernel.normalize_join_key), and join columns are a
+            # small fraction of a referential corpus's column set
+            out[spec.key] = _extract_joinkey(resources, spec, interner, rows)
         else:
             raise ValueError(f"unknown column kind {spec.kind}")
     return out
@@ -288,6 +332,8 @@ def extract_columns(
             for i, keys in enumerate(per_row_keys):
                 ids[i, : len(keys)] = keys
             out[spec.key] = {"ids": ids}
+        elif spec.kind == "joinkey":
+            out[spec.key] = _extract_joinkey(resources, spec, interner, rows)
         else:
             raise ValueError(f"unknown column kind {spec.kind}")
     return out

@@ -326,6 +326,17 @@ class TpuDriver(InterpDriver):
         self._delta_state = None
         self._delta_jit = None
         self._delta_jit_key = None
+        # referential-policy state (ops/joinkernel.py): the host-side
+        # join-group index (key -> provider/reader rows) that gives the
+        # delta sweep O(churn) key-group invalidation, the per-epoch
+        # unique-plan cache, and the audit-mode mask executable (the
+        # review-mode fused fn resolves JoinCmp to unknown and must
+        # never back the delta fold's base mask)
+        self._join_state = None
+        self._join_plans_cache: Optional[tuple] = None
+        self._join_safe_cache: Optional[tuple] = None
+        self._fused_mask = None
+        self._fused_mask_key = None
         # per-sweep instrumentation (read by bench.py): pack/dispatch/fetch/
         # render wall-times, transferred bytes, rendered cells
         self.last_sweep_stats: Dict[str, float] = {}
@@ -769,29 +780,39 @@ class TpuDriver(InterpDriver):
             tuple(sorted(s.key for s in col_specs)),
         )
 
-    def _fused_fn(self):
-        """One jitted function for the whole sweep: match kernel + every
-        violation-program group, combined into the candidate mask.  ONE
-        dispatch and ONE device->host fetch per evaluation — essential when
-        the device sits behind a network relay (each fetch is an RTT).
+    def _eval_body(self, side, join_mode: Optional[str] = None,
+                   axis_name: Optional[str] = None):
+        """The one match-kernel + violation-program-groups evaluation,
+        parameterized by the JOIN mode (ops/joinkernel.py):
 
-        Keyed on the STRUCTURE signature, not the epoch: params, string
-        tables (vocab-bucketed) and group index vectors are all runtime
-        arguments, so constraint churn that keeps shapes inside their
-        power-of-two buckets reuses the warm executable as-is."""
-        side = self._constraint_side()
-        sig = self._structure_sig(side)
-        if self._fused is not None and self._fused_key == sig:
-            return self._fused, side
-        if faults.ENABLED:
-            faults.fire(faults.TPU_COMPILE)
+        - ``None`` (the review path): JoinCmp nodes resolve to their
+          polarity's unknown_default — sound over-approximation, no extra
+          arguments, signature identical to the pre-referential body.
+        - ``'trace'`` (full audit sweeps): per-key aggregate tables are
+          computed in-trace from the resident columns (segment-reduce
+          group-by; per-shard + all_gather merge when ``axis_name`` names
+          the mesh axis); the trailing ``joins`` argument carries runtime
+          kind ids so interner ids are never baked into a cached
+          executable.
+        - ``'tables'`` (delta sweeps): the trailing ``joins`` argument
+          carries the host join index's (uk, uc) tables — a churn-slice
+          dispatch cannot derive the global aggregate from its rows.
+
+        Returns (body, has_joins): ``body(rv, cs, cols, group_params
+        [, joins])``."""
         _ordered, _cp, groups, _col_specs, _crow = side
         static = [(prog, start, B) for prog, start, B, _packed in groups]
+        plans = self._active_join_plans()
+        has_joins = bool(plans) and join_mode is not None
+        pidx = {p: i for i, p in enumerate(plans)}
 
-        def fused(rv, cs, cols, group_params):
+        def body(rv, cs, cols, group_params, joins=None):
             match, autoreject = match_kernel(rv, cs)
             mask = match
             R = match.shape[1]
+            # join tables shared ACROSS groups: N template clones of one
+            # referential family cost one table build per sweep
+            shared_tables: dict = {}
             for (prog, start, B), (params, elems, tables) in zip(
                 static, group_params
             ):
@@ -808,6 +829,14 @@ class TpuDriver(InterpDriver):
                 env = EvalEnv(
                     prog_cols, params, elems, tables, keysets, B, R
                 )
+                if has_joins and prog.join_plans:
+                    from .joinkernel import JoinBinding
+
+                    env.joins = JoinBinding(
+                        join_mode, prog.join_plans,
+                        [joins[pidx[p]] for p in prog.join_plans],
+                        rv=rv, axis_name=axis_name, cache=shared_tables,
+                    )
                 vmask = eval_program(prog, env)  # [B, R], B = block size
                 # STATIC SLICE update: the group-major layout gives every
                 # group a contiguous [start, start+B) block, so no
@@ -820,12 +849,227 @@ class TpuDriver(InterpDriver):
                 )
             return mask, autoreject
 
+        return body, has_joins
+
+    def _fused_fn(self):
+        """One jitted function for the whole sweep: match kernel + every
+        violation-program group, combined into the candidate mask.  ONE
+        dispatch and ONE device->host fetch per evaluation — essential when
+        the device sits behind a network relay (each fetch is an RTT).
+
+        Keyed on the STRUCTURE signature, not the epoch: params, string
+        tables (vocab-bucketed) and group index vectors are all runtime
+        arguments, so constraint churn that keeps shapes inside their
+        power-of-two buckets reuses the warm executable as-is."""
+        side = self._constraint_side()
+        sig = self._structure_sig(side)
+        if self._fused is not None and self._fused_key == sig:
+            return self._fused, side
+        if faults.ENABLED:
+            faults.fire(faults.TPU_COMPILE)
+        body, _has_joins = self._eval_body(side)  # review mode
+
+        def fused(rv, cs, cols, group_params):
+            return body(rv, cs, cols, group_params)
+
         from .aotcache import aot_jit
 
         self._fused = aot_jit(fused, "fused", sig)
         self._fused_key = sig
         self._fused_gen += 1
         return self._fused, side
+
+    # ---- referential policies (ops/joinkernel.py) -------------------------
+
+    def _active_join_plans(self) -> tuple:
+        """Ordered unique JoinPlans across every installed program,
+        cached per constraint-side epoch.  Index order is the ``joins``
+        runtime-argument order of every join-bearing executable."""
+        cached = self._join_plans_cache
+        if cached is not None and cached[0] == self._cs_epoch:
+            return cached[1]
+        plans: List = []
+        for kind in sorted(self.programs):
+            prog = self.programs.get(kind)
+            for p in getattr(prog, "join_plans", ()) or ():
+                if p not in plans:
+                    plans.append(p)
+        out = tuple(plans)
+        self._join_plans_cache = (self._cs_epoch, out)
+        return out
+
+    def _join_trace_args(self) -> Optional[tuple]:
+        """Runtime arguments for 'trace'-mode join executables: the
+        interned remote-kind id per plan (runtime, never baked — AOT
+        cache entries are shared across processes whose interners
+        assigned different ids)."""
+        plans = self._active_join_plans()
+        if not plans:
+            return None
+        return tuple(
+            {"kind_id": np.asarray(
+                self.interner.intern(p.remote_kind), np.int32
+            )}
+            for p in plans
+        )
+
+    def _join_delta_tables(self) -> Optional[tuple]:
+        """'tables'-mode runtime arguments from the host join index
+        (per-plan uk/uc tables + the kind id JoinCmp.exclude_self
+        needs)."""
+        js = self._join_state
+        if js is None or not js.built:
+            return None
+        plans = self._active_join_plans()
+        out = []
+        for p, tab in zip(plans, js.delta_tables()):
+            tab = dict(tab)
+            tab["kind_id"] = np.asarray(
+                self.interner.intern(p.remote_kind), np.int32
+            )
+            out.append(tab)
+        return tuple(out)
+
+    def _ensure_join_state(self):
+        """Bring the host join-group index current with the audit pack
+        (full-sweep path).  The rebuild DIFFS against the previous index
+        and bumps the row generations of readers whose key group
+        changed, so the render caches can never replay a message whose
+        aggregate (a quota count, a duplicate set) moved underneath it."""
+        plans = self._active_join_plans()
+        ap = self._audit_pack
+        if not plans:
+            if self._join_state is not None:
+                # the last referential template left: retract the gauge
+                # so /metrics never shows phantom active join plans
+                self._join_state = None
+                from ..metrics.catalog import set_join_plans
+
+                set_join_plans(0)
+            return None
+        from .joinkernel import JoinState
+
+        js = self._join_state
+        sig = tuple(p.sig for p in plans)
+        if js is None or js.sig != sig or js.rebuild_gen != ap.rebuild_gen:
+            # a pack rebuild reassigned row ids (and reset every row
+            # generation with it), so a fresh index starts diff-free
+            js = JoinState(plans, ap.rebuild_gen)
+            self._join_state = js
+        bump = js.rebuild(ap, self.interner)
+        if bump:
+            ap.bump_row_gen(bump)
+        from ..metrics.catalog import set_join_plans
+
+        set_join_plans(len(plans))
+        return js
+
+    def _join_safe(self, kind: str) -> bool:
+        """True when a referential template's rendered results are
+        reusable across sweeps: every inventory read is a classified
+        join plan (prog.exact survived compilation), so verdict+message
+        depend only on (row content, key-group aggregate) — and the join
+        index bumps reader row generations whenever a group changes."""
+        cached = self._join_safe_cache
+        if cached is None or cached[0] != self._cs_epoch:
+            cached = (self._cs_epoch, {})
+            self._join_safe_cache = cached
+        hit = cached[1].get(kind)
+        if hit is None:
+            prog = self.programs.get(kind)
+            # same determinism bar as the row-local audit memo (which
+            # keys on pack row generations, not review content): an
+            # EXACT program's clauses compiled entirely from the
+            # wall-clock-free vectorized fragment, so the render is a
+            # function of (row content, key-group aggregate) — both
+            # covered by the generation bumps.  memo_safe is deliberately
+            # NOT required: it trips on whole-review aliasing (the
+            # `identical(other, input.review)` helper), which is
+            # harmless here — the review IS the row content.
+            hit = bool(
+                prog is not None
+                and getattr(prog, "join_plans", ())
+                and prog.exact
+                and kind in self.templates
+            )
+            cached[1][kind] = hit
+        return hit
+
+    def _join_strict(self, kind: str, constraint: dict) -> bool:
+        """A flagged-but-renders-empty cell for this constraint is a
+        genuine plan-vs-oracle divergence (not a legitimate match or
+        mask over-approximation): exact join program, selector-free
+        match (the packed match is exact without label selectors)."""
+        prog = self.programs.get(kind)
+        if prog is None or not getattr(prog, "join_plans", ()) \
+                or not prog.exact:
+            return False
+        match = constraint_match_spec(constraint)
+        return not match.get("labelSelector") and not match.get(
+            "namespaceSelector"
+        )
+
+    def _note_join_false_positive(self, kind: str, name: str, ri: int):
+        """A strict-eligible join cell whose interpreter render came back
+        empty: count/raise it as a divergence UNLESS the documented
+        groupVersion-twin corner explains it (legitimate filter work —
+        raising there would crash armed audits on valid clusters)."""
+        from . import joinkernel
+
+        prog = self.programs.get(kind)
+        js = self._join_state
+        if (
+            js is not None and prog is not None
+            and joinkernel.gv_twin_corner(
+                js, getattr(prog, "join_plans", ()), self._audit_pack, ri
+            )
+        ):
+            return
+        joinkernel.note_false_positive(kind, name, ri)
+
+    def join_plan_shapes(self) -> List[dict]:
+        """Join-plan observability summary (served by /debug/routez via
+        the route ledger, obs/routeledger.py)."""
+        js = self._join_state
+        if js is not None and js.built:
+            return js.shapes()
+        return [
+            {
+                "agg": p.agg, "kind": p.remote_kind,
+                "scope": p.remote_scope, "slot_key": p.local_slot,
+                "groups": None, "provider_rows": None, "reader_rows": None,
+            }
+            for p in self._active_join_plans()
+        ]
+
+    def _fused_mask_fn(self):
+        """Audit-mode [C, R] mask executable (single-device path), or
+        None when no join plans exist (the plain fused fn is then
+        byte-identical and its warm executable serves).  The lazy
+        MaskSource dispatch must compute join verdicts exactly like the
+        capped reduction it backs: the review-mode fused fn resolves
+        JoinCmp to unknown_default and would corrupt the delta fold's
+        before-columns."""
+        fused, side = self._fused_fn()
+        if self._fused_mask is not None and \
+                self._fused_mask_key == self._fused_gen:
+            return self._fused_mask
+        body, has_joins = self._eval_body(side, join_mode="trace")
+        if not has_joins:
+            self._fused_mask = None
+            self._fused_mask_key = self._fused_gen
+            return None
+
+        def fused_mask(rv, cs, cols, gp, joins):
+            return body(rv, cs, cols, gp, joins)[0]
+
+        from .aotcache import aot_jit
+
+        self._fused_mask = aot_jit(
+            fused_mask, "fused-mask", self._fused_key
+        )
+        self._fused_mask_key = self._fused_gen
+        return self._fused_mask
 
     def _repack_if_vocab_grew(self, fn, side):
         """Row packing may have interned new strings; constraint-side string
@@ -2599,11 +2843,20 @@ class TpuDriver(InterpDriver):
             and self._fused_audit_key == (self._fused_gen, K)
         ):
             return self._fused_audit, side
-        raw = fused.__wrapped__
+        body, has_joins = self._eval_body(side, join_mode="trace")
+        if has_joins:
+            # join-bearing corpora take a trailing `joins` runtime arg
+            # (kind ids) and compute the per-key aggregate tables
+            # in-trace (ops/joinkernel.py)
+            def fused_audit(rv, cs, cols, gp, joins):
+                mask, _autoreject = body(rv, cs, cols, gp, joins)
+                return _packed_reduction(mask, K)
+        else:
+            raw = fused.__wrapped__
 
-        def fused_audit(rv, cs, cols, gp):
-            mask, _autoreject = raw(rv, cs, cols, gp)
-            return _packed_reduction(mask, K)
+            def fused_audit(rv, cs, cols, gp):
+                mask, _autoreject = raw(rv, cs, cols, gp)
+                return _packed_reduction(mask, K)
 
         from .aotcache import aot_jit
 
@@ -2626,7 +2879,7 @@ class TpuDriver(InterpDriver):
         stays device-resident and row-sharded."""
         from jax.sharding import PartitionSpec as _P
 
-        fused, _side = self._fused_fn()
+        fused, side = self._fused_fn()
         key_now = self._fused_audit_mesh_key
         if (
             self._fused_audit_mesh is not None
@@ -2636,10 +2889,21 @@ class TpuDriver(InterpDriver):
             and key_now[2] is mesh  # identity-is-liveness, not id()
         ):
             return self._fused_audit_mesh
+        # join-bearing corpora evaluate in 'trace' mode with the mesh
+        # axis named: each shard segment-reduces its own row slab to a
+        # compact per-key table and an all_gather merges them — the
+        # [C, 1+K]-reduce-then-merge idiom applied to join groups, so a
+        # key spanning shards counts once per provider row at any width
+        eval_body, has_joins = self._eval_body(
+            side, join_mode="trace", axis_name="data"
+        )
         raw = fused.__wrapped__
 
-        def body(rv, cs, cols, gp):
-            mask, _autoreject = raw(rv, cs, cols, gp)
+        def body(rv, cs, cols, gp, joins=None):
+            if has_joins:
+                mask, _autoreject = eval_body(rv, cs, cols, gp, joins)
+            else:
+                mask, _autoreject = raw(rv, cs, cols, gp)
             packed = _packed_reduction(mask, K)
             shard = jax.lax.axis_index("data")
             idx = packed[:, 1:]
@@ -2649,25 +2913,39 @@ class TpuDriver(InterpDriver):
 
         sharded = [None]  # built on first call: specs follow arg trees
 
-        def fused_audit_mesh(rv, cs, cols, gp):
-            if sharded[0] is None:
-                def row_spec(a):
-                    return _P("data", *([None] * (a.ndim - 1)))
+        def _build(rv, cs, cols, gp, joins=None):
+            def row_spec(a):
+                return _P("data", *([None] * (a.ndim - 1)))
 
-                repl = _P()
-                in_specs = (
-                    jax.tree_util.tree_map(row_spec, rv),
-                    jax.tree_util.tree_map(lambda a: repl, cs),
-                    jax.tree_util.tree_map(row_spec, cols),
-                    jax.tree_util.tree_map(lambda a: repl, gp),
+            repl = _P()
+            in_specs = (
+                jax.tree_util.tree_map(row_spec, rv),
+                jax.tree_util.tree_map(lambda a: repl, cs),
+                jax.tree_util.tree_map(row_spec, cols),
+                jax.tree_util.tree_map(lambda a: repl, gp),
+            )
+            if has_joins:
+                in_specs = in_specs + (
+                    jax.tree_util.tree_map(lambda a: repl, joins),
                 )
-                out_specs = (_P(None, "data"), _P("data", None, None))
-                from ..util.jaxcompat import shard_map as _shard_map
+            out_specs = (_P(None, "data"), _P("data", None, None))
+            from ..util.jaxcompat import shard_map as _shard_map
 
-                sharded[0] = jax.jit(_shard_map(
-                    body, mesh=mesh, in_specs=in_specs,
-                    out_specs=out_specs, check_vma=False,
-                ))
+            if has_joins:
+                inner = body
+            else:
+                def inner(rv, cs, cols, gp):
+                    return body(rv, cs, cols, gp)
+            sharded[0] = jax.jit(_shard_map(
+                inner, mesh=mesh, in_specs=in_specs,
+                out_specs=out_specs, check_vma=False,
+            ))
+
+        def fused_audit_mesh(rv, cs, cols, gp, joins=None):
+            if sharded[0] is None:
+                _build(rv, cs, cols, gp, joins)
+            if has_joins:
+                return sharded[0](rv, cs, cols, gp, joins)
             return sharded[0](rv, cs, cols, gp)
 
         self._fused_audit_mesh = fused_audit_mesh
@@ -2885,6 +3163,12 @@ class TpuDriver(InterpDriver):
         ap = self._audit_pack
         if ap.n_rows == 0:
             return None
+        # referential policies: bring the host join-group index current
+        # (diff-bumps reader row generations for changed key groups) and
+        # build the trace-mode runtime args the join-bearing executables
+        # take (ops/joinkernel.py)
+        self._ensure_join_state()
+        jargs = self._join_trace_args()
         mesh = self._mesh()
         t1 = _time.perf_counter()
         if mesh is None:
@@ -2892,16 +3176,27 @@ class TpuDriver(InterpDriver):
             cs_d, gp_d = self._constraint_device_side(
                 cp.arrays, group_params, None, None
             )
-            packed_dev = fn(rv_d, cs_d, cols_d, gp_d)
-            # lazy: the [C, R] mask is its own (never-fetched) dispatch
-            # against the SAME committed buffers, issued only when the
-            # delta path or the uncapped audit first needs it — keeping it
-            # out of the capped fetch avoids the relay's big-co-output
-            # transfer charge (the r3 full-resweep regression)
-            fused = self._fused  # this epoch's compiled plain fused fn
-            mask_src = MaskSource(
-                lambda: fused(rv_d, cs_d, cols_d, gp_d)[0]
-            )
+            if jargs is None:
+                packed_dev = fn(rv_d, cs_d, cols_d, gp_d)
+                # lazy: the [C, R] mask is its own (never-fetched)
+                # dispatch against the SAME committed buffers, issued
+                # only when the delta path or the uncapped audit first
+                # needs it — keeping it out of the capped fetch avoids
+                # the relay's big-co-output transfer charge (the r3
+                # full-resweep regression)
+                fused = self._fused  # this epoch's compiled plain fused fn
+                mask_src = MaskSource(
+                    lambda: fused(rv_d, cs_d, cols_d, gp_d)[0]
+                )
+            else:
+                packed_dev = fn(rv_d, cs_d, cols_d, gp_d, jargs)
+                # the mask dispatch must be AUDIT-mode too: the review
+                # fused fn resolves JoinCmp to unknown and would corrupt
+                # the delta fold's before-columns
+                mask_fn = self._fused_mask_fn()
+                mask_src = MaskSource(
+                    lambda: mask_fn(rv_d, cs_d, cols_d, gp_d, jargs)
+                )
             # background-resolve the mask, then warm the width-8 delta
             # executable against it: both trace/compiles happen off the
             # sweep path, so neither this sweep's fetch nor the first
@@ -2921,9 +3216,17 @@ class TpuDriver(InterpDriver):
                 cp.arrays, group_params, None, mesh
             )
             fn_mesh = self._fused_audit_mesh_fn(K, mesh)
-            mask_dev, packed_dev = self._guarded_mesh_dispatch(
-                mesh, lambda: fn_mesh(rv_p, cs_p, cols_p, gp_p)
-            )
+            if jargs is None:
+                mask_dev, packed_dev = self._guarded_mesh_dispatch(
+                    mesh, lambda: fn_mesh(rv_p, cs_p, cols_p, gp_p)
+                )
+            else:
+                from ..parallel.mesh import replicate_tree
+
+                j_p = replicate_tree(mesh, jargs)
+                mask_dev, packed_dev = self._guarded_mesh_dispatch(
+                    mesh, lambda: fn_mesh(rv_p, cs_p, cols_p, gp_p, j_p)
+                )
             mask_src = MaskSource.resolved(mask_dev)
             # warm the mesh-specialized delta executable off the sweep
             # path (the mask is already resolved; only the trace/compile
@@ -2981,6 +3284,18 @@ class TpuDriver(InterpDriver):
                 ap.capacity, 1 if mesh is None else int(mesh.devices.size)
             )[1]
         )
+        if jargs is not None:
+            # a join-bearing sweep is its own routing event: without the
+            # explicit reason the dispatch would read as an ordinary
+            # row-local device sweep in route_decisions_total/routez
+            self.last_sweep_stats["join_plans"] = float(len(jargs))
+            # tier "device" (the documented taxonomy), flip-exempt: an
+            # audit-class dispatch interleaved with np/interp review
+            # traffic is not a serving-tier change
+            self.route_ledger.record(
+                "device", "join_plan", cells=len(ordered) * ap.n_rows,
+                n_reviews=int(ap.n_rows), lam=None, track_flips=False,
+            )
         obstrace.record_span("audit.pack", t0, t1, stage=obstrace.PACK,
                              rows=ap.n_rows)
         obstrace.record_span(
@@ -3058,6 +3373,14 @@ class TpuDriver(InterpDriver):
         try:
             out = self._audit_device(tracing)
         except Exception as e:
+            from .joinkernel import JoinDivergence
+
+            if isinstance(e, JoinDivergence):
+                # the armed (GK_JOIN_ASSERT) join-parity assertion is a
+                # diagnostic, not a device failure: serving the interp
+                # fallback here would hide exactly the divergence the
+                # caller armed the flag to catch
+                raise
             self._record_device_failure(e)
             log.warning(
                 "device audit failed (%s: %s); serving from the "
@@ -3105,10 +3428,18 @@ class TpuDriver(InterpDriver):
                     continue
                 rowview = RowView(review)
                 for i in np.nonzero(mask[:, ri])[0]:
-                    kind, _name, constraint = ordered[i]
-                    self._render_cell(
-                        results, constraint, kind, review, None,
-                        inventory, trace, rowview=rowview,
+                    kind, name, constraint = ordered[i]
+                    violations = self._cell_violations(
+                        constraint, kind, review, None, inventory,
+                        rowview=rowview,
+                    )
+                    if not violations and self._join_strict(
+                        kind, constraint
+                    ):
+                        self._note_join_false_positive(kind, name, int(ri))
+                    self._append_violation_results(
+                        results, violations, constraint, kind, review,
+                        trace,
                     )
             self._flush_render_counts()
             return results, ("\n".join(trace) if tracing else None)
@@ -3165,6 +3496,12 @@ class TpuDriver(InterpDriver):
         prog = self.programs.get(kind)
         if prog is None or not prog.exact:
             return False
+        if getattr(prog, "join_plans", ()):
+            # the distinct-provider-row aggregate can over-approximate in
+            # one documented corner (same kind/ns/name under two
+            # groupVersions, docs/referential.md) — never report its
+            # device count as the reference-exact total past the cap
+            return False
         if len(prog.clauses) != 1 or prog.clauses[0].slot_iter is not None:
             return False
         match = constraint_match_spec(constraint)
@@ -3217,6 +3554,8 @@ class TpuDriver(InterpDriver):
             ck: {leaf: a[rows_pad] for leaf, a in leaves.items()}
             for ck, leaves in ap.cols.items()
         }
+        jt = self._join_delta_tables()
+        jtail = (jt,) if jt is not None else ()
         if mesh is not None:
             from ..parallel.mesh import DISPATCH_LOCK
 
@@ -3238,11 +3577,13 @@ class TpuDriver(InterpDriver):
                     # deadlock this gate exists to prevent); the stall is
                     # one bounded cold compile
                     delta_jit(
-                        m, rows_pad, rv_slice, cs_d, cols_slice, gp_d
+                        m, rows_pad, rv_slice, cs_d, cols_slice, gp_d,
+                        *jtail
                     ).block_until_ready()
         else:
             def _warm(m):
-                delta_jit(m, rows_pad, rv_slice, cs_d, cols_slice, gp_d)
+                delta_jit(m, rows_pad, rv_slice, cs_d, cols_slice, gp_d,
+                          *jtail)
 
         mask_src.prefetch(after=_warm)
 
@@ -3252,17 +3593,29 @@ class TpuDriver(InterpDriver):
         the resident full-sweep mask, in ONE dispatch ->
         [C, 2d] (old | new) int8.  Same traced computation as the full
         sweep, tiny intermediates, one round trip."""
-        fused, _side = self._fused_fn()
+        fused, side = self._fused_fn()
         if self._delta_jit is not None and self._delta_jit_key == self._fused_gen:
             return self._delta_jit
-        raw = fused.__wrapped__
+        body, has_joins = self._eval_body(side, join_mode="tables")
+        if has_joins:
+            # a churn-slice dispatch cannot derive the global join
+            # aggregate from its own rows: the host join index supplies
+            # the per-key tables as a trailing runtime argument
+            def delta(mask_dev, idx, rv, cs, cols, gp, joins):
+                new = body(rv, cs, cols, gp, joins)[0]
+                old = mask_dev[:, idx]
+                return jnp.concatenate(
+                    [old.astype(jnp.int8), new.astype(jnp.int8)], axis=1
+                )
+        else:
+            raw = fused.__wrapped__
 
-        def delta(mask_dev, idx, rv, cs, cols, gp):
-            new = raw(rv, cs, cols, gp)[0]
-            old = mask_dev[:, idx]
-            return jnp.concatenate(
-                [old.astype(jnp.int8), new.astype(jnp.int8)], axis=1
-            )
+            def delta(mask_dev, idx, rv, cs, cols, gp):
+                new = raw(rv, cs, cols, gp)[0]
+                old = mask_dev[:, idx]
+                return jnp.concatenate(
+                    [old.astype(jnp.int8), new.astype(jnp.int8)], axis=1
+                )
 
         from .aotcache import aot_jit
 
@@ -3317,6 +3670,25 @@ class TpuDriver(InterpDriver):
             return ap.reviews, ordered, st
         if len(ap.delta_dirty) > self.DELTA_MAX_ROWS:
             return None
+        # referential policies: the delta dispatch must also re-evaluate
+        # the READERS of every key group the churn touched (a churn row
+        # invalidates only its key group — never the cluster).  Without a
+        # current join index the aggregate cannot be maintained
+        # incrementally, so rebase via a full sweep.
+        js = None
+        if self._active_join_plans():
+            js = self._join_state
+            if (
+                js is None or not js.built
+                or js.sig != tuple(
+                    p.sig for p in self._active_join_plans()
+                )
+                or js.rebuild_gen != ap.rebuild_gen
+            ):
+                return None
+            affected = js.affected(ap, self.interner, ap.delta_dirty)
+            if len(ap.delta_dirty) + len(affected) > self.DELTA_MAX_ROWS:
+                return None
         from .deltasweep import MaskSource
 
         got = st.mask_src.peek(wait_s=self.DELTA_MASK_WAIT_S)
@@ -3346,8 +3718,21 @@ class TpuDriver(InterpDriver):
         # point must invalidate the state (the caller then runs a full
         # sweep, which rebases knowledge and clears both dirty channels)
         rows = sorted(ap.take_delta_dirty())
+        join_rows = 0
+        if js is not None:
+            # commit the churn to the join index: updates provider/reader
+            # maps, bumps affected readers' row generations (stale render
+            # reuse), and returns the key-group rows to co-dispatch
+            extra = js.commit(ap, self.interner, rows)
+            if extra:
+                join_rows = len(extra)
+                rows = sorted(set(rows) | extra)
+                from ..metrics.catalog import record_join_affected
+
+                record_join_affected(join_rows)
         try:
-            return self._apply_delta(st, ap, rows, ordered, cp, groups, t0)
+            return self._apply_delta(st, ap, rows, ordered, cp, groups, t0,
+                                     join_rows=join_rows)
         except Exception:
             import logging
 
@@ -3358,7 +3743,8 @@ class TpuDriver(InterpDriver):
             self._delta_state = None
             return None
 
-    def _apply_delta(self, st, ap, rows, ordered, cp, groups, t0):
+    def _apply_delta(self, st, ap, rows, ordered, cp, groups, t0,
+                     join_rows: int = 0):
         import time as _time
         t1 = _time.perf_counter()
         # ONE dispatch: the fused evaluation on the dirty-row slice AND the
@@ -3378,6 +3764,11 @@ class TpuDriver(InterpDriver):
         cs_d, gp_d = self._constraint_device_side(
             cp.arrays, group_params, None, mesh
         )
+        # post-commit join tables: the [C, d] dispatch evaluates the
+        # churned rows AND the affected key-group readers against the
+        # UPDATED global aggregate (ops/joinkernel.py 'tables' mode)
+        jt = self._join_delta_tables()
+        jtail = (jt,) if jt is not None else ()
         # [C_total, 2d] from the device; crow folds pad rows out so the
         # incremental state stays per ordered constraint
         if mesh is not None:
@@ -3386,14 +3777,15 @@ class TpuDriver(InterpDriver):
             both_dev = self._guarded_mesh_dispatch(
                 mesh,
                 lambda: delta_fn(
-                    mask_in, rows_pad, rv_slice, cs_d, cols_slice, gp_d
+                    mask_in, rows_pad, rv_slice, cs_d, cols_slice, gp_d,
+                    *jtail
                 ),
                 enter=False,
             )
         else:
             both_dev = self._delta_dispatch_fn(mesh)(
                 st.mask_src.get(), rows_pad, rv_slice, cs_d, cols_slice,
-                gp_d
+                gp_d, *jtail
             )
         both = np.asarray(both_dev).astype(bool)[st.crow]
         fetch_bytes = both.nbytes
@@ -3417,6 +3809,11 @@ class TpuDriver(InterpDriver):
             "cells": float(len(ordered) * len(rows)),
             "shards": 1.0 if mesh is None else float(mesh.devices.size),
         }
+        if jt is not None:
+            # key-group locality: how many of the dispatched rows were
+            # affected readers rather than content churn (the quantity
+            # tools/check_join_parity.py pins to the exact group size)
+            self.last_sweep_stats["join_affected_rows"] = float(join_rows)
         if mesh is not None:
             # churn locality: the dirty rows' slabs are the only shards
             # whose resident state the next full placement must touch
@@ -3460,6 +3857,11 @@ class TpuDriver(InterpDriver):
         try:
             out = self._audit_capped_device(cap, tracing)
         except Exception as e:
+            from .joinkernel import JoinDivergence
+
+            if isinstance(e, JoinDivergence):
+                # armed join-parity assertion: surface it (see audit())
+                raise
             self._record_device_failure(e)
             log.warning(
                 "device capped audit failed (%s: %s); serving from the "
@@ -3544,11 +3946,18 @@ class TpuDriver(InterpDriver):
         cost_on = obscosts.enabled()
         cost_entries: List[Tuple] = []
 
-        def render(ri, kind, name, constraint, uses_inv, action):
+        def render(ri, kind, name, constraint, uses_inv, action,
+                   join_strict=False):
             violations = self._memo_cell(
                 kind, name, ri, constraint, reviews[ri], rowviews,
                 inventory, uses_inv, ap.row_gen[ri],
             )
+            if join_strict and not violations:
+                # an exact join plan flagged this cell but the oracle
+                # renders nothing: interned-key/aggregate divergence
+                # (counted always; raises under GK_JOIN_ASSERT=1), with
+                # the documented gv-twin corner filtered out
+                self._note_join_false_positive(kind, name, int(ri))
             for v in violations:
                 results.append(
                     Result(
@@ -3593,6 +4002,14 @@ class TpuDriver(InterpDriver):
                 True if tmpl is None
                 else getattr(tmpl.policy, "uses_inventory", True)
             )
+            join_strict = False
+            if uses_inv and self._join_safe(kind):
+                # every inventory read is a classified join plan: the
+                # join index bumps reader row generations when a key
+                # group changes, so rendered results are content-keyed
+                # like inventory-free templates — O(churn) rendering
+                uses_inv = False
+                join_strict = self._join_strict(kind, constraint)
             lst = st.cand[ci]
             sig = None
             if trace is None and not uses_inv and len(lst) <= 512:
@@ -3624,7 +4041,8 @@ class TpuDriver(InterpDriver):
                     break
                 if ri >= R or reviews[ri] is None:
                     continue  # tombstoned row (valid=False on device too)
-                render(ri, kind, name, constraint, uses_inv, action)
+                render(ri, kind, name, constraint, uses_inv, action,
+                       join_strict=join_strict)
                 rendered_cells += 1
             if not capped:
                 totals[ckey] = (len(results) - start, "exact")

@@ -119,6 +119,9 @@ class Vectorizer:
         self.literals: set = set()
         self.str_preds: List[StrPred] = []
         self.exact = True
+        # classified cross-resource aggregates (ops/joinkernel.py),
+        # indexed by JoinCmp.plan_id
+        self.join_plans: List = []
 
     # ---- public ----------------------------------------------------------
 
@@ -148,11 +151,23 @@ class Vectorizer:
             literals=sorted(self.literals),
             exact=self.exact,
             clause_plans=tuple(plans),
+            join_plans=tuple(self.join_plans),
         )
 
     # ---- clause compilation ----------------------------------------------
 
     def _compile_clause(self, rule: Rule):
+        # referential (cross-resource) bodies classify into join plans
+        # FIRST: the generic path below would drop every data.inventory
+        # statement (sound but inexact, and O(inventory) to render).
+        # An unclassified referential clause still falls through to the
+        # generic over-approximation, so recognition failures only cost
+        # performance, never correctness.
+        from .joinkernel import classify_join_clause
+
+        jc = classify_join_clause(self, rule)
+        if jc is not None:
+            return jc, None  # rendered by the interpreter (inventory)
         env: Dict[str, Any] = {}
         conds: List = []
         # guards: rhs terms of recognized non-iteration assignments.  The
@@ -811,6 +826,10 @@ def _flip_unknown_defaults(node):
     from dataclasses import replace
 
     if isinstance(node, Cmp):
+        return replace(node, unknown_default=not node.unknown_default)
+    from .vexpr import JoinCmp
+
+    if isinstance(node, JoinCmp):
         return replace(node, unknown_default=not node.unknown_default)
     if isinstance(node, BoolOp):
         return BoolOp(node.op, tuple(_flip_unknown_defaults(c) for c in node.children))

@@ -113,6 +113,29 @@ class SetCountCmp:
 
 
 @dataclass(frozen=True)
+class JoinCmp:
+    """Cross-resource aggregate comparison (ops/joinkernel.py): the
+    distinct-provider-row count at the row's interned join key, compared
+    to ``rhs`` under the engine's exact total order.  ``plan_id`` indexes
+    the program's ``join_plans``.  Without a join binding on the EvalEnv
+    (the admission/review path and the numpy host tier, where no global
+    inventory is resident) the node resolves to ``unknown_default`` —
+    over-approximation, filtered by the interpreter render."""
+
+    plan_id: int
+    op: str  # == != < <= > >=
+    rhs: Operand
+    slot: bool = False
+    unknown_default: bool = True
+    # duplicate detection: subtract the local row's OWN provider
+    # contribution (1 when the row participates in the aggregate) so
+    # "another object has my key" is exact whether or not the evaluated
+    # row is itself a provider — requires local and remote key columns
+    # to coincide (enforced by the classifier)
+    exclude_self: bool = False
+
+
+@dataclass(frozen=True)
 class AnySlots:
     inner: Tuple[Any, ...]  # conjunction, may reference slot columns
 
@@ -139,7 +162,8 @@ class BoolOp:
 
 
 VNode = Union[
-    Const, Truthy, Cmp, StrPred, AnyParam, SetCountCmp, AnySlots, BoolOp, ReduceSlots
+    Const, Truthy, Cmp, StrPred, AnyParam, SetCountCmp, JoinCmp, AnySlots,
+    BoolOp, ReduceSlots,
 ]
 
 
@@ -159,6 +183,9 @@ class VProgram:
     str_preds: List[StrPred] = field(default_factory=list)
     literals: List[str] = field(default_factory=list)
     exact: bool = True
+    # classified cross-resource aggregates (ops/joinkernel.py JoinPlan),
+    # indexed by JoinCmp.plan_id; () for row-local programs
+    join_plans: Tuple = ()
     # per-clause compiled violation-object (message) plans, parallel to
     # `clauses` (ops/renderplan.py); None entries render via the
     # interpreter.  Deliberately NOT part of structure_key: message
@@ -173,15 +200,18 @@ class VProgram:
         the IR is immutable after vectorize()."""
         key = getattr(self, "_structure_key", None)
         if key is None:
-            key = repr(
-                (
-                    [(c.conds, c.slot_iter) for c in self.clauses],
-                    sorted(s.key for s in self.column_specs),
-                    self.param_scalars,
-                    self.param_arrays,
-                    self.literals,
-                )
+            sig = (
+                [(c.conds, c.slot_iter) for c in self.clauses],
+                sorted(s.key for s in self.column_specs),
+                self.param_scalars,
+                self.param_arrays,
+                self.literals,
             )
+            if self.join_plans:
+                # appended only when present so row-local programs keep
+                # their pre-referential keys (warm AOT caches survive)
+                sig = sig + (self.join_plans,)
+            key = repr(sig)
             self._structure_key = key
         return key
 
@@ -213,6 +243,10 @@ class EvalEnv:
         # host-serving path (ops/npside.py) — same IR, same semantics, no
         # trace/compile.  Everything below goes through env.xp.
         self.xp = xp
+        # cross-resource join binding (ops/joinkernel.py JoinBinding);
+        # None — the review/np paths — resolves every JoinCmp to its
+        # polarity's unknown_default (sound over-approximation)
+        self.joins = None
 
 
 def _operand_arrays(op: Operand, env: EvalEnv, axes: str, pidx=None):
@@ -312,6 +346,8 @@ def _eval_node(node: VNode, env: EvalEnv, axes: str, pidx=None):
         return acc if acc is not None else xp.asarray(False)
     if isinstance(node, SetCountCmp):
         return _eval_setcount(node, env, axes)
+    if isinstance(node, JoinCmp):
+        return _eval_joincmp(node, env, axes, pidx)
     if isinstance(node, BoolOp):
         parts = [_eval_node(c, env, axes, pidx) for c in node.children]
         if node.op == "not":
@@ -481,6 +517,43 @@ def _eval_setcount(node: SetCountCmp, env: EvalEnv, axes: str):
     }[node.op]
 
 
+def _eval_joincmp(node: JoinCmp, env: EvalEnv, axes: str, pidx=None):
+    """Distinct-provider-rows-per-key aggregate vs ``rhs``: one table
+    gather + the exact cross-type comparison.  Key-undefined cells
+    (missing field) compare as undefined, exactly like the interpreter's
+    failed assignment; UNKNOWN_KEY cells (unnormalizable values) resolve
+    to the polarity default so the render filter decides."""
+    xp = env.xp
+    jb = env.joins
+    if jb is None:
+        return xp.asarray(node.unknown_default)
+    from .joinkernel import UNKNOWN_KEY, lookup_counts
+
+    plan = jb.plans[node.plan_id]
+    uk, uc = jb.table(node.plan_id, env)
+    sid = xp.asarray(env.cols[plan.local_colkey]["sid"])
+    q = sid[None]  # [1, R] or [1, R, S]
+    if not plan.local_slot and axes.endswith("S"):
+        q = q[..., None]
+    counts = lookup_counts(uk, uc, q, xp)
+    if node.exclude_self:
+        part = jb.self_mask(node.plan_id, env)  # [R] bool
+        part = xp.where(part, 1, 0)[None]
+        if axes.endswith("S"):
+            part = part[..., None]
+        counts = counts - part
+    lhs = {
+        "tcode": xp.where(q >= 0, T_NUM, T_UNDEF).astype(xp.int8),
+        "sid": xp.full_like(q, -1),
+        # float32 is exact for any row count this engine can pack; jnp
+        # without x64 would noisily truncate an explicit float64 request
+        "num": counts.astype(xp.float64 if xp is np else xp.float32),
+    }
+    rhs = _operand_arrays(node.rhs, env, axes, pidx)
+    res = _cmp_values(lhs, rhs, node.op, node.unknown_default, xp)
+    return xp.where(q == UNKNOWN_KEY, node.unknown_default, res)
+
+
 def _slot_mask(env: EvalEnv, iter_key: Tuple):
     xp = env.xp
     for spec_key, arrs in env.cols.items():
@@ -517,6 +590,8 @@ def eval_program(prog: VProgram, env: EvalEnv):
 
 
 def _clause_uses_slot(node: VNode) -> bool:
+    if isinstance(node, JoinCmp):
+        return node.slot
     if isinstance(node, Truthy):
         return isinstance(node.operand, ColRef) and node.operand.slot
     if isinstance(node, Cmp):

@@ -109,6 +109,15 @@ def multihost_capped_sweep(driver, K: int):
     from ..ops.driver import _merge_sharded_packed
 
     fn, ordered, cp, group_params, crow = driver._audit_inputs(K)
+    if getattr(driver, "_active_join_plans", lambda: ())():
+        # referential join plans take a trailing `joins` runtime arg and
+        # (in trace mode) an all_gather over the in-process mesh axis;
+        # the multi-host lane has not grown that plumbing — fail loudly
+        # rather than sweep with a silently mis-shaped executable
+        raise NotImplementedError(
+            "referential join plans are not supported on the multi-host "
+            "audit lane (docs/referential.md)"
+        )
     ap = driver._audit_pack
     if ap.n_rows == 0:
         return [], None, None

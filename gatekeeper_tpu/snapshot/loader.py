@@ -427,6 +427,32 @@ class SnapshotLoader:
                         "render cache (first sweep re-renders)"
                     )
                     render_cache = {}
+            # referential policies: rebuild the persisted join-group
+            # index (ops/joinkernel.py).  Plan drift — a template change
+            # reclassifying the join families between writer and reader —
+            # or a missing index drops the WHOLE basis: candidates and
+            # counts were produced by the old aggregates, and the delta
+            # path cannot maintain aggregates it has no index for.
+            plans = ()
+            if hasattr(driver, "_active_join_plans"):
+                plans = driver._active_join_plans()
+            join_state = None
+            if plans:
+                from ..ops.joinkernel import JoinState
+
+                ji = delta.get("join_index")
+                join_state = (
+                    JoinState.restore(tuple(plans), ji, ap.rebuild_gen)
+                    if ji else None
+                )
+                if join_state is None:
+                    log.warning(
+                        "snapshot delta basis dropped: referential join "
+                        "plans active but the persisted join index is "
+                        "missing or drifted (first sweep will be a full "
+                        "dispatch)"
+                    )
+                    return False
             # device upload stays lazy: the first sweep with zero churn
             # never needs the mask at all.  Under a mesh the mask commits
             # row-sharded on "data" (the same-width check above guarantees
@@ -459,6 +485,8 @@ class SnapshotLoader:
                 # so the restored basis carries exactly that topology
                 mesh_width=live_width,
             )
+            if join_state is not None:
+                driver._join_state = join_state
         return True
 
     # ---- the whole restore --------------------------------------------------

@@ -126,6 +126,24 @@ class SnapshotWriter:
         ordered_keys = [
             (k, n) for k, n, _c in driver._ordered_constraints()
         ]
+        # referential policies: the delta path needs the host join-group
+        # index (ops/joinkernel.py) — a basis restored without it could
+        # not maintain the aggregates incrementally, so when plans are
+        # active and the index is stale the basis is withheld entirely
+        # (the restart's first sweep rebases via one full dispatch)
+        join_index = None
+        plans = ()
+        if hasattr(driver, "_active_join_plans"):
+            plans = driver._active_join_plans()
+        if plans:
+            js = getattr(driver, "_join_state", None)
+            if (
+                js is None or not js.built
+                or js.rebuild_gen != ap.rebuild_gen
+                or js.sig != tuple(p.sig for p in plans)
+            ):
+                return None
+            join_index = js.persist()
         # compiled message-plan tiers per constraint: the loader re-binds
         # plans after template replay and validates the classification
         # against this map — a drift (e.g. a plan-compiler change between
@@ -153,6 +171,10 @@ class SnapshotWriter:
             },
             "render_cache": dict(st.render_cache),
             "ordered_keys": ordered_keys,
+            # the join-group index (None for row-local corpora): restores
+            # keep the O(churn) delta path for referential policies; the
+            # loader drops the whole basis on plan drift
+            "join_index": join_index,
             # resolved post-lock; a MaskSource is internally locked and
             # its value is pinned to this basis's full sweep
             "mask_src": st.mask_src,

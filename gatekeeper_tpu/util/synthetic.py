@@ -285,6 +285,225 @@ def _load_client(driver, n_templates: int, n_resources: int, seed: int):
     return client
 
 
+# ---------------------------------------------------------------------------
+# Referential corpus (cross-resource join plans, ops/joinkernel.py)
+# ---------------------------------------------------------------------------
+
+REF_FAMILIES = ["uniquehost", "requiredclass", "teamquota"]
+
+
+def _rego_uniquehost(pkg: str) -> str:
+    return f"""
+package {pkg}
+
+violation[{{"msg": msg}}] {{
+  host := input.review.object.spec.rules[_].host
+  other := data.inventory.namespace[_][_]["Ingress"][_]
+  otherhost := other.spec.rules[_].host
+  host == otherhost
+  not identical(other, input.review)
+  msg := sprintf("duplicate ingress host: %v", [host])
+}}
+
+identical(obj, review) {{
+  obj.metadata.namespace == review.object.metadata.namespace
+  obj.metadata.name == review.object.metadata.name
+}}
+"""
+
+
+def _rego_requiredclass(pkg: str) -> str:
+    return f"""
+package {pkg}
+
+violation[{{"msg": msg}}] {{
+  class := input.review.object.spec.storageClassName
+  not class_exists(class)
+  msg := sprintf("storage class %v does not exist", [class])
+}}
+
+class_exists(name) {{
+  sc := data.inventory.cluster[_]["StorageClass"][_]
+  sc.metadata.name == name
+}}
+"""
+
+
+def _rego_teamquota(pkg: str) -> str:
+    return f"""
+package {pkg}
+
+violation[{{"msg": msg}}] {{
+  team := input.review.object.metadata.labels.team
+  n := count({{[ns, ident] | p := data.inventory.namespace[ns][_]["Pod"][ident]; p.metadata.labels.team == team}})
+  n > input.parameters.limit
+  msg := sprintf("team %v has %v pods (limit %v)", [team, n, input.parameters.limit])
+}}
+"""
+
+
+_REF_REGO = {
+    "uniquehost": _rego_uniquehost,
+    "requiredclass": _rego_requiredclass,
+    "teamquota": _rego_teamquota,
+}
+
+_REF_MATCH = {
+    "uniquehost": [{"apiGroups": ["networking.k8s.io"],
+                    "kinds": ["Ingress"]}],
+    "requiredclass": [{"apiGroups": ["*"],
+                       "kinds": ["PersistentVolumeClaim"]}],
+    "teamquota": [{"apiGroups": [""], "kinds": ["Pod"]}],
+}
+
+
+def make_referential_templates(n: int, seed: int = 0):
+    """n referential templates cycling the three join families (each its
+    own CRD kind, so clones batch on the constraint axis of one shared
+    program structure) + one constraint per template."""
+    rng = random.Random(seed)
+    templates, constraints = [], []
+    for i in range(n):
+        family = REF_FAMILIES[i % len(REF_FAMILIES)]
+        kind = f"Ref{family.capitalize()}{i}"
+        pkg = f"ref{family}{i}"
+        templates.append(
+            {
+                "apiVersion": "templates.gatekeeper.sh/v1beta1",
+                "kind": "ConstraintTemplate",
+                "metadata": {"name": kind.lower()},
+                "spec": {
+                    "crd": {"spec": {"names": {"kind": kind}}},
+                    "targets": [
+                        {
+                            "target": "admission.k8s.gatekeeper.sh",
+                            "rego": _REF_REGO[family](pkg),
+                        }
+                    ],
+                },
+            }
+        )
+        params = (
+            {"limit": rng.choice([1, 2, 3, 5])}
+            if family == "teamquota" else {}
+        )
+        constraints.append(
+            {
+                "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+                "kind": kind,
+                "metadata": {"name": f"c-{kind.lower()}"},
+                "spec": {
+                    "match": {"kinds": _REF_MATCH[family]},
+                    "parameters": params,
+                },
+            }
+        )
+    return templates, constraints
+
+
+def make_referential_objects(n: int, seed: int = 1) -> List[dict]:
+    """A mixed inventory the three join families bite on: Ingresses with
+    deliberately colliding hosts, PVCs referencing (sometimes dangling)
+    StorageClasses, and Pods with team labels — a few of them integer
+    values, pinning the typed interned-key normalization (an int team
+    must never pool with its string twin)."""
+    rng = random.Random(seed)
+    objs: List[dict] = [
+        {
+            "apiVersion": "storage.k8s.io/v1",
+            "kind": "StorageClass",
+            "metadata": {"name": scn},
+        }
+        for scn in ("standard", "fast", "gold")
+    ]
+    # realistic clusters converge to compliance: most hosts are unique
+    # (a small shared pool supplies deliberate duplicates), most PVC
+    # references resolve, most teams sit under quota.  Violation rate
+    # lands around a few percent per family.
+    dup_pool = [f"app-{k}.corp.io" for k in range(3)]
+    for i in range(n):
+        ns = f"ns-{i % 10}"
+        pick = i % 3
+        if pick == 0:
+            if rng.random() < 0.04:
+                rules = [{"host": rng.choice(dup_pool)}]
+            else:
+                rules = [{"host": f"svc-{i}.corp.io"}]
+            if rng.random() < 0.2:
+                rules.append({"host": f"alt-{i}.corp.io"})
+            objs.append({
+                "apiVersion": "networking.k8s.io/v1",
+                "kind": "Ingress",
+                "metadata": {"name": f"ing-{i}", "namespace": ns},
+                "spec": {"rules": rules},
+            })
+        elif pick == 1:
+            cls = (
+                f"missing-{i % 7}" if rng.random() < 0.05
+                else rng.choice(["standard", "fast", "gold"])
+            )
+            objs.append({
+                "apiVersion": "v1",
+                "kind": "PersistentVolumeClaim",
+                "metadata": {"name": f"pvc-{i}", "namespace": ns},
+                "spec": {"storageClassName": cls},
+            })
+        else:
+            # "crowded" (and the int-vs-str twins) exceed the quota on
+            # bigger corpora; the per-pod teams stay under it
+            r = rng.random()
+            if r < 0.015:
+                team = "crowded"
+            elif r < 0.02:
+                team = 5
+            elif r < 0.025:
+                team = "5"
+            else:
+                team = f"team-{i}"
+            objs.append({
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "metadata": {
+                    "name": f"pod-{i}", "namespace": ns,
+                    "labels": {"team": team},
+                },
+                "spec": {"containers": [{"name": "c", "image": "r/i:1"}]},
+            })
+    return objs
+
+
+def _load_referential(driver, n_templates: int, n_resources: int,
+                      seed: int):
+    from ..client.client import Client
+
+    templates, constraints = make_referential_templates(n_templates, seed)
+    client = Client(driver=driver)
+    for t in templates:
+        client.add_template(t)
+    for c in constraints:
+        client.add_constraint(c)
+    for o in make_referential_objects(n_resources, seed + 1):
+        client.add_data(o)
+    return client
+
+
+def build_referential_driver(n_templates: int, n_resources: int,
+                             seed: int = 0):
+    """A TpuDriver loaded with the referential workload."""
+    from ..ops.driver import TpuDriver
+
+    return _load_referential(TpuDriver(), n_templates, n_resources, seed)
+
+
+def build_referential_oracle(n_templates: int, n_resources: int,
+                             seed: int = 0):
+    """The interpreter-oracle twin over the identical corpus (own
+    instance — see build_oracle)."""
+    from ..client.drivers import InterpDriver
+
+    return _load_referential(InterpDriver(), n_templates, n_resources, seed)
+
+
 def audit_result_sig(results):
     """Canonical order-independent signature of audit results for
     byte-parity comparisons (constraint kind+name, rendered message,

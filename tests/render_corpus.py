@@ -354,3 +354,118 @@ def review_of(obj, namespace=None):
     if ns:
         r["namespace"] = ns
     return r
+
+
+# ---- referential (cross-resource join) scenarios ---------------------------
+# Rendered through the interpreter with a real inventory (join plans
+# produce the mask; rendering is the oracle by construction), so the
+# parity suite drives these end-to-end driver-vs-oracle instead of
+# plan.apply().  Each entry: (name, template, constraint, objects) where
+# `objects` is the inventory the scenario audits.
+
+_JOIN_UNIQUE_HOST = """
+package k8suniqueingresshost
+
+violation[{"msg": msg}] {
+  host := input.review.object.spec.rules[_].host
+  other := data.inventory.namespace[_][_]["Ingress"][_]
+  otherhost := other.spec.rules[_].host
+  host == otherhost
+  not identical(other, input.review)
+  msg := sprintf("duplicate ingress host: %v", [host])
+}
+
+identical(obj, review) {
+  obj.metadata.namespace == review.object.metadata.namespace
+  obj.metadata.name == review.object.metadata.name
+}
+"""
+
+_JOIN_REQUIRED_CLASS = """
+package k8srequiredstorageclass
+
+violation[{"msg": msg}] {
+  class := input.review.object.spec.storageClassName
+  not class_exists(class)
+  msg := sprintf("storage class %v does not exist", [class])
+}
+
+class_exists(name) {
+  sc := data.inventory.cluster[_]["StorageClass"][_]
+  sc.metadata.name == name
+}
+"""
+
+_JOIN_TEAM_QUOTA = """
+package k8steamquota
+
+violation[{"msg": msg}] {
+  team := input.review.object.metadata.labels.team
+  n := count({[ns, ident] | p := data.inventory.namespace[ns][_]["Pod"][ident]; p.metadata.labels.team == team})
+  n > input.parameters.limit
+  msg := sprintf("team %v has %v pods (limit %v)", [team, n, input.parameters.limit])
+}
+"""
+
+
+def _ingress(name, ns, hosts):
+    return {
+        "apiVersion": "networking.k8s.io/v1", "kind": "Ingress",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {"rules": [{"host": h} for h in hosts]},
+    }
+
+
+def _join_match(kind_name, groups):
+    return {"kinds": [{"apiGroups": groups, "kinds": [kind_name]}]}
+
+
+def join_corpus():
+    """Referential scenarios for the parity suite: unicode hosts,
+    duplicate slots within one object (self never duplicates itself),
+    dangling and present references, and int-vs-str quota keys (the
+    interned-key normalization satellite)."""
+    unique_objs = [
+        _ingress("ing-a", "ns-1", ["app.corp.io", "dup-🌍.corp.io"]),
+        _ingress("ing-b", "ns-2", ["dup-🌍.corp.io"]),
+        _ingress("ing-c", "ns-1", ["solo.corp.io", "solo.corp.io"]),
+        _ingress("ing-d", "défault", ["ünïque.corp.io"]),
+    ]
+    class_objs = [
+        {"apiVersion": "storage.k8s.io/v1", "kind": "StorageClass",
+         "metadata": {"name": "standard"}},
+        {"apiVersion": "v1", "kind": "PersistentVolumeClaim",
+         "metadata": {"name": "ok", "namespace": "ns-1"},
+         "spec": {"storageClassName": "standard"}},
+        {"apiVersion": "v1", "kind": "PersistentVolumeClaim",
+         "metadata": {"name": "dangling-ütf", "namespace": "ns-1"},
+         "spec": {"storageClassName": "missing-klässe"}},
+        {"apiVersion": "v1", "kind": "PersistentVolumeClaim",
+         "metadata": {"name": "no-field", "namespace": "ns-1"},
+         "spec": {}},
+    ]
+    quota_objs = [
+        {"apiVersion": "v1", "kind": "Pod",
+         "metadata": {"name": f"q-{i}", "namespace": "ns-1",
+                      "labels": {"team": team}},
+         "spec": {}}
+        for i, team in enumerate([5, 5, 5, "5", "tëam-ü", "tëam-ü"])
+    ]
+    unique_t = _template("K8sUniqueIngressHost", _JOIN_UNIQUE_HOST)
+    unique_c = _constraint("K8sUniqueIngressHost", {})
+    unique_c["spec"]["match"] = _join_match(
+        "Ingress", ["networking.k8s.io"]
+    )
+    class_t = _template("K8sRequiredStorageClass", _JOIN_REQUIRED_CLASS)
+    class_c = _constraint("K8sRequiredStorageClass", {})
+    class_c["spec"]["match"] = _join_match(
+        "PersistentVolumeClaim", ["*"]
+    )
+    quota_t = _template("K8sTeamQuota", _JOIN_TEAM_QUOTA)
+    quota_c = _constraint("K8sTeamQuota", {"limit": 2})
+    quota_c["spec"]["match"] = _join_match("Pod", [""])
+    return [
+        ("join-unique-host", unique_t, unique_c, unique_objs),
+        ("join-required-class", class_t, class_c, class_objs),
+        ("join-team-quota", quota_t, quota_c, quota_objs),
+    ]

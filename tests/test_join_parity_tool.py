@@ -1,0 +1,72 @@
+"""Tier-1 wiring for tools/check_join_parity.py: the referential
+(cross-resource join) conformance sweep — plan classification, width-1 vs
+width-4 vs interpreter-oracle byte parity, and key-group churn locality —
+runs on every test invocation, so a join-kernel regression fails fast,
+before it could ship wrong audit results.  The conftest's 8 virtual CPU
+devices make the width-4 mesh available in-process."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+import check_join_parity as chk  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _arm_join_assert(monkeypatch):
+    """The tool's contract: divergences raise instead of being silently
+    filtered by the render path."""
+    monkeypatch.setenv("GK_JOIN_ASSERT", "1")
+
+
+def test_repo_join_kernels_are_conformant():
+    assert chk.run_checks() == []
+
+
+def test_parity_detector_flags_aggregate_divergence(monkeypatch):
+    """A broken per-key count (off by one) must be detected as a result
+    divergence, not silently absorbed."""
+    from gatekeeper_tpu.ops import joinkernel as jk
+
+    orig = jk.lookup_counts
+
+    def broken(uk, uc, q, xp):
+        return orig(uk, uc, q, xp) + 1
+
+    monkeypatch.setattr(jk, "lookup_counts", broken)
+    # the render filter hides over-approximation, but GK_JOIN_ASSERT
+    # turns the flagged-but-empty cells into a raised divergence
+    with pytest.raises(jk.JoinDivergence):
+        chk.check_width_parity()
+
+
+def test_locality_detector_flags_full_resweeps(monkeypatch):
+    """If the delta path stopped serving referential churn (every sweep
+    a full dispatch again), the locality check trips."""
+    from gatekeeper_tpu.ops.driver import TpuDriver
+
+    monkeypatch.setattr(TpuDriver, "_try_delta", lambda self, K: None)
+    problems = chk.check_churn_locality()
+    assert problems and "churn locality" in problems[0]
+
+
+def test_locality_detector_flags_group_overreach(monkeypatch):
+    """If churn started invalidating MORE than its key group (the
+    O(churn) contract broken), the pinned dispatch count trips."""
+    from gatekeeper_tpu.ops.joinkernel import JoinState
+
+    orig = JoinState.commit
+
+    def overreach(self, ap, interner, dirty):
+        out = orig(self, ap, interner, dirty)
+        extra = {r for r in range(ap.n_rows)
+                 if ap.reviews[r] is not None} - set(dirty)
+        ap.bump_row_gen(extra - out)
+        return extra
+
+    monkeypatch.setattr(JoinState, "commit", overreach)
+    problems = chk.check_churn_locality()
+    assert problems and any("churn locality" in p for p in problems)

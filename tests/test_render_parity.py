@@ -203,3 +203,62 @@ def test_plan_disabled_kill_switch():
             (r.msg, r.metadata) for r in b
         ]
     assert off.driver._tier_counts == {"static": 0, "slots": 0, "interp": 0}
+
+
+class TestJoinScenarios:
+    """Referential (cross-resource) corpus entries: join plans produce
+    the mask, the interpreter renders with the inventory — the parity
+    bar is full-stack driver-vs-oracle byte identity per scenario, under
+    the armed divergence assertion."""
+
+    @pytest.mark.parametrize(
+        "name", [e[0] for e in __import__(
+            "tests.render_corpus", fromlist=["join_corpus"]
+        ).join_corpus()],
+    )
+    def test_join_scenario_audit_byte_parity(self, name, monkeypatch):
+        monkeypatch.setenv("GK_JOIN_ASSERT", "1")
+        from gatekeeper_tpu.client.client import Client
+        from gatekeeper_tpu.client.drivers import InterpDriver
+        from gatekeeper_tpu.ops.driver import TpuDriver
+        from gatekeeper_tpu.util.synthetic import audit_result_sig
+
+        from .render_corpus import join_corpus
+
+        _n, template, constraint, objects = next(
+            e for e in join_corpus() if e[0] == name
+        )
+        # the scenario must classify into a join plan, not interp fallback
+        pol = _policy(template)
+        prog = vectorize(pol)
+        assert prog is not None and prog.join_plans and prog.exact
+
+        def load(driver):
+            c = Client(driver=driver)
+            c.add_template(template)
+            c.add_constraint(constraint)
+            for o in objects:
+                c.add_data(dict(o))
+            return c
+
+        tpu, oracle = load(TpuDriver()), load(InterpDriver())
+        res, totals, _ = tpu.driver.audit_capped(4096)
+        ores, ototals, _ = oracle.driver.audit_capped(4096)
+        assert audit_result_sig(res) == audit_result_sig(ores)
+        assert totals == ototals
+
+    def test_join_scenarios_produce_violations(self):
+        """Vacuity guard: every scenario must violate somewhere."""
+        from gatekeeper_tpu.client.client import Client
+        from gatekeeper_tpu.client.drivers import InterpDriver
+
+        from .render_corpus import join_corpus
+
+        for name, template, constraint, objects in join_corpus():
+            c = Client(driver=InterpDriver())
+            c.add_template(template)
+            c.add_constraint(constraint)
+            for o in objects:
+                c.add_data(dict(o))
+            res, _t, _ = c.driver.audit_capped(4096)
+            assert res, f"{name} produced no violations"
