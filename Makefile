@@ -37,6 +37,10 @@ lint:  ## gklint invariants + observability/parity conformance checks
 .PHONY: obs-check
 obs-check: lint  ## observability conformance + gklint (alias of lint so the two never drift)
 
+.PHONY: replay-check
+replay-check:  ## decision-log differential-replay selftest (zero drift + seeded GK_BUG_COMPAT drift flagged)
+	python tools/replay_decisions.py --selftest
+
 .PHONY: lint-baseline
 lint-baseline:  ## accept current gklint findings into .gklint-baseline.json
 	python tools/gklint.py --write-baseline

@@ -145,6 +145,10 @@ class AuditManager:
         # sweep is the one moment the packed inventory is exactly synced
         # to the store, so each success re-arms the background writer
         self.snapshotter = snapshotter
+        # decision-log transition basis (obs/decisionlog.py): the
+        # previous sweep's reported violation keys, diffed each sweep so
+        # the archive records new/resolved DELTAS, never the full set
+        self._prev_violation_keys: Optional[set] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -331,6 +335,7 @@ class AuditManager:
                     constraint_kinds, update_lists, timestamp,
                     totals_per_constraint, totals_exact,
                 )
+            self._record_transitions(update_lists, timestamp)
             return update_lists
         finally:
             dur = time.monotonic() - t0
@@ -345,6 +350,39 @@ class AuditManager:
             _span_ctx.__exit__(*_sys.exc_info())
 
     # ---- helpers -----------------------------------------------------------
+
+    def _record_transitions(self, update_lists, timestamp):
+        """Decision-log feed (obs/decisionlog.py): diff this sweep's
+        REPORTED violation set (update_lists — per-constraint capped at
+        violations_limit, the same set the status writes publish)
+        against the previous sweep's, and record only the new/resolved
+        deltas.  A restart's first sweep reports everything as new (no
+        basis).  Guarded: provenance must never fail the sweep."""
+        try:
+            from ..obs import decisionlog as obsdlog
+
+            # the O(reported violations) digest + diff below is pure
+            # decision-log feed work — skip it entirely when recording
+            # is off (the next enabled sweep reports all-new, same as a
+            # restart's first sweep)
+            if not obsdlog.get_log().record_enabled:
+                self._prev_violation_keys = None
+                return
+            cur = set()
+            for ck, violations in update_lists.items():
+                for v in violations:
+                    cur.add((ck, v.kind, v.namespace, v.name,
+                             obsdlog.message_digest(v.message)))
+            prev = self._prev_violation_keys
+            self._prev_violation_keys = cur
+            if prev is None:
+                prev = set()
+            new = sorted(cur - prev)
+            resolved = sorted(prev - cur)
+            if new or resolved:
+                obsdlog.record_audit_transitions(new, resolved, timestamp)
+        except Exception:
+            log.exception("could not record decision-log transitions")
 
     # last_sweep_stats keys the audit owner republishes (sharded-path
     # shape: mesh width, per-shard work, steady-state churn row count)

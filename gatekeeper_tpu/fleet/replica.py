@@ -88,6 +88,11 @@ def _child_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-seed-namespaces", action="store_true",
                    help="do not create Namespace objects for restored "
                         "pack rows in the local in-memory store")
+    p.add_argument("--decision-log-dir", default="",
+                   help="shared fleet decision-log directory "
+                        "(docs/decision-logs.md): each replica writes "
+                        "its own decisions-<replica_id>-* segments; "
+                        "also inherited via $GK_DECISION_LOG_DIR")
     return p
 
 
@@ -320,6 +325,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         flags += ["--webhook-max-pending", str(args.webhook_max_pending)]
     if args.admission_fail_open:
         flags += ["--admission-fail-open"]
+    dlog_dir = (args.decision_log_dir
+                or os.environ.get("GK_DECISION_LOG_DIR", ""))
+    if dlog_dir:
+        # per-replica segments under the shared fleet dir: the segment
+        # names carry the replica id, and retention prunes own files
+        # only (docs/decision-logs.md).  The env spelling gets the SAME
+        # sealed posture as the flag — the child would otherwise pick
+        # the dir up from its parser default with seal off
+        flags += ["--decision-log-dir", dlog_dir, "--decision-log-seal"]
     app = App(build_parser().parse_args(flags), kube=InMemoryKube())
     app.start()
     try:

@@ -175,6 +175,33 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--flightrec-size", type=int,
                    default=env_flightrec_size(),
                    help="bounded flight-recorder event ring size")
+    # decision log (ISSUE 15, docs/decision-logs.md): durable verdict
+    # provenance — admission verdicts + audit violation transitions
+    # flushed into NDJSON segments under a (fleet-shared) directory
+    p.add_argument("--decision-log-dir",
+                   default=os.environ.get("GK_DECISION_LOG_DIR", ""),
+                   help="directory for decision-log segments (per-replica "
+                        "files under a shared fleet dir); empty keeps the "
+                        "in-memory /debug/decisionz ring only")
+    p.add_argument("--decision-log-sample-rate", type=float, default=1.0,
+                   help="head-sampling rate for ALLOW verdicts; denials, "
+                        "sheds, expiries, errors, degraded-route and slow "
+                        "decisions are always kept")
+    p.add_argument("--decision-log-seal", action="store_true",
+                   help="HMAC-chain every record under the shared seal "
+                        "key (util/seal.py GK_SEAL_KEY) for tamper "
+                        "evidence; verified by tools/replay_decisions.py")
+    p.add_argument("--decision-log-retain", type=int, default=16,
+                   help="completed decision segments kept per replica "
+                        "(oldest pruned after each rotation)")
+    p.add_argument("--decision-log-mask", action="append", default=[],
+                   help="dot-path masked out of each record before "
+                        "serialization (repeatable; e.g. "
+                        "request.userInfo) — masked records are skipped "
+                        "by differential replay")
+    p.add_argument("--decision-log-disable", action="store_true",
+                   help="disable decision recording entirely (the "
+                        "/debug/decisionz ring included)")
     # graceful degradation (docs/failure-modes.md)
     p.add_argument("--admission-deadline-budget-ms", type=float, default=0.0,
                    help="per-request admission deadline budget in ms; work "
@@ -588,6 +615,25 @@ class App:
         )
         if getattr(args, "flightrec_dir", ""):
             flightrec.get_recorder().install_exit_hook()
+        # decision log (obs/decisionlog.py, docs/decision-logs.md):
+        # verdict provenance recording starts before the webhook serves
+        # so the very first admission decision is archived
+        from .obs import decisionlog as obsdlog
+
+        # empty dir DETACHES (configure: dir="" -> None, dir=None ->
+        # unchanged): the recorder is process-global, so an App started
+        # without the flag must not inherit a prior run's archive dir
+        dlog = obsdlog.get_log().configure(
+            dir=getattr(args, "decision_log_dir", ""),
+            sample_rate=getattr(args, "decision_log_sample_rate", 1.0),
+            seal=getattr(args, "decision_log_seal", False),
+            retain=getattr(args, "decision_log_retain", 16),
+            mask_fields=getattr(args, "decision_log_mask", []) or [],
+        )
+        dlog.record_enabled = not getattr(
+            args, "decision_log_disable", False)
+        if dlog.record_enabled:
+            dlog.start()
         # cert bootstrap gates everything (main.go:219-220); write_cert_files
         # runs ensure_certs synchronously, so readiness is set before start()
         # spins the refresh thread
@@ -968,6 +1014,11 @@ class App:
         unpin = getattr(self.client.driver, "set_brownout_pin", None)
         if unpin is not None:
             unpin(False)  # defensive: also covers --brownout-disable
+        # decision log: flush queued records and rotate the open segment
+        # so a stopped process leaves no invisible .open tail behind
+        from .obs import decisionlog as obsdlog
+
+        obsdlog.get_log().stop()
         self.manager.stop()
 
     def run_forever(self):

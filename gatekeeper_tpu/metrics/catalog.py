@@ -389,6 +389,34 @@ XLA_COUNTERS_M = Measure(
     "on this build (cache_requests_total{cache=xlacache} is live), 0 "
     "when they are absent and that instrumentation is silently missing",
 )
+# ---- decision log (ISSUE 15) ------------------------------------------------
+# Durable verdict provenance (obs/decisionlog.py): record/drop accounting
+# for the non-blocking decision recorder — a dropped record is an audit
+# gap and must be visible, never silent (the telemetry-drop contract).
+DECISION_RECORDS_M = Measure(
+    "decision_log_records",
+    "Decision records accepted by the recorder, by decision class "
+    "(allow, deny, shed, expired, error) or 'audit_transition' — "
+    "sampled-out records count in decision_log_dropped_total instead",
+)
+DECISION_DROPPED_M = Measure(
+    "decision_log_dropped",
+    "Decision records not written, by reason (sampled_out: head "
+    "sampling; queue_full: bounded-queue shed; write_error: disk "
+    "failure; transition_overflow: per-sweep transition cap) — every "
+    "drop is counted, never silent",
+)
+DECISION_SEGMENTS_M = Measure(
+    "decision_log_segments",
+    "Completed decision-log segments made visible by the writer's "
+    "atomic rename (rotation by size/time; bounded retention prunes "
+    "this replica's own oldest segments)",
+)
+DECISION_BYTES_M = Measure(
+    "decision_log_bytes",
+    "Bytes of decision records committed into completed segments",
+    unit="By",
+)
 PROFILER_SAMPLES_M = Measure(
     "profiler_samples",
     "Thread-stack samples collected by the always-on sampling profiler "
@@ -556,6 +584,13 @@ def catalog_views():
              tag_keys=("component",)),
         View("xlacache_counters_available", XLA_COUNTERS_M,
              AGG_LAST_VALUE),
+        View("decision_log_records_total", DECISION_RECORDS_M, AGG_COUNT,
+             tag_keys=("class",)),
+        View("decision_log_dropped_total", DECISION_DROPPED_M, AGG_COUNT,
+             tag_keys=("reason",)),
+        View("decision_log_segments_total", DECISION_SEGMENTS_M,
+             AGG_COUNT),
+        View("decision_log_bytes_total", DECISION_BYTES_M, AGG_COUNT),
     ]
 
 
@@ -1098,6 +1133,42 @@ def record_xla_counters_available(ok: bool):
         _global().record(XLA_COUNTERS_M, 1.0 if ok else 0.0)
     except Exception:  # telemetry never blocks cache setup
         record_dropped("record_xla_counters_available")
+
+
+def record_decision_record(dclass: str, n: int = 1):
+    """n decision records accepted by the decision log in one batch
+    (decision_log_records_total{class}; obs/decisionlog.py flushes its
+    hot-path counts batched)."""
+    if n <= 0:
+        return
+    try:
+        _global().record(DECISION_RECORDS_M, float(n), {"class": dclass},
+                         count=n)
+    except Exception:  # telemetry never blocks the verdict
+        record_dropped("record_decision_record")
+
+
+def record_decision_dropped(reason: str, n: int = 1):
+    """n decision records not written, by reason
+    (decision_log_dropped_total{reason}) — sampling, queue sheds and
+    write failures are all counted drops, never silent."""
+    if n <= 0:
+        return
+    try:
+        _global().record(DECISION_DROPPED_M, float(n), {"reason": reason},
+                         count=n)
+    except Exception:  # telemetry never blocks the verdict
+        record_dropped("record_decision_dropped")
+
+
+def record_decision_segment(nbytes: int):
+    """One completed decision-log segment of nbytes committed."""
+    try:
+        _global().record(DECISION_SEGMENTS_M, 1.0)
+        _global().record(DECISION_BYTES_M, float(nbytes),
+                         count=max(int(nbytes), 0))
+    except Exception:  # telemetry never blocks rotation
+        record_dropped("record_decision_segment")
 
 
 def record_cache(cache: str, hit: bool, n: int = 1):

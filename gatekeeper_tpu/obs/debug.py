@@ -20,6 +20,9 @@ Endpoints (docs/tracing.md):
   /debug/flightrecz?limit=&dump= flight-recorder event ring; dump=1 also
                                  writes the on-disk artifact
                                  (obs/flightrec.py)
+  /debug/decisionz?limit=&verdict= recent decision records (ring mirror)
+                                 + recorder stats; verdict filters by
+                                 decision class (obs/decisionlog.py)
   /debug/fleet-traces?min_ms=    assembled cross-process traces — present
                                  only where a fleet TraceCollector is
                                  installed (obs/fleetobs.py)
@@ -80,6 +83,7 @@ class DebugRouter:
             "/debug/routez": self._routez,
             "/debug/compilez": self._compilez,
             "/debug/flightrecz": self._flightrecz,
+            "/debug/decisionz": self._decisionz,
         }
 
     def endpoints(self) -> List[str]:
@@ -186,6 +190,23 @@ class DebugRouter:
         if do_dump:
             payload["dumped_to"] = rec.dump("debug_endpoint")
         return _json(200, payload)
+
+
+    def _decisionz(self, q) -> Response:
+        from . import decisionlog
+
+        limit = _num(q, "limit", int, None)
+        if limit is not None and limit < 0:
+            raise BadParam("limit must be a non-negative integer")
+        verdict = q.get("verdict", [None])[0]
+        if verdict is not None and verdict not in decisionlog.CLASSES:
+            raise BadParam(
+                "verdict must be one of "
+                + ", ".join(decisionlog.CLASSES)
+            )
+        return _json(200, decisionlog.get_log().snapshot(
+            limit=limit, verdict=verdict,
+        ))
 
 
 _ROUTER = DebugRouter()

@@ -86,6 +86,9 @@ class RouteLedger:
         self._counts: Dict[Tuple[str, str], int] = {}
         self._last_tier: Optional[str] = None
         self.flips = 0
+        #: newest decision's (tier, reason) — assigned atomically so the
+        #: decision log reads it lock-free per admission record
+        self.last_decision: Optional[Tuple[str, str]] = None
 
     def attach(self, driver) -> "RouteLedger":
         """Bind the owning driver (weakly: test suites create hundreds of
@@ -144,6 +147,7 @@ class RouteLedger:
                 wins[tier] = wins.get(tier, 0) + 1
             key = (tier, reason)
             self._counts[key] = self._counts.get(key, 0) + 1
+            self.last_decision = (tier, reason)
             if track_flips:
                 if self._last_tier is not None and self._last_tier != tier:
                     flipped = (self._last_tier, tier)
@@ -162,6 +166,13 @@ class RouteLedger:
             )
 
     # ---- retrieval ---------------------------------------------------------
+
+    def last(self) -> Optional[Tuple[str, str]]:
+        """The newest decision's (tier, reason), or None before any —
+        the decision log stamps this onto each admission record as the
+        route attribution of the batch that served it
+        (obs/decisionlog.py)."""
+        return self.last_decision
 
     def tier_wins(self) -> List[dict]:
         """The per-shape tier-win table, smallest shape first."""
@@ -243,6 +254,7 @@ class RouteLedger:
             self._shape_overflow = 0
             self._last_tier = None
             self.flips = 0
+            self.last_decision = None
 
 
 # the most recently attached ledger, weakly held: `/debug/routez` serves

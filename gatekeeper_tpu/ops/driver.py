@@ -1027,6 +1027,98 @@ class TpuDriver(InterpDriver):
             return
         joinkernel.note_false_positive(kind, name, ri)
 
+    def _join_render_inventory(self, kind: str, rows) -> Optional[object]:
+        """ONE grouped inventory for rendering this kind's flagged join
+        cells (the PR 14 REMAINING item, docs/referential.md): the
+        interpreter re-runs the Rego body per flagged cell, and its
+        ``data.inventory`` iterate walks the FULL provider collection —
+        O(R) per cell.  For a join-safe kind (every inventory read is an
+        exact classified plan) the verdict and message depend only on
+        the provider rows in the flagged readers' key groups, so one
+        pass builds a pruned tree holding exactly those rows and every
+        flagged cell renders byte-identically against it — total render
+        cost O(flagged + union of group sizes), not O(flagged x R).
+
+        Returns the frozen pruned tree, or None when equivalence cannot
+        be proven (no current join index, unknown plan, provider row
+        outside the pack) — the caller then falls back to the full
+        inventory.  Soundness backstop: a pruning defect surfaces as a
+        flagged-but-renders-empty cell, which the GK_JOIN_ASSERT-armed
+        divergence assertion (and tools/check_join_parity.py, tier-1)
+        turns into a loud failure, never a silent wrong message."""
+        js = self._join_state
+        prog = self.programs.get(kind)
+        if js is None or not js.built or prog is None:
+            return None
+        plans = getattr(prog, "join_plans", ()) or ()
+        if not plans:
+            return None
+        ap = self._audit_pack
+        reviews = ap.reviews
+        by_sig = {p.sig: i for i, p in enumerate(js.plans)}
+        provider_rows: set = set()
+        for plan in plans:
+            i = by_sig.get(plan.sig)
+            if i is None:
+                return None  # index predates this plan set: rebase path
+            row_rkeys = js.row_rkeys[i]
+            providers = js.providers[i]
+            keys: set = set()
+            for r in rows:
+                keys.update(row_rkeys.get(int(r), ()))
+            for k in keys:
+                provider_rows |= providers.get(k, set())
+        tree: Dict[str, dict] = {}
+        for ri in sorted(provider_rows):
+            if ri >= len(reviews):
+                return None  # index/pack drift: never render against it
+            rev = reviews[ri]
+            if rev is None:
+                continue  # tombstoned provider: contributes nothing
+            obj = rev.get("object")
+            if not isinstance(obj, (dict,)) and not hasattr(obj, "get"):
+                return None
+            meta = obj.get("metadata") or {}
+            api = obj.get("apiVersion") or ""
+            okind = obj.get("kind") or ""
+            name = meta.get("name") or ""
+            # placement mirrors target.py inventory_segments: the
+            # OBJECT's namespace decides cluster- vs namespace-scope
+            ns = meta.get("namespace") or ""
+            if ns:
+                node = (
+                    tree.setdefault("namespace", {})
+                    .setdefault(ns, {})
+                    .setdefault(api, {})
+                    .setdefault(okind, {})
+                )
+            else:
+                node = (
+                    tree.setdefault("cluster", {})
+                    .setdefault(api, {})
+                    .setdefault(okind, {})
+                )
+            node[name] = obj
+        from ..engine.value import freeze
+
+        return freeze(tree)
+
+    def _lazy_join_inventory(self, kind: str, rows, full_inventory):
+        """Thunk form of _join_render_inventory, memoized on first call:
+        the grouped-tree build runs only when a cell actually MISSES the
+        render memo — a steady-state sweep whose join cells all replay
+        cached renders never pays it.  Falls back to the full inventory
+        when pruning cannot be proven equivalent."""
+        box: list = []
+
+        def get():
+            if not box:
+                pruned = self._join_render_inventory(kind, rows)
+                box.append(full_inventory if pruned is None else pruned)
+            return box[0]
+
+        return get
+
     def join_plan_shapes(self) -> List[dict]:
         """Join-plan observability summary (served by /debug/routez via
         the route ledger, obs/routeledger.py)."""
@@ -3417,6 +3509,29 @@ class TpuDriver(InterpDriver):
             inventory = self._inventory_for_render()
             results: List[Result] = []
             trace: List[str] = [] if tracing else None
+            # grouped join renders (docs/referential.md): per join-safe
+            # kind, ONE pruned inventory over the union of its flagged
+            # rows — the interpreter's per-cell O(R) inventory walk
+            # becomes O(group); built lazily on the kind's first cell
+            kind_cis: Dict[str, list] = {}
+            for i, (k, _n, _c) in enumerate(ordered):
+                kind_cis.setdefault(k, []).append(i)
+            join_inv: Dict[str, object] = {}
+
+            def _inv_for(kind):
+                got = join_inv.get(kind)
+                if got is None:
+                    got = inventory
+                    if self._join_safe(kind):
+                        rows = np.nonzero(
+                            mask[kind_cis[kind]].any(axis=0)
+                        )[0]
+                        pruned = self._join_render_inventory(kind, rows)
+                        if pruned is not None:
+                            got = pruned
+                    join_inv[kind] = got
+                return got
+
             # resource-major order, matching InterpDriver.audit; only
             # reviews with a positive cell pay any render cost (plan
             # cells skip even the freeze — the RowView freezes lazily,
@@ -3430,7 +3545,7 @@ class TpuDriver(InterpDriver):
                 for i in np.nonzero(mask[:, ri])[0]:
                     kind, name, constraint = ordered[i]
                     violations = self._cell_violations(
-                        constraint, kind, review, None, inventory,
+                        constraint, kind, review, None, _inv_for(kind),
                         rowview=rowview,
                     )
                     if not violations and self._join_strict(
@@ -3471,6 +3586,10 @@ class TpuDriver(InterpDriver):
             hit = self._render_memo.get(mkey)
             if hit is not None and hit[0] == row_gen:
                 return hit[1]
+        if callable(inventory):
+            # lazy grouped join inventory (_lazy_join_inventory):
+            # resolved only on this miss path, never on a memo hit
+            inventory = inventory()
         row = rowviews.get(ri)
         if row is None:
             from .renderplan import RowView
@@ -3947,10 +4066,11 @@ class TpuDriver(InterpDriver):
         cost_entries: List[Tuple] = []
 
         def render(ri, kind, name, constraint, uses_inv, action,
-                   join_strict=False):
+                   join_strict=False, inv=None):
             violations = self._memo_cell(
                 kind, name, ri, constraint, reviews[ri], rowviews,
-                inventory, uses_inv, ap.row_gen[ri],
+                inventory if inv is None else inv, uses_inv,
+                ap.row_gen[ri],
             )
             if join_strict and not violations:
                 # an exact join plan flagged this cell but the oracle
@@ -3991,6 +4111,29 @@ class TpuDriver(InterpDriver):
             for ri in full[len(lst):]:
                 yield ri
 
+        def _join_complete(ci):
+            # complete candidate knowledge: the union below must cover
+            # the constraint's readers, and candidates() never extends
+            # st.cand past this exact condition
+            return (st.horizon[ci] is None
+                    or int(st.counts[ci]) <= len(st.cand[ci]))
+
+        # ONE pruned join inventory per kind, shared by its constraints
+        # (the full-sweep path's _inv_for argument: a provider SUPERSET
+        # is equivalence-safe, so the union of the kind's candidate
+        # rows serves every constraint) — K same-kind constraints
+        # missing the memo in one sweep build one tree, not K
+        join_union: Dict[str, set] = {}
+        for ci, (kind, _name, _c) in enumerate(ordered):
+            if (int(st.counts[ci]) == 0 or not self._join_safe(kind)
+                    or not _join_complete(ci)):
+                continue
+            tmpl = self.templates.get(kind)
+            if tmpl is None or getattr(tmpl.policy, "uses_inventory",
+                                       True):
+                join_union.setdefault(kind, set()).update(st.cand[ci])
+        join_inv_by_kind: Dict[str, object] = {}
+
         for ci, (kind, name, constraint) in enumerate(ordered):
             ckey = (kind, name)
             n_cand = int(st.counts[ci])
@@ -4003,6 +4146,7 @@ class TpuDriver(InterpDriver):
                 else getattr(tmpl.policy, "uses_inventory", True)
             )
             join_strict = False
+            join_inv = None
             if uses_inv and self._join_safe(kind):
                 # every inventory read is a classified join plan: the
                 # join index bumps reader row generations when a key
@@ -4010,6 +4154,23 @@ class TpuDriver(InterpDriver):
                 # like inventory-free templates — O(churn) rendering
                 uses_inv = False
                 join_strict = self._join_strict(kind, constraint)
+                if _join_complete(ci):
+                    # grouped interpreter pass (docs/referential.md):
+                    # every flagged cell renders against ONE pruned
+                    # inventory holding the kind's key groups' provider
+                    # rows — the interp's O(R) per-cell inventory walk
+                    # becomes O(group).  LAZY: built on the first
+                    # render MISS, so steady-state memo-hit sweeps
+                    # never pay it.  Candidate knowledge must be
+                    # complete; the horizon-fetch fallback keeps the
+                    # full tree.
+                    join_inv = join_inv_by_kind.get(kind)
+                    if join_inv is None:
+                        join_inv = self._lazy_join_inventory(
+                            kind, sorted(join_union.get(kind, ())),
+                            inventory,
+                        )
+                        join_inv_by_kind[kind] = join_inv
             lst = st.cand[ci]
             sig = None
             if trace is None and not uses_inv and len(lst) <= 512:
@@ -4042,7 +4203,7 @@ class TpuDriver(InterpDriver):
                 if ri >= R or reviews[ri] is None:
                     continue  # tombstoned row (valid=False on device too)
                 render(ri, kind, name, constraint, uses_inv, action,
-                       join_strict=join_strict)
+                       join_strict=join_strict, inv=join_inv)
                 rendered_cells += 1
             if not capped:
                 totals[ckey] = (len(results) - start, "exact")

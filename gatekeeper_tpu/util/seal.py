@@ -72,6 +72,29 @@ def seal(data: bytes) -> str:
     return _hmac.new(seal_key(), data, hashlib.sha256).hexdigest()
 
 
+def stable_seal_key() -> bytes:
+    """Key for sealed artifacts that are SOURCE data meant to outlive
+    builds (decision-log segments, obs/decisionlog.py): ``GK_SEAL_KEY``
+    when set (the real authentication boundary, same variable as
+    ``seal_key``), else a fixed package constant.  Unlike ``seal_key``'s
+    code-fingerprint fallback — correct for DERIVED state, which must
+    never cross a build — a decision archive's whole point is to be
+    replayed against a LATER engine (tools/replay_decisions.py), so its
+    fallback key must not change when the source does.  Without a real
+    key either fallback is derivable from the image; the unkeyed seal
+    detects corruption, reordering and truncation, not a deliberate
+    re-signer (docs/decision-logs.md documents the posture)."""
+    k = os.environ.get("GK_SEAL_KEY", "")
+    if k:
+        return k.encode()
+    return hashlib.sha256(b"gatekeeper-tpu-seal:source-data:v1").digest()
+
+
+def stable_seal(data: bytes) -> str:
+    """Hex HMAC-SHA256 tag over `data` under the build-stable key."""
+    return _hmac.new(stable_seal_key(), data, hashlib.sha256).hexdigest()
+
+
 def verify(data: bytes, tag: str) -> bool:
     """Constant-time check of `tag` against `data`; False on any
     malformed tag rather than raising — callers treat a bad seal as a

@@ -22,7 +22,12 @@ from dataclasses import dataclass, field
 from typing import Callable, List, Optional
 
 from .. import logging as gklog
-from ..deadline import DeadlineExceeded, OverloadShed
+from ..deadline import (
+    DeadlineExceeded,
+    OverloadShed,
+    remaining as deadline_remaining,
+)
+from ..obs import decisionlog as obsdlog
 from ..obs import slo as obsslo
 from ..obs import trace as obstrace
 from ..apis.config import CONFIG_NAME, GVK as CONFIG_GVK, parse_config
@@ -136,17 +141,31 @@ class ValidationHandler:
 
     def handle(self, req: dict) -> AdmissionResponse:
         t0 = time.monotonic()
+        # decision-log provenance (obs/decisionlog.py): the remaining
+        # deadline budget at entry rides every record, and each return
+        # site below lands one admission record — pre-review refusals
+        # included — so a denied AdmissionReview survives the trace
+        # ring's rotation
+        budget_s = deadline_remaining()
+
+        def _record(resp, hint=None, results=None):
+            obsdlog.record_admission(
+                req, resp, time.monotonic() - t0, budget_s=budget_s,
+                results=results, hint=hint,
+            )
+            return resp
+
         if self._is_gk_service_account(req):
-            return _allowed("Gatekeeper does not self-manage")
+            return _record(_allowed("Gatekeeper does not self-manage"))
 
         is_delete = req.get("operation") == "DELETE"
         if is_delete:
             if req.get("oldObject") is None:
-                return _denied(
+                return _record(_denied(
                     "For admission webhooks registered for DELETE operations, "
                     "please use Kubernetes v1.15.0+.",
                     500,
-                )
+                ), hint=obsdlog.CLASS_ERROR)
             req = dict(req)
             req["object"] = req["oldObject"]
 
@@ -156,16 +175,20 @@ class ValidationHandler:
         if not is_delete:
             user_err, err = self._validate_gatekeeper_resources(req)
             if err is not None:
-                return _denied(err, 422 if user_err else 500)
+                return _record(_denied(err, 422 if user_err else 500))
 
         status = RESPONSE_UNKNOWN
+        resp: Optional[AdmissionResponse] = None
+        hint: Optional[str] = None
+        results = None
         try:
             ns = req.get("namespace") or ""
             if self.excluder.is_namespace_excluded(WEBHOOK, ns):
                 status = RESPONSE_ALLOW
-                return _allowed(
+                resp = _allowed(
                     "Namespace is set to be ignored by Gatekeeper config"
                 )
+                return resp
             try:
                 results = self._review(req)
             except NamespaceNotSynced as e:
@@ -175,16 +198,20 @@ class ValidationHandler:
                 # that costs ~0.7ms/request and is trivially attacker-paced
                 log.warning("error executing query: %s", e)
                 status = RESPONSE_ERROR
-                return _denied(str(e), 500)
+                hint = obsdlog.CLASS_ERROR
+                resp = _denied(str(e), 500)
+                return resp
             except DeadlineExceeded:
                 # budget exhausted: explicit, policy-selected decision —
                 # the apiserver gets a well-formed AdmissionReview inside
                 # its own timeout instead of a hung socket
                 log.warning("admission deadline budget exhausted")
                 status = RESPONSE_ERROR
-                return self._failure_response(
+                hint = obsdlog.CLASS_EXPIRED
+                resp = self._failure_response(
                     DEADLINE_MESSAGE, DEADLINE_CODE, FAIL_OPEN_DEADLINE
                 )
+                return resp
             except OverloadShed:
                 # bounded-queue refusal (docs/failure-modes.md shed
                 # order): the same explicit fail-open/closed decision,
@@ -192,24 +219,37 @@ class ValidationHandler:
                 # the refusal costs microseconds, not a queue wait
                 log.warning("admission request shed under overload")
                 status = RESPONSE_ERROR
-                return self._failure_response(
+                hint = obsdlog.CLASS_SHED
+                resp = self._failure_response(
                     SHED_MESSAGE, SHED_CODE, FAIL_OPEN_SHED
                 )
+                return resp
             except Exception as e:  # error executing query -> 500
                 log.exception("error executing query")
                 status = RESPONSE_ERROR
-                return self._failure_response(
+                hint = obsdlog.CLASS_ERROR
+                resp = self._failure_response(
                     str(e), 500, FAIL_OPEN_INTERNAL
                 )
+                return resp
             msgs = self._get_deny_messages(results, req)
             if msgs:
                 status = RESPONSE_DENY
-                return _denied("\n".join(msgs), 403)
+                resp = _denied("\n".join(msgs), 403)
+                return resp
             status = RESPONSE_ALLOW
-            return _allowed()
+            resp = _allowed()
+            return resp
         finally:
             obstrace.set_attrs(admission_status=status)
             duration_s = time.monotonic() - t0
+            if resp is not None:
+                # provenance record: class hint from the branch taken,
+                # matched constraint set when a review completed
+                obsdlog.record_admission(
+                    req, resp, duration_s, budget_s=budget_s,
+                    results=results, hint=hint,
+                )
             # SLO event stream (obs/slo.py): the same outcome + duration
             # the request metric records, so burn rates and dashboards
             # agree by construction

@@ -21,7 +21,7 @@ class TestRouterDirect:
         eps = get_router().endpoints()
         for p in ("/debug/traces", "/debug/stacks", "/debug/costs",
                   "/debug/slo", "/debug/routez", "/debug/compilez",
-                  "/debug/flightrecz"):
+                  "/debug/flightrecz", "/debug/decisionz"):
             assert p in eps
 
     @pytest.mark.parametrize("path,query", [
@@ -36,6 +36,9 @@ class TestRouterDirect:
         ("/debug/compilez", "limit=abc"),
         ("/debug/flightrecz", "limit=abc"),
         ("/debug/flightrecz", "dump=yes"),
+        # ISSUE 15: /debug/decisionz inherits the same contract
+        ("/debug/decisionz", "limit=abc"),
+        ("/debug/decisionz", "limit=1.5"),
     ])
     def test_non_numeric_params_are_json_400(self, path, query):
         code, ctype, body = handle(path, query)
@@ -87,12 +90,52 @@ class TestRouterDirect:
         """The three ISSUE 13 endpoints serve well-formed JSON on both
         the bare path and with a numeric limit."""
         for path in ("/debug/routez", "/debug/compilez",
-                     "/debug/flightrecz"):
+                     "/debug/flightrecz", "/debug/decisionz"):
             for query in ("", "limit=2"):
                 code, ctype, body = handle(path, query)
                 assert code == 200, (path, query)
                 assert ctype == "application/json"
                 json.loads(body)
+
+    def test_decisionz_negative_limit_is_400(self):
+        code, _ctype, body = handle("/debug/decisionz", "limit=-1")
+        assert code == 400
+        assert "non-negative" in json.loads(body)["error"]
+
+    def test_decisionz_unknown_verdict_filter_is_400(self):
+        code, _ctype, body = handle("/debug/decisionz", "verdict=bogus")
+        assert code == 400
+        err = json.loads(body)["error"]
+        assert "verdict" in err and "allow" in err
+
+    def test_decisionz_verdict_filter_and_limit(self):
+        from gatekeeper_tpu.obs import decisionlog as dlog
+        from gatekeeper_tpu.webhook.policy import AdmissionResponse
+
+        log = dlog.get_log()
+        log.clear()
+        was = log.record_enabled
+        log.record_enabled = True
+        try:
+            log.record_admission({"uid": "a"},
+                                 AdmissionResponse(True, "", 200), 0.0)
+            for i in range(3):
+                log.record_admission(
+                    {"uid": f"d{i}"},
+                    AdmissionResponse(False, "no", 403), 0.0,
+                )
+            code, _ctype, body = handle("/debug/decisionz",
+                                        "verdict=deny&limit=2")
+            payload = json.loads(body)
+            assert code == 200
+            assert [r["uid"] for r in payload["records"]] == ["d1", "d2"]
+            assert payload["stats"]["recorded"] == 4
+            # limit=0 returns zero records, not the whole ring
+            code, _ctype, body = handle("/debug/decisionz", "limit=0")
+            assert json.loads(body)["records"] == []
+        finally:
+            log.record_enabled = was
+            log.clear()
 
     def test_slo_payload_shape(self):
         code, _ctype, body = handle("/debug/slo")

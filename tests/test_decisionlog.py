@@ -1,0 +1,458 @@
+"""Decision log (ISSUE 15, gatekeeper_tpu/obs/decisionlog.py): record
+schema + taxonomy, head sampling with always-keep classes, bounded-queue
+sheds with counted drops, rotation/retention under churn, seal-chain
+tamper evidence, field masking, audit violation transitions, and the
+webhook handler's end-to-end record sites."""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from gatekeeper_tpu.metrics.catalog import RECORD_DROPS
+from gatekeeper_tpu.obs import decisionlog as dl
+from gatekeeper_tpu.webhook.policy import (
+    AdmissionResponse,
+    FAIL_OPEN_ANNOTATION,
+    ValidationHandler,
+)
+
+
+def make_log(tmp_path=None, **cfg) -> dl.DecisionLog:
+    log = dl.DecisionLog()
+    if tmp_path is not None:
+        cfg.setdefault("dir", str(tmp_path))
+    log.configure(**cfg)
+    return log
+
+
+def allow(msg=""):
+    return AdmissionResponse(True, msg, 200)
+
+
+def deny(msg="nope", code=403):
+    return AdmissionResponse(False, msg, code)
+
+
+class TestTaxonomy:
+    def test_classify_basic_shapes(self):
+        assert dl.DecisionLog.classify(allow()) == "allow"
+        assert dl.DecisionLog.classify(deny()) == "deny"
+        assert dl.DecisionLog.classify(deny("shed", 429)) == "shed"
+        assert dl.DecisionLog.classify(deny("late", 504)) == "expired"
+        assert dl.DecisionLog.classify(deny("boom", 500),
+                                       hint="error") == "error"
+
+    def test_fail_open_annotations_classify_by_reason(self):
+        """A fail-open ALLOW under degradation must never read as a
+        policy allow in the archive."""
+        for reason, want in (("overload-shed", "shed"),
+                             ("deadline-exhausted", "expired"),
+                             ("internal-error", "error")):
+            resp = AdmissionResponse(
+                True, "m", 200, annotations={FAIL_OPEN_ANNOTATION: reason}
+            )
+            assert dl.DecisionLog.classify(resp) == want
+
+    def test_record_fields_schema_is_complete(self):
+        """Every field an admission record carries must be in
+        RECORD_FIELDS (the documented schema the conformance check
+        pins)."""
+        log = make_log()
+        log.record_admission(
+            {"uid": "u1"}, deny(), 0.002, budget_s=0.5,
+            results=[], hint=None,
+        )
+        rec = log.snapshot()["records"][0]
+        for field in rec:
+            assert field in dl.RECORD_FIELDS, field
+
+
+class TestSampling:
+    def test_head_sampling_keeps_exact_fraction_of_allows(self):
+        log = make_log(sample_rate=0.1)
+        for i in range(1000):
+            log.record_admission({"uid": str(i)}, allow(), 0.0)
+        kept = [r for r in log.snapshot(limit=0)["records"]]
+        assert log.recorded == 100
+        assert log.sampled_out == 900
+        assert kept == []  # limit=0 returns none (the [-0:] trap)
+
+    def test_always_keep_classes_bypass_sampling(self):
+        log = make_log(sample_rate=0.01)
+        for i in range(50):
+            log.record_admission({"uid": f"a{i}"}, allow(), 0.0)
+        for i in range(7):
+            log.record_admission({"uid": f"d{i}"}, deny(), 0.0)
+        for i in range(3):
+            log.record_admission({"uid": f"s{i}"}, deny("shed", 429), 0.0)
+        for i in range(2):
+            log.record_admission({"uid": f"e{i}"}, deny("late", 504), 0.0)
+        snap = log.snapshot()
+        by_class = {}
+        for r in snap["records"]:
+            by_class[r["class"]] = by_class.get(r["class"], 0) + 1
+        assert by_class.get("deny") == 7
+        assert by_class.get("shed") == 3
+        assert by_class.get("expired") == 2
+
+    def test_slow_allow_is_always_kept(self):
+        log = make_log(sample_rate=0.0, slow_ms=10.0)
+        log.record_admission({"uid": "fast"}, allow(), 0.001)
+        log.record_admission({"uid": "slow"}, allow(), 0.5)
+        uids = [r["uid"] for r in log.snapshot()["records"]]
+        assert uids == ["slow"]
+
+
+class TestQueueBound:
+    def test_full_queue_sheds_with_counted_drops(self):
+        """The writer never runs (no start()), so the queue fills; past
+        the bound every record sheds — counted, ring still mirrors."""
+        log = make_log(tmp_path="/tmp/gk-declog-unused", queue_max=16)
+        for i in range(50):
+            log.record_admission({"uid": str(i)}, deny(), 0.0)
+        assert log.queue_sheds == 34
+        assert len(log._queue) == 16
+        # the ring mirror keeps serving /debug/decisionz regardless
+        assert len(log.snapshot()["records"]) > 16
+
+    def test_recorder_defect_is_a_counted_drop_not_a_raise(self):
+        log = make_log()
+        before = dict(RECORD_DROPS)
+
+        class Hostile:
+            allowed = True
+            code = 200
+
+            @property
+            def message(self):
+                raise RuntimeError("defect")
+
+        log.record_admission({"uid": "x"}, Hostile(), 0.0)
+        site = "decisionlog.record_admission"
+        assert RECORD_DROPS.get(site, 0) == before.get(site, 0) + 1
+
+
+class TestRotationRetention:
+    def test_rotation_and_retention_under_churn(self, tmp_path):
+        log = make_log(tmp_path, segment_max_bytes=2000, retain=3)
+        log.start()
+        try:
+            for burst in range(6):
+                for i in range(15):
+                    log.record_admission(
+                        {"uid": f"{burst}-{i}"}, deny("x" * 50), 0.0
+                    )
+                log.flush()
+            segs = dl.segment_paths(str(tmp_path))
+            assert 1 <= len(segs) <= 3  # pruned to retain
+            assert log.segments_written > 3  # churn really rotated
+            for s in segs:
+                assert s.endswith(".ndjson")
+                for line in open(s):
+                    json.loads(line)  # every visible line is whole
+            # no hidden .open tail after stop()
+            log.stop()
+            leftovers = [n for n in os.listdir(tmp_path)
+                         if n.endswith(".open")]
+            assert leftovers == []
+        finally:
+            log.stop()
+
+    def test_shared_dir_prunes_own_replica_only(self, tmp_path):
+        other = tmp_path / "decisions-otherreplica-1-00001.ndjson"
+        other.write_text('{"kind":"admission"}\n')
+        log = make_log(tmp_path, segment_max_bytes=256, retain=1)
+        log.start()
+        try:
+            for i in range(30):
+                log.record_admission({"uid": str(i)}, deny(), 0.0)
+            log.flush()
+        finally:
+            log.stop()
+        assert other.exists()  # a peer's segments are never touched
+
+
+class TestSealChain:
+    def _write_sealed(self, tmp_path, n=10):
+        log = make_log(tmp_path, seal=True)
+        log.start()
+        for i in range(n):
+            log.record_admission({"uid": str(i)}, deny(f"m{i}"), 0.0)
+        log.flush()
+        log.stop()
+        segs = dl.segment_paths(str(tmp_path))
+        assert segs
+        return segs
+
+    def test_intact_chain_verifies(self, tmp_path):
+        segs = self._write_sealed(tmp_path)
+        total = 0
+        for s in segs:
+            n, problems = dl.verify_segment(s)
+            assert problems == []
+            total += n
+        assert total == 10
+
+    @pytest.mark.parametrize("tamper", ["edit", "reorder", "truncate_mid"])
+    def test_tampered_segment_is_rejected(self, tmp_path, tamper):
+        seg = self._write_sealed(tmp_path)[0]
+        lines = open(seg).readlines()
+        if tamper == "edit":
+            rec = json.loads(lines[2])
+            rec["class"] = "allow"  # flip a verdict
+            lines[2] = json.dumps(rec) + "\n"
+        elif tamper == "reorder":
+            lines[1], lines[2] = lines[2], lines[1]
+        else:
+            del lines[3]  # drop a middle record
+        open(seg, "w").writelines(lines)
+        _n, problems = dl.verify_segment(seg)
+        assert problems, tamper
+
+    def test_unsealed_segment_reports_when_seal_required(self, tmp_path):
+        log = make_log(tmp_path, seal=False)
+        log.start()
+        log.record_admission({"uid": "u"}, deny(), 0.0)
+        log.flush()
+        log.stop()
+        seg = dl.segment_paths(str(tmp_path))[0]
+        _n, problems = dl.verify_segment(seg)
+        assert any("unsealed" in p for p in problems)
+
+
+class TestMasking:
+    def test_masked_fields_never_reach_disk(self, tmp_path):
+        log = make_log(tmp_path,
+                       mask_fields=["request.userInfo",
+                                    "request.object.data"])
+        log.start()
+        req = {"uid": "m", "userInfo": {"username": "alice"},
+               "object": {"kind": "Secret", "data": {"k": "v"}}}
+        log.record_admission(req, deny(), 0.0)
+        log.flush()
+        log.stop()
+        body = open(dl.segment_paths(str(tmp_path))[0]).read()
+        assert "alice" not in body
+        rec = json.loads(body.splitlines()[0])
+        assert rec["request"]["userInfo"] == dl.MASK_MARKER
+        assert sorted(rec["masked"]) == [
+            "request.object.data", "request.userInfo",
+        ]
+        # the caller's request object is never mutated
+        assert req["userInfo"] == {"username": "alice"}
+
+
+class TestAuditTransitions:
+    def test_transitions_are_deltas_and_always_kept(self):
+        log = make_log(sample_rate=0.0)  # sampling must not touch these
+        new = [("K/ns/c", "Pod", "ns", "p1", "d1"),
+               ("K/ns/c", "Pod", "ns", "p2", "d2")]
+        log.record_audit_transitions(new, [], "t1")
+        resolved = [("K/ns/c", "Pod", "ns", "p1", "d1")]
+        log.record_audit_transitions([], resolved, "t2")
+        recs = log.snapshot()["records"]
+        assert [r["transition"] for r in recs] == ["new", "new", "resolved"]
+        assert recs[0]["resource"] == {"kind": "Pod", "namespace": "ns",
+                                       "name": "p1"}
+        assert recs[2]["audit_id"] == "t2"
+
+    def test_transition_overflow_is_summarized_and_counted(self):
+        log = make_log()
+        n = dl.TRANSITIONS_MAX_PER_SWEEP + 10
+        new = [("K/ns/c", "Pod", "ns", f"p{i}", f"d{i}") for i in range(n)]
+        log.record_audit_transitions(new, [], "t1")
+        recs = log.snapshot(limit=0 + 10**6)["records"]
+        overflow = [r for r in recs if r.get("transition") == "overflow"]
+        assert len(overflow) == 1
+        assert overflow[0]["dropped_new"] == 10
+
+    def test_audit_manager_diffs_reported_sets(self):
+        """The manager records only new/resolved deltas between sweeps
+        (never the full set twice)."""
+        from gatekeeper_tpu.audit.manager import AuditManager, StatusViolation
+
+        mgr = AuditManager.__new__(AuditManager)
+        mgr._prev_violation_keys = None
+        log = dl.get_log()
+        log.clear()
+        was = log.record_enabled
+        log.record_enabled = True
+        try:
+            v1 = {"K/ns/c": [StatusViolation("Pod", "p1", "ns", "m1", "deny"),
+                             StatusViolation("Pod", "p2", "ns", "m2", "deny")]}
+            mgr._record_transitions(v1, "t1")
+            first = log.snapshot()["records"]
+            assert len(first) == 2  # first sweep: everything new
+            v2 = {"K/ns/c": [StatusViolation("Pod", "p2", "ns", "m2", "deny"),
+                             StatusViolation("Pod", "p3", "ns", "m3", "deny")]}
+            mgr._record_transitions(v2, "t2")
+            delta = log.snapshot()["records"][2:]
+            kinds = sorted((r["transition"], r["resource"]["name"])
+                           for r in delta)
+            assert kinds == [("new", "p3"), ("resolved", "p1")]
+        finally:
+            log.record_enabled = was
+            log.clear()
+
+
+class TestHandlerIntegration:
+    def _handler(self, client):
+        return ValidationHandler(client)
+
+    def test_handler_records_allow_deny_with_provenance(self):
+        from gatekeeper_tpu.client.client import Client
+        from gatekeeper_tpu.client.drivers import InterpDriver
+        from gatekeeper_tpu.util.synthetic import make_pods, make_templates
+
+        templates, constraints = make_templates(2)
+        c = Client(driver=InterpDriver())
+        for t in templates:
+            c.add_template(t)
+        for k in constraints:
+            c.add_constraint(k)
+        handler = self._handler(c)
+        log = dl.get_log()
+        log.clear()
+        was = log.record_enabled
+        log.record_enabled = True
+        try:
+            good = make_pods(8, seed=3, violation_rate=0.0)[0]
+            bad = json.loads(json.dumps(good))
+            bad["metadata"]["labels"] = {}  # trips every labelreq clone
+            for i, pod in enumerate((good, bad)):
+                handler.handle({
+                    "uid": f"u{i}",
+                    "kind": {"group": "", "version": "v1", "kind": "Pod"},
+                    "name": pod["metadata"]["name"],
+                    "namespace": pod["metadata"]["namespace"],
+                    "operation": "CREATE",
+                    "object": pod,
+                })
+            recs = log.snapshot()["records"]
+            assert [r["class"] for r in recs] == ["allow", "deny"]
+            d = recs[1]
+            assert d["verdict"] == {"allowed": False, "code": 403}
+            assert len(d["message_sha256"]) == 64
+            assert d["uid"] == "u1"
+            assert d["request"]["object"]["metadata"]["name"] == \
+                bad["metadata"]["name"]
+            assert d["templates"]  # matched template kinds attributed
+            assert d["latency_ms"] >= 0
+        finally:
+            log.record_enabled = was
+            log.clear()
+
+    def test_handler_records_shed_and_expired_taxonomy(self):
+        from gatekeeper_tpu import deadline as gk_deadline
+
+        class Shedding:
+            def review(self, obj, tracing=False):
+                raise gk_deadline.OverloadShed("full")
+
+        handler = self._handler(Shedding())
+        log = dl.get_log()
+        log.clear()
+        was = log.record_enabled
+        log.record_enabled = True
+        try:
+            req = {"uid": "s1", "kind": {"kind": "Pod"}, "object": {}}
+            resp = handler.handle(req)
+            assert resp.code == 429
+            token = gk_deadline.push(-1.0)  # already expired
+            try:
+                class Slow:
+                    def review(self, obj, tracing=False):
+                        raise gk_deadline.DeadlineExceeded("late")
+
+                handler2 = self._handler(Slow())
+                handler2.handle({"uid": "e1", "kind": {"kind": "Pod"},
+                                 "object": {}})
+            finally:
+                gk_deadline.pop(token)
+            classes = [r["class"] for r in log.snapshot()["records"]]
+            assert classes == ["shed", "expired"]
+            exp = log.snapshot()["records"][1]
+            assert exp["deadline_budget_ms"] is not None
+        finally:
+            log.record_enabled = was
+            log.clear()
+
+
+class TestFleetSegments:
+    def test_spawned_replica_writes_per_replica_sealed_segments(
+        self, tmp_path,
+    ):
+        """A fleet replica handed --decision-log-dir archives its
+        admission verdicts as decisions-<replica_id>-* segments under
+        the shared dir (sealed), flushed on orderly stop."""
+        from .test_snapshot_concurrent import _can_spawn
+
+        if not _can_spawn():
+            pytest.skip("subprocess spawn unavailable")
+        import urllib.request
+
+        from gatekeeper_tpu.fleet.replica import spawn_replica
+
+        h = spawn_replica(
+            "r0",
+            extra_flags=["--driver", "interp",
+                         "--decision-log-dir", str(tmp_path)],
+            timeout_s=120.0,
+        )
+        try:
+            body = json.dumps({
+                "request": {
+                    "uid": "fleet-d1",
+                    "kind": {"group": "", "version": "v1", "kind": "Pod"},
+                    "operation": "CREATE",
+                    "object": {"apiVersion": "v1", "kind": "Pod",
+                               "metadata": {"name": "p", "namespace": "d"}},
+                },
+            }).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{h.port}/v1/admit", data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                assert resp.status == 200
+        finally:
+            h.stop()
+        segs = dl.segment_paths(str(tmp_path))
+        assert segs, os.listdir(tmp_path)
+        assert all("decisions-r0-" in os.path.basename(s) for s in segs)
+        recs = [json.loads(line) for s in segs for line in open(s)]
+        assert any(r.get("uid") == "fleet-d1" for r in recs)
+        assert all(r.get("replica_id") == "r0" for r in recs)
+        for s in segs:
+            n, problems = dl.verify_segment(s)
+            assert n and problems == []
+
+
+class TestConcurrency:
+    def test_parallel_recording_keeps_seq_total_order(self, tmp_path):
+        log = make_log(tmp_path)
+        log.start()
+        try:
+            def pound(tid):
+                for i in range(200):
+                    log.record_admission({"uid": f"{tid}-{i}"}, deny(), 0.0)
+
+            threads = [threading.Thread(target=pound, args=(t,))
+                       for t in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            log.flush()
+            seqs = []
+            for seg in dl.segment_paths(str(tmp_path)):
+                for line in open(seg):
+                    seqs.append(json.loads(line)["seq"])
+            assert seqs == sorted(seqs)
+            assert len(set(seqs)) == len(seqs)
+            assert log.recorded == 1600
+        finally:
+            log.stop()

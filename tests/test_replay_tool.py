@@ -1,0 +1,139 @@
+"""Tier-1 wiring for tools/replay_decisions.py (ISSUE 15): the
+differential-replay selftest — record a synthetic corpus through the
+live handler, replay it at zero drift, then replay under GK_BUG_COMPAT=1
+and REQUIRE the seeded divergence to be flagged.  The subprocess arm
+skips cleanly where spawn is unavailable; the in-process arms pin the
+drift detector's mechanics directly."""
+
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+import replay_decisions as rp  # noqa: E402
+
+from .test_snapshot_concurrent import spawn_available
+
+TOOL = os.path.join(os.path.dirname(__file__), "..", "tools",
+                    "replay_decisions.py")
+
+
+@spawn_available
+def test_selftest_passes_in_a_subprocess():
+    env = dict(os.environ)
+    env.pop("GK_BUG_COMPAT", None)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [sys.executable, TOOL, "--selftest"],
+        capture_output=True, text=True, timeout=420, env=env,
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "seeded drift flagged" in proc.stdout
+
+
+class TestReplayMechanics:
+    def test_zero_drift_on_identical_engine(self, tmp_path,
+                                            monkeypatch):
+        monkeypatch.delenv("GK_BUG_COMPAT", raising=False)
+        from gatekeeper_tpu.obs import decisionlog as dlog
+
+        log = dlog.get_log()
+        log.clear()
+        log.configure(dir=str(tmp_path), seal=True, sample_rate=1.0)
+        log.record_enabled = True
+        log.start()
+        try:
+            handler = rp._selftest_handler()
+            for req in rp.selftest_requests(n=12, divergent=2):
+                handler.handle(req)
+            log.flush()
+            records, problems = rp.load_records(str(tmp_path),
+                                                require_seal=True)
+            assert problems == []
+            report = rp.replay_records(handler, records)
+            assert report["replayed"] == 12
+            assert report["drift_count"] == 0
+        finally:
+            log.stop()
+            log.record_enabled = False
+            log.clear()
+
+    def test_bug_compat_divergence_is_flagged_with_route_attribution(
+        self, tmp_path, monkeypatch,
+    ):
+        monkeypatch.delenv("GK_BUG_COMPAT", raising=False)
+        from gatekeeper_tpu.obs import decisionlog as dlog
+
+        log = dlog.get_log()
+        log.clear()
+        log.configure(dir=str(tmp_path), seal=False, sample_rate=1.0)
+        log.record_enabled = True
+        log.start()
+        try:
+            handler = rp._selftest_handler()
+            for req in rp.selftest_requests(n=10, divergent=3):
+                handler.handle(req)
+            log.flush()
+            records, _problems = rp.load_records(str(tmp_path))
+            monkeypatch.setenv("GK_BUG_COMPAT", "1")
+            report = rp.replay_records(rp._selftest_handler(), records)
+            assert report["drift_count"] >= 3
+            d = report["drift"][0]
+            # drift entries carry BOTH sides' verdicts + route attribution
+            assert d["recorded"]["verdict"]["allowed"] is False
+            assert d["replayed"]["allowed"] is True
+            assert "route" in d["recorded"] and "route" in d["replayed"]
+        finally:
+            log.stop()
+            log.record_enabled = False
+            log.clear()
+
+    def test_masked_and_transient_records_are_skipped(self):
+        from gatekeeper_tpu.obs import decisionlog as dlog
+
+        records = [
+            {"kind": "admission", "class": "allow", "masked": ["x"],
+             "request": {"uid": "m"}},
+            {"kind": "admission", "class": "shed",
+             "request": {"uid": "s"},
+             "verdict": {"allowed": False, "code": 429}},
+            {"kind": dlog.KIND_AUDIT_TRANSITION, "transition": "new"},
+        ]
+
+        class NeverCalled:
+            def handle(self, req):  # pragma: no cover - must not run
+                raise AssertionError("skipped records must not replay")
+
+        report = rp.replay_records(NeverCalled(), records)
+        assert report["replayed"] == 0
+        assert report["skipped_masked"] == 1
+        assert report["skipped_transient"] == 1
+        assert report["skipped_other"] == 1
+
+    def test_replay_never_rearchives_into_the_corpus(self, tmp_path,
+                                                     monkeypatch):
+        """Recording pauses during replay: the archive must not grow
+        with its own replays."""
+        monkeypatch.delenv("GK_BUG_COMPAT", raising=False)
+        from gatekeeper_tpu.obs import decisionlog as dlog
+
+        log = dlog.get_log()
+        log.clear()
+        log.configure(dir=str(tmp_path), sample_rate=1.0)
+        log.record_enabled = True
+        log.start()
+        try:
+            handler = rp._selftest_handler()
+            for req in rp.selftest_requests(n=6, divergent=0):
+                handler.handle(req)
+            log.flush()
+            records, _ = rp.load_records(str(tmp_path))
+            recorded_before = log.recorded
+            rp.replay_records(handler, records)
+            assert log.recorded == recorded_before
+            assert log.record_enabled is True  # restored afterwards
+        finally:
+            log.stop()
+            log.record_enabled = False
+            log.clear()

@@ -41,6 +41,13 @@ tests/test_observability_check.py; also runnable standalone):
    each be documented in docs/metrics.md (the route_decisions_total
    reason taxonomy).
 
+9. Decision-log conformance (ISSUE 15): the record schema
+   (decisionlog.RECORD_FIELDS) and decision taxonomy
+   (decisionlog.CLASSES) must each be documented in
+   docs/decision-logs.md, and a live admission record must emit no
+   field outside the declared schema — the archive format is the replay
+   tool's input contract.
+
 Run: python tools/check_observability.py   (exit 0 clean, 1 with findings)
 """
 
@@ -65,6 +72,7 @@ HOT_PATH_MODULES = (
     "gatekeeper_tpu/obs/flightrec.py",
     "gatekeeper_tpu/obs/routeledger.py",
     "gatekeeper_tpu/obs/compilestats.py",
+    "gatekeeper_tpu/obs/decisionlog.py",
     "gatekeeper_tpu/obs/brownout.py",
     "gatekeeper_tpu/ops/xlacache.py",
     "gatekeeper_tpu/ops/asynccompile.py",
@@ -384,6 +392,61 @@ def check_flightrec_conformance() -> list:
     return problems
 
 
+def check_decisionlog_conformance() -> list:
+    """The decision log's record schema and taxonomy are operator (and
+    replay-tool) contracts (ISSUE 15): every field a record may carry
+    (decisionlog.RECORD_FIELDS) and every decision class
+    (decisionlog.CLASSES) must be documented in docs/decision-logs.md,
+    and a live admission record must emit no field outside the declared
+    schema — an undocumented field silently changes the archive format
+    replay depends on."""
+    from gatekeeper_tpu.obs import decisionlog
+
+    problems = []
+    doc_path = os.path.join(REPO, "docs", "decision-logs.md")
+    try:
+        with open(doc_path) as f:
+            doc = f.read()
+    except OSError as e:
+        return [f"docs/decision-logs.md unreadable: {e}"]
+    for field in decisionlog.RECORD_FIELDS:
+        if f"`{field}`" not in doc:
+            problems.append(
+                f"decision-record field {field!r} is not documented in "
+                "docs/decision-logs.md (the record-schema table)"
+            )
+    for dclass in decisionlog.CLASSES:
+        if f"`{dclass}`" not in doc:
+            problems.append(
+                f"decision class {dclass!r} is not documented in "
+                "docs/decision-logs.md (the taxonomy table)"
+            )
+    # functional half: a real record must stay inside the schema
+    log = decisionlog.DecisionLog()
+
+    class _Resp:
+        allowed = False
+        code = 403
+        message = "check"
+        annotations = None
+
+    log.record_admission({"uid": "schema-check"}, _Resp(), 0.001,
+                         budget_s=0.1)
+    recs = log.snapshot()["records"]
+    if not recs:
+        problems.append("decision log dropped a synthetic record "
+                        "(schema check could not run)")
+    else:
+        for field in recs[0]:
+            if field not in decisionlog.RECORD_FIELDS:
+                problems.append(
+                    f"admission records emit undeclared field {field!r} "
+                    "— add it to decisionlog.RECORD_FIELDS and the "
+                    "docs/decision-logs.md schema table"
+                )
+    return problems
+
+
 def run_checks() -> list:
     sys.path.insert(0, REPO)
     return (
@@ -395,6 +458,7 @@ def run_checks() -> list:
         + check_wire_stages()
         + check_federated_format()
         + check_flightrec_conformance()
+        + check_decisionlog_conformance()
     )
 
 
