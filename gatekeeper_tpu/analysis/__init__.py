@@ -20,6 +20,8 @@ Pass families (each module registers its rules on import):
                  idempotent start()
   queuebound     unbounded queues (queue.Queue() without maxsize,
                  list-backed pending queues on serving paths)
+  evloopsafety   blocking socket calls inside selectors-based
+                 event-loop modules (ISSUE 19 reactor discipline)
   registrycheck  fault-point and metric registries vs their docs
 """
 
@@ -37,6 +39,7 @@ from .core import (  # noqa: F401
 )
 
 # importing the pass modules registers them with core.PASSES
+from . import evloopsafety  # noqa: F401,E402
 from . import failpolicy  # noqa: F401,E402
 from . import hygiene  # noqa: F401,E402
 from . import locks  # noqa: F401,E402

@@ -40,7 +40,8 @@ from typing import Optional
 
 #: the wire header carrying the REMAINING budget, in milliseconds, across
 #: the front-door hop (and any future proxy hop: the contract is
-#: transport-agnostic — ROADMAP item 1's rebuild must preserve it)
+#: transport-agnostic — the event-edge wire protocol carries the same
+#: remaining-budget value in its request frames, fleet/wireproto.py)
 DEADLINE_HEADER = "X-GK-Deadline-Ms"
 
 

@@ -19,6 +19,12 @@ Pieces:
   a bounded single retry) for benching and parity checks; production
   fleets use a Service/LB, this one exists so the repo can DRIVE and
   PROVE the topology end to end;
+- :mod:`evloop` / :mod:`wireproto` / :mod:`evdoor` /
+  :mod:`wirelistener` — the event-loop admission data plane (ISSUE 19):
+  a selectors-based reactor, the framed chunk protocol, the
+  non-blocking front door (persistent pipelined client connections,
+  byte-splice proxying) and the replica-side batch listener that feeds
+  whole chunks into the micro-batcher via ``submit_many``;
 - :mod:`supervisor` — replica supervision (exit/wedge detection, warm
   restarts with capped backoff, flap quarantine, graceful drain and
   zero-failed-admission rolling restarts; ISSUE 8,
@@ -37,14 +43,18 @@ Per-replica identity (`--replica-id`) is stamped into metrics
 payload.
 """
 
+from .evdoor import EventFrontDoor
 from .frontdoor import FrontDoor
 from .replica import ReplicaHandle, spawn_replica, spawn_fleet
 from .supervisor import ReplicaSupervisor
+from .wirelistener import WireListener
 
 __all__ = [
+    "EventFrontDoor",
     "FrontDoor",
     "ReplicaHandle",
     "ReplicaSupervisor",
+    "WireListener",
     "spawn_replica",
     "spawn_fleet",
 ]

@@ -254,13 +254,18 @@ class Backend:
     # bounded samples, summarized over the trailing LATENCY_WINDOW_S
     LATENCY_SAMPLES = 1024
 
-    __slots__ = ("host", "port", "replica_id", "inflight", "served",
-                 "errors", "consecutive_errors", "ejected", "ejected_at",
-                 "readmissions", "lock", "lat")
+    __slots__ = ("host", "port", "probe_port", "replica_id", "inflight",
+                 "served", "errors", "consecutive_errors", "ejected",
+                 "ejected_at", "readmissions", "lock", "lat")
 
-    def __init__(self, host: str, port: int, replica_id: str = ""):
+    def __init__(self, host: str, port: int, replica_id: str = "",
+                 probe_port: int = 0):
         self.host = host
         self.port = int(port)
+        # readmission probes GET /readyz over HTTP; a backend whose
+        # data port speaks the wire protocol (EventFrontDoor) names the
+        # replica's HTTP listener here.  0 = probe the data port.
+        self.probe_port = int(probe_port)
         self.replica_id = replica_id or f"{host}:{port}"
         self.inflight = 0
         self.served = 0
@@ -363,6 +368,7 @@ class FrontDoor:
                 self.backends.append(Backend(
                     b.get("host", "127.0.0.1"), b["port"],
                     b.get("replica_id", ""),
+                    probe_port=b.get("probe_port", 0),
                 ))
             else:
                 host, bport = b
@@ -406,9 +412,9 @@ class FrontDoor:
         to refuse."""
         if not self.max_inflight:
             return True
-        with self._mu:
-            candidates = list(self.backends)
-        live = [b for b in candidates if not b.ejected]
+        # the roster list is append-only during __init__, so lock-free
+        # iteration is safe (the advisory inflight reads always were)
+        live = [b for b in self.backends if not b.ejected]
         if not live:
             return True
         return any(b.inflight < self.max_inflight for b in live)
@@ -422,8 +428,57 @@ class FrontDoor:
         one is at its bound (the caller answers the fast 429 — a
         saturated-but-healthy fleet must never be queued into);
         returns None only when nothing is choosable at all."""
-        with self._mu:
-            candidates = list(self.backends)
+        candidates = self.backends  # append-only after __init__; no copy
+        if not exclude:
+            # healthy-path fast lanes: reserve with no intermediate
+            # list builds.  Fall through to the general path when
+            # ejections or reservation races complicate the picture
+            # (live-subset rotation fairness, fail-static probing).
+            n = len(candidates)
+            start = next(self._rr)
+            if self.policy == ROUND_ROBIN:
+                saw_ejected = False
+                for k in range(n):
+                    b = candidates[(start + k) % n]
+                    if b.ejected:
+                        saw_ejected = True
+                        continue
+                    with b.lock:
+                        if (
+                            self.max_inflight
+                            and b.inflight >= self.max_inflight
+                        ):
+                            continue
+                        b.inflight += 1
+                    return b
+                if not saw_ejected:
+                    raise _deadline.OverloadShed(
+                        "every live backend is at its inflight bound"
+                    )
+            else:
+                # least-inflight: lock-free argmin over the rotation
+                # (advisory reads, like the sort the general path
+                # does), then a locked re-check on the winner only.
+                # Starting the scan at the rotation point keeps ties
+                # shared the way the stable sort did.
+                best = None
+                best_in = 0
+                for k in range(n):
+                    b = candidates[(start + k) % n]
+                    if not b.ejected and (best is None
+                                          or b.inflight < best_in):
+                        best = b
+                        best_in = b.inflight
+                if best is not None:
+                    with best.lock:
+                        if not (
+                            self.max_inflight
+                            and best.inflight >= self.max_inflight
+                        ):
+                            best.inflight += 1
+                            return best
+                # at-bound or all-ejected: the general path below owns
+                # the shed/fail-static decision
         live = [
             (i, b) for i, b in enumerate(candidates)
             if (not exclude or i not in exclude) and not b.ejected
@@ -543,7 +598,8 @@ class FrontDoor:
             for b in ejected:
                 try:
                     conn = http.client.HTTPConnection(
-                        b.host, b.port, timeout=self.PROBE_TIMEOUT_S
+                        b.host, b.probe_port or b.port,
+                        timeout=self.PROBE_TIMEOUT_S,
                     )
                     conn.request("GET", "/readyz")
                     resp = conn.getresponse()

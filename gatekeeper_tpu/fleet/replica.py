@@ -336,6 +336,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         flags += ["--decision-log-dir", dlog_dir, "--decision-log-seal"]
     app = App(build_parser().parse_args(flags), kube=InMemoryKube())
     app.start()
+    wire = None
     try:
         seeded = 0
         if not args.no_seed_namespaces:
@@ -344,10 +345,22 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         if hasattr(drv, "wait_ready"):
             drv.wait_ready(timeout=300.0)
         _probe_ready(app.webhook_server.port)
+        # the batched wire listener (ISSUE 19): the event-loop front
+        # door speaks framed chunks to this port; the HTTP listener
+        # stays up for the classic door, /readyz probing, and /metrics
+        from .wirelistener import WireListener
+
+        ws = app.webhook_server
+        wire = WireListener(
+            handler=ws.validation_handler,
+            label_handler=ws.label_handler,
+            server=ws,
+        ).start()
         ready = {
             "event": "ready",
             "replica_id": args.replica_id,
             "port": app.webhook_server.port,
+            "wire_port": wire.port,
             # the ephemeral exporter port, announced so the parent-side
             # metrics federator (obs/fleetobs.py) can scrape this
             # replica's /metrics into the fleet view
@@ -490,6 +503,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             pass
         return 0
     finally:
+        if wire is not None:
+            wire.stop()
         app.stop()
 
 
@@ -641,6 +656,8 @@ class ReplicaHandle:
         self.port: int = int(ready["port"])
         # exporter port for the metrics federator (0 on older replicas)
         self.metrics_port: int = int(ready.get("metrics_port", 0))
+        # batched wire-protocol listener (0 on replicas without one)
+        self.wire_port: int = int(ready.get("wire_port", 0))
         self.ready_s: float = float(ready["ready_s"])  # in-process
         self.spawn_s = spawn_s      # parent wall: Popen -> ready line
         self.host = "127.0.0.1"
@@ -655,6 +672,15 @@ class ReplicaHandle:
     def backend(self) -> Dict:
         return {"host": self.host, "port": self.port,
                 "replica_id": self.replica_id}
+
+    def wire_backend(self) -> Dict:
+        """Backend dict for the event-loop door: admissions travel the
+        framed wire port, while /readyz probing stays on the HTTP port
+        (the wire listener does not speak HTTP)."""
+        if not self.wire_port:
+            return self.backend()
+        return {"host": self.host, "port": self.wire_port,
+                "probe_port": self.port, "replica_id": self.replica_id}
 
     def command(self, cmd: Dict, timeout_s: float = 600.0) -> Dict:
         """Send one JSON command line to the child and return its JSON

@@ -961,6 +961,43 @@ def record_frontdoor_stage(stage: str, seconds: float):
         record_dropped("record_frontdoor_stage")
 
 
+_FRONTDOOR_STAGE_OBS = None
+
+
+def record_frontdoor_stages(samples, exemplar_trace_id=None):
+    """A batch of wire-stage intervals in ONE registry lock hold
+    (samples: [(stage, seconds)] with stage in frontdoor.WIRE_STAGES) —
+    the event-loop door flushes a whole reactor tick's stage observes
+    through here instead of one record_frontdoor_stage round-trip per
+    interval.  The prebound observer memoizes per-stage row keys.
+    Guarded like record_stage."""
+    global _FRONTDOOR_STAGE_OBS
+    try:
+        obs = _FRONTDOOR_STAGE_OBS
+        if obs is None:
+            obs = _FRONTDOOR_STAGE_OBS = _global().observer(
+                FRONTDOOR_STAGE_M, "stage")
+        obs(samples, exemplar_trace_id=exemplar_trace_id)
+    except Exception:  # telemetry never blocks the wire path
+        record_dropped("record_frontdoor_stages")
+
+
+def record_frontdoor_requests(counts):
+    """Tick-batched request outcomes from the event-loop door: counts
+    maps (outcome, backend) -> n, flushed once per reactor tick so the
+    hot path pays a dict increment instead of a registry lock per
+    request.  Guarded like record_stage."""
+    try:
+        reg = _global()
+        for (outcome, backend), n in counts.items():
+            reg.record(
+                FRONTDOOR_REQS_M, 1.0,
+                {"outcome": outcome, "backend": backend}, count=n,
+            )
+    except Exception:  # telemetry never blocks the wire path
+        record_dropped("record_frontdoor_requests")
+
+
 def record_frontdoor_request(outcome: str, backend: str):
     """One request through the front door: outcome in (ok,
     backend_error, no_backend, bad_request); backend = the serving

@@ -9,6 +9,7 @@ last-value, and bucketed distribution.
 
 from __future__ import annotations
 
+import bisect
 import threading
 import time
 from dataclasses import dataclass, field
@@ -121,38 +122,115 @@ class Registry:
         tags = tags or {}
         with self._lock:
             for state in self._by_measure.get(measure.name, ()):
-                v = state.view
-                key = tuple(tags.get(k, "") for k in v.tag_keys)
-                if v.aggregation == AGG_COUNT:
-                    state.rows[key] = int(state.rows.get(key, 0)) + count
-                elif v.aggregation == AGG_SUM:
-                    state.rows[key] = float(state.rows.get(key, 0.0)) + value
-                elif v.aggregation == AGG_LAST_VALUE:
-                    state.rows[key] = float(value)
-                elif v.aggregation == AGG_DISTRIBUTION:
-                    dist = state.rows.get(key)
-                    if dist is None:
-                        dist = DistributionData(
-                            bucket_counts=[0] * (len(v.buckets) + 1)
-                        )
-                        state.rows[key] = dist
-                    idx = len(v.buckets)
-                    for i, bound in enumerate(v.buckets):
-                        if value <= bound:
-                            idx = i
-                            break
-                    dist.bucket_counts[idx] += 1
-                    dist.count += 1
-                    dist.sum += value
-                    dist.min = min(dist.min, value)
-                    dist.max = max(dist.max, value)
-                    if exemplar_trace_id:
-                        dist.exemplars[idx] = Exemplar(
-                            value=float(value),
-                            trace_id=exemplar_trace_id,
-                            ts=_WALL_ANCHOR
-                            + (time.perf_counter() - _PERF_ANCHOR),
-                        )
+                key = tuple(tags.get(k, "") for k in state.view.tag_keys)
+                self._apply(state, key, value, count, exemplar_trace_id)
+
+    def record_many(
+        self,
+        measure: Measure,
+        samples,
+        exemplar_trace_id: Optional[str] = None,
+    ) -> None:
+        """Record N ``(value, tags)`` measurements of one measure under
+        a SINGLE lock hold — the event-loop edge flushes a request's
+        six wire-stage observes in one call instead of six lock
+        round-trips on the reactor thread."""
+        with self._lock:
+            for state in self._by_measure.get(measure.name, ()):
+                keys = state.view.tag_keys
+                for value, tags in samples:
+                    key = tuple(tags.get(k, "") for k in keys)
+                    self._apply(state, key, value, 1, exemplar_trace_id)
+
+    def observer(self, measure: Measure, tag_key: str):
+        """Prebound recorder for a single-tag measure on a reactor hot
+        path: returns ``obs(pairs, exemplar_trace_id=None)`` with pairs
+        ``[(tag_value, value)]`` — one lock hold for the whole batch,
+        and the per-tag-value row key tuples memoized instead of
+        rebuilt per sample.  Row objects are still fetched per call so
+        :meth:`clear` keeps working.  Views registered after a tag
+        value is first seen are not picked up for that value — build
+        observers after catalog registration (the catalog does)."""
+        memo: Dict[str, list] = {}
+
+        def keyed(tv: str) -> list:
+            rows = [
+                (st, tuple(tv if k == tag_key else ""
+                           for k in st.view.tag_keys))
+                for st in self._by_measure.get(measure.name, ())
+            ]
+            memo[tv] = rows
+            return rows
+
+        bisect_left = bisect.bisect_left
+
+        def obs(pairs, exemplar_trace_id: Optional[str] = None) -> None:
+            with self._lock:
+                for tv, value in pairs:
+                    for st, key in (memo.get(tv) or keyed(tv)):
+                        v = st.view
+                        if v.aggregation != AGG_DISTRIBUTION:
+                            self._apply(st, key, value, 1,
+                                        exemplar_trace_id)
+                            continue
+                        # inlined _apply distribution branch: the stage
+                        # histogram flush is the reactor's hottest
+                        # metric path, worth skipping a frame per sample
+                        dist = st.rows.get(key)
+                        if dist is None:
+                            dist = DistributionData(
+                                bucket_counts=[0] * (len(v.buckets) + 1)
+                            )
+                            st.rows[key] = dist
+                        idx = bisect_left(v.buckets, value)
+                        dist.bucket_counts[idx] += 1
+                        dist.count += 1
+                        dist.sum += value
+                        if value < dist.min:
+                            dist.min = value
+                        if value > dist.max:
+                            dist.max = value
+                        if exemplar_trace_id:
+                            dist.exemplars[idx] = Exemplar(
+                                value=float(value),
+                                trace_id=exemplar_trace_id,
+                                ts=_WALL_ANCHOR
+                                + (time.perf_counter() - _PERF_ANCHOR),
+                            )
+
+        return obs
+
+    def _apply(self, state, key, value, count, exemplar_trace_id) -> None:
+        """One measurement into one view's row (caller holds _lock)."""
+        v = state.view
+        if v.aggregation == AGG_COUNT:
+            state.rows[key] = int(state.rows.get(key, 0)) + count
+        elif v.aggregation == AGG_SUM:
+            state.rows[key] = float(state.rows.get(key, 0.0)) + value
+        elif v.aggregation == AGG_LAST_VALUE:
+            state.rows[key] = float(value)
+        elif v.aggregation == AGG_DISTRIBUTION:
+            dist = state.rows.get(key)
+            if dist is None:
+                dist = DistributionData(
+                    bucket_counts=[0] * (len(v.buckets) + 1)
+                )
+                state.rows[key] = dist
+            # first bound >= value, i.e. the "value <= bound" bucket;
+            # bisect beats the linear scan on the wide stage histograms
+            idx = bisect.bisect_left(v.buckets, value)
+            dist.bucket_counts[idx] += 1
+            dist.count += 1
+            dist.sum += value
+            dist.min = min(dist.min, value)
+            dist.max = max(dist.max, value)
+            if exemplar_trace_id:
+                dist.exemplars[idx] = Exemplar(
+                    value=float(value),
+                    trace_id=exemplar_trace_id,
+                    ts=_WALL_ANCHOR
+                    + (time.perf_counter() - _PERF_ANCHOR),
+                )
 
     def snapshot(self) -> List[Tuple[View, Dict[Tuple[str, ...], object]]]:
         import copy

@@ -72,6 +72,12 @@ def _new_trace_id() -> str:
     return f"{random.getrandbits(128):032x}"
 
 
+#: public alias for producers that mint a trace id WITHOUT building a
+#: trace — the event-loop edge stamps X-GK-Trace-Id on head-unsampled
+#: requests from this, skipping Span/Trace allocation entirely
+new_trace_id = _new_trace_id
+
+
 # span ids only need process-local uniqueness (trace ids carry the global
 # entropy); a counter is ~3x cheaper than getrandbits+format per span
 _SPAN_SEQ = __import__("itertools").count(1)
@@ -294,6 +300,18 @@ class Tracer:
                 self.slow_threshold_s = float(slow_threshold_s)
             if sample_rate is not None:
                 self.sample_rate = min(max(float(sample_rate), 0.0), 1.0)
+
+    def sampled(self) -> bool:
+        """Head-sampling decision for high-rate span producers (the
+        event-loop edge): decide ONCE at request origination whether
+        this trace would be retained, so an un-sampled request skips
+        span allocation entirely instead of paying the full per-span
+        cost and being dropped at completion anyway.  The trade: the
+        slow-trace tail criterion only sees head-sampled requests on
+        such producers — at sample_rate 1.0 (the default) nothing
+        changes and every trace still completes through the ring."""
+        r = self.sample_rate
+        return r >= 1.0 or (r > 0.0 and random.random() < r)
 
     # ---- completion --------------------------------------------------------
 

@@ -25,6 +25,8 @@ from .. import logging as gklog
 from ..deadline import (
     DeadlineExceeded,
     OverloadShed,
+    pop as deadline_pop,
+    push as deadline_push,
     remaining as deadline_remaining,
 )
 from ..obs import decisionlog as obsdlog
@@ -256,6 +258,213 @@ class ValidationHandler:
             obsslo.observe_admission(status, duration_s)
             if self.reporter is not None:
                 self.reporter.report_request(status, duration_s)
+
+    def handle_many(self, items: List[tuple]) -> List[AdmissionResponse]:
+        """Chunk admission for the wire listener (ISSUE 19): evaluate N
+        parsed requests with ONE batcher enqueue instead of N.
+
+        ``items`` is a list of ``(req, deadline, span)`` — deadline an
+        absolute ``time.monotonic()`` instant or None, span the
+        request's ``admission`` root span or None.  Returns responses
+        aligned with ``items``.
+
+        Semantics are handle()'s, request for request — same check
+        order, same exception taxonomy, same decision-log records, same
+        SLO stream — with the review leg routed through the client's
+        submit_many/wait chunk API when it has one (the MicroBatcher),
+        so the whole chunk costs one producer-lock round.  Traced
+        requests and clients without submit_many fall back to the
+        per-request review path."""
+        n = len(items)
+        out: List[Optional[AdmissionResponse]] = [None] * n
+        meta = [None] * n        # (req, t0, budget_s, deadline, span)
+        to_review: List[tuple] = []   # (idx, AugmentedReview, trace, dump)
+        for idx, (req, deadline, span) in enumerate(items):
+            t0 = time.monotonic()
+            budget_s = (
+                None if deadline is None else max(0.0, deadline - t0)
+            )
+            # --- handle()'s pre-try section: decision-log records only,
+            # no SLO event (preserved asymmetry) ---
+            if self._is_gk_service_account(req):
+                resp = _allowed("Gatekeeper does not self-manage")
+                obsdlog.record_admission(
+                    req, resp, time.monotonic() - t0, budget_s=budget_s)
+                out[idx] = resp
+                continue
+            is_delete = req.get("operation") == "DELETE"
+            if is_delete:
+                if req.get("oldObject") is None:
+                    resp = _denied(
+                        "For admission webhooks registered for DELETE "
+                        "operations, please use Kubernetes v1.15.0+.",
+                        500,
+                    )
+                    obsdlog.record_admission(
+                        req, resp, time.monotonic() - t0,
+                        budget_s=budget_s, hint=obsdlog.CLASS_ERROR)
+                    out[idx] = resp
+                    continue
+                req = dict(req)
+                req["object"] = req["oldObject"]
+            if not is_delete:
+                user_err, err = self._validate_gatekeeper_resources(req)
+                if err is not None:
+                    resp = _denied(err, 422 if user_err else 500)
+                    obsdlog.record_admission(
+                        req, resp, time.monotonic() - t0,
+                        budget_s=budget_s)
+                    out[idx] = resp
+                    continue
+            meta[idx] = (req, t0, budget_s, deadline, span)
+            ns = req.get("namespace") or ""
+            if self.excluder.is_namespace_excluded(WEBHOOK, ns):
+                resp = _allowed(
+                    "Namespace is set to be ignored by Gatekeeper config"
+                )
+                out[idx] = self._finalize_one(
+                    req, resp, t0, budget_s, RESPONSE_ALLOW, None, None,
+                    span)
+                continue
+            try:
+                trace, dump = self._tracing_level(req)
+                review = self._augmented_review(req)
+            except NamespaceNotSynced as e:
+                log.warning("error executing query: %s", e)
+                out[idx] = self._finalize_one(
+                    req, _denied(str(e), 500), t0, budget_s,
+                    RESPONSE_ERROR, obsdlog.CLASS_ERROR, None, span)
+                continue
+            except Exception as e:
+                log.exception("error executing query")
+                out[idx] = self._finalize_one(
+                    req, self._failure_response(str(e), 500,
+                                                FAIL_OPEN_INTERNAL),
+                    t0, budget_s, RESPONSE_ERROR, obsdlog.CLASS_ERROR,
+                    None, span)
+                continue
+            to_review.append((idx, review, trace, dump))
+
+        submit = getattr(self.client, "submit_many", None)
+        waiter = getattr(self.client, "wait", None)
+        batchable: List[tuple] = []
+        for idx, review, trace, dump in to_review:
+            if submit is None or waiter is None or trace:
+                # traced requests want their own trace output (and a
+                # client without the chunk API has no batch lane):
+                # evaluate solo, exactly like _review
+                req, t0, budget_s, deadline, span = meta[idx]
+                out[idx] = self._review_one_direct(
+                    req, review, trace, dump, t0, budget_s, deadline,
+                    span)
+            else:
+                batchable.append((idx, review))
+        if batchable:
+            pendings = submit([
+                (review, meta[idx][3], meta[idx][4])
+                for idx, review in batchable
+            ])
+            for (idx, review), p in zip(batchable, pendings):
+                req, t0, budget_s, deadline, span = meta[idx]
+                results = None
+                try:
+                    resp_obj = waiter(p)
+                    results = resp_obj.results()
+                except Exception as e:
+                    out[idx] = self._finalize_failure(
+                        req, e, t0, budget_s, span)
+                    continue
+                out[idx] = self._finalize_verdict(
+                    req, results, t0, budget_s, span)
+        return out  # type: ignore[return-value]
+
+    def _review_one_direct(self, req, review, trace, dump, t0, budget_s,
+                           deadline, span) -> AdmissionResponse:
+        """handle()'s review leg for one chunk member without the batch
+        lane (traced request, or a client with no submit_many)."""
+        results = None
+        token = None
+        try:
+            if deadline is not None:
+                rem = deadline - time.monotonic()
+                if rem <= 0:
+                    raise DeadlineExceeded(
+                        "admission deadline budget exhausted before "
+                        "evaluation"
+                    )
+                # the wire lane has no ambient deadline (do_POST pushes
+                # one on the HTTP edge): bound the batcher wait by the
+                # caller's REMAINING budget or a traced request parks
+                # its wire worker past the caller's deadline
+                token = deadline_push(rem)
+            resp_obj = self.client.review(review, tracing=trace)
+            if trace:
+                log.info(resp_obj.trace_dump())
+            if dump:
+                log.info(self.client.dump())
+            results = resp_obj.results()
+        except Exception as e:
+            return self._finalize_failure(req, e, t0, budget_s, span)
+        finally:
+            if token is not None:
+                deadline_pop(token)
+        return self._finalize_verdict(req, results, t0, budget_s, span)
+
+    def _finalize_failure(self, req, e, t0, budget_s,
+                          span) -> AdmissionResponse:
+        """handle()'s except-chain, verbatim, for the chunk path."""
+        if isinstance(e, NamespaceNotSynced):
+            log.warning("error executing query: %s", e)
+            return self._finalize_one(
+                req, _denied(str(e), 500), t0, budget_s,
+                RESPONSE_ERROR, obsdlog.CLASS_ERROR, None, span)
+        if isinstance(e, DeadlineExceeded):
+            log.warning("admission deadline budget exhausted")
+            return self._finalize_one(
+                req, self._failure_response(
+                    DEADLINE_MESSAGE, DEADLINE_CODE, FAIL_OPEN_DEADLINE),
+                t0, budget_s, RESPONSE_ERROR, obsdlog.CLASS_EXPIRED,
+                None, span)
+        if isinstance(e, OverloadShed):
+            log.warning("admission request shed under overload")
+            return self._finalize_one(
+                req, self._failure_response(
+                    SHED_MESSAGE, SHED_CODE, FAIL_OPEN_SHED),
+                t0, budget_s, RESPONSE_ERROR, obsdlog.CLASS_SHED,
+                None, span)
+        log.exception("error executing query")
+        return self._finalize_one(
+            req, self._failure_response(str(e), 500, FAIL_OPEN_INTERNAL),
+            t0, budget_s, RESPONSE_ERROR, obsdlog.CLASS_ERROR, None, span)
+
+    def _finalize_verdict(self, req, results, t0, budget_s,
+                          span) -> AdmissionResponse:
+        msgs = self._get_deny_messages(results, req)
+        if msgs:
+            return self._finalize_one(
+                req, _denied("\n".join(msgs), 403), t0, budget_s,
+                RESPONSE_DENY, None, results, span)
+        return self._finalize_one(
+            req, _allowed(), t0, budget_s, RESPONSE_ALLOW, None, results,
+            span)
+
+    def _finalize_one(self, req, resp, t0, budget_s, status, hint,
+                      results, span) -> AdmissionResponse:
+        """handle()'s finally block for one chunk member: status attr on
+        the request's OWN span (the chunk path has no per-request
+        CURRENT), then the identical decision-log + SLO + reporter
+        triple."""
+        duration_s = time.monotonic() - t0
+        if span is not None:
+            span.set_attrs(admission_status=status)
+        obsdlog.record_admission(
+            req, resp, duration_s, budget_s=budget_s, results=results,
+            hint=hint,
+        )
+        obsslo.observe_admission(status, duration_s)
+        if self.reporter is not None:
+            self.reporter.report_request(status, duration_s)
+        return resp
 
     # ---- pieces ------------------------------------------------------------
 

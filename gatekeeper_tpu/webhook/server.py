@@ -57,13 +57,17 @@ def _low_value(obj) -> bool:
     return bool(isinstance(req, dict) and req.get("dryRun"))
 
 
+_SPAN_CURRENT = object()  # _Pending sentinel: adopt the caller's span
+
+
 class _Pending:
     __slots__ = (
         "obj", "event", "result", "error", "deadline", "low_value",
         "span", "queue_span",
     )
 
-    def __init__(self, obj, deadline: Optional[float] = None):
+    def __init__(self, obj, deadline: Optional[float] = None,
+                 span=_SPAN_CURRENT):
         self.obj = obj
         self.event = threading.Event()
         self.result = None
@@ -72,8 +76,12 @@ class _Pending:
         self.low_value = _low_value(obj)
         # explicit cross-thread context passing: the request's active span
         # (linked by the batch span) and its open queue-wait span (ended
-        # by the batch thread when the batch is drained)
-        self.span = obstrace.current_span()
+        # by the batch thread when the batch is drained).  The wire
+        # listener's chunk path has no per-request thread, so it passes
+        # each request's span explicitly instead of relying on CURRENT.
+        if span is _SPAN_CURRENT:
+            span = obstrace.current_span()
+        self.span = span
         self.queue_span = (
             obstrace.detached_span(
                 "webhook.queue_wait", parent=self.span,
@@ -359,6 +367,122 @@ class MicroBatcher:
         if dl is None:
             p.event.wait()
         elif not p.event.wait(timeout=max(0.0, dl - time.monotonic())):
+            raise _deadline.DeadlineExceeded(
+                "admission deadline budget exhausted"
+            )
+        if p.error is not None:
+            raise p.error
+        return p.result
+
+    def submit_many(self, items):
+        """Chunk enqueue (ISSUE 19): admit a whole decoded wire chunk
+        under ONE cv acquisition — the point of the batched door↔replica
+        protocol is that N pipelined requests cost one producer-lock
+        round and one notify, not N.
+
+        ``items`` is an iterable of ``(obj, deadline, span)`` — deadline
+        an absolute monotonic instant or None, span the request's root
+        span or None (the chunk path has no per-request thread, so
+        CURRENT would be wrong).  Returns the list of `_Pending`s, every
+        one of which WILL complete: refusals — stopped batcher, expired
+        budget, queue bound — are delivered as ``p.error`` instead of
+        raised, so the caller finalizes all requests of a chunk through
+        the same :meth:`wait` tail.  Shed accounting (self.sheds,
+        record_shed, dry-run-first eviction) matches review() exactly:
+        the overload taxonomy must not care which transport carried the
+        request."""
+        pendings: List[_Pending] = []
+        for obj, dl, span in items:
+            if faults.ENABLED:
+                faults.fire(faults.WEBHOOK_ENQUEUE)
+            pendings.append(_Pending(obj, deadline=dl, span=span))
+        with self._rate_lock:
+            self._arrivals += len(pendings)
+        now = time.monotonic()
+        stopped = False
+        queued_any = False
+        evictions: List[_Pending] = []
+        refused: List[_Pending] = []   # queue-bound sheds
+        expired: List[_Pending] = []   # dead-on-arrival budgets
+        with self._cv:
+            if self._stop:
+                stopped = True
+            else:
+                for p in pendings:
+                    if p.deadline is not None and now > p.deadline:
+                        expired.append(p)
+                        continue
+                    evicted: Optional[_Pending] = None
+                    if (self.max_pending
+                            and len(self._pending) >= self.max_pending):
+                        if p.low_value:
+                            refused.append(p)
+                            continue
+                        if self._pending_dryruns > 0:
+                            for i, q in enumerate(self._pending):
+                                if q.low_value:
+                                    evicted = self._pending.pop(i)
+                                    self._pending_dryruns -= 1
+                                    break
+                        if evicted is None:
+                            refused.append(p)
+                            continue
+                    self._pending.append(p)
+                    if p.low_value:
+                        self._pending_dryruns += 1
+                    queued_any = True
+                    if evicted is not None:
+                        evictions.append(evicted)
+            if queued_any:
+                self._cv.notify()
+        # deliveries happen OUTSIDE the cv, exactly as in review():
+        # Event.set and registry records must not run under the producer
+        # lock
+        if stopped:
+            for p in pendings:
+                if p.queue_span is not None:
+                    p.queue_span.end()
+                p.error = BatcherStopped("webhook batcher is stopped")
+                p.event.set()
+            return pendings
+        for ev in evictions:
+            with self._rate_lock:
+                self.sheds += 1
+            if ev.queue_span is not None:
+                ev.queue_span.end()
+            ev.error = _deadline.OverloadShed(
+                "dry-run admission preempted by enforced work at the "
+                "pending bound"
+            )
+            ev.event.set()
+            record_shed("queue_full_dryrun")
+        for p in refused:
+            with self._rate_lock:
+                self.sheds += 1
+            if p.queue_span is not None:
+                p.queue_span.end()
+            record_shed("queue_full_dryrun" if p.low_value else "queue_full")
+            p.error = _deadline.OverloadShed(
+                "micro-batcher pending queue is at its bound "
+                f"({self.max_pending})"
+            )
+            p.event.set()
+        for p in expired:
+            if p.queue_span is not None:
+                p.queue_span.end()
+            p.error = _deadline.DeadlineExceeded(
+                "admission deadline budget exhausted before evaluation"
+            )
+            p.event.set()
+        return pendings
+
+    def wait(self, p: "_Pending"):
+        """Block until a submit_many pending completes — the same tail
+        as review(): a deadline-bounded event wait, then the error (if
+        any) raised on the waiter's thread."""
+        if p.deadline is None:
+            p.event.wait()
+        elif not p.event.wait(timeout=max(0.0, p.deadline - time.monotonic())):
             raise _deadline.DeadlineExceeded(
                 "admission deadline budget exhausted"
             )

@@ -762,9 +762,21 @@ class TestRetryBudget:
         assert rb.tokens() == 0.0
 
 
+@pytest.fixture(params=["threaded", "evloop"])
+def door_cls(request):
+    """Both serving edges must survive slow clients: the original
+    thread-per-connection door (socket timeouts) and the ISSUE 19
+    event-loop door (sweep timer) — same externally visible contract."""
+    if request.param == "evloop":
+        from gatekeeper_tpu.fleet.evdoor import EventFrontDoor
+
+        return EventFrontDoor
+    return FrontDoor
+
+
 class TestSlowClientHardening:
-    def test_slowloris_header_stall_is_closed_by_timeout(self):
-        door = FrontDoor(
+    def test_slowloris_header_stall_is_closed_by_timeout(self, door_cls):
+        door = door_cls(
             [("127.0.0.1", _free_port())],
             probe_interval_s=3600.0, header_timeout_s=0.3,
         ).start()
@@ -785,8 +797,8 @@ class TestSlowClientHardening:
         finally:
             door.stop()
 
-    def test_stalled_body_answers_408(self):
-        door = FrontDoor(
+    def test_stalled_body_answers_408(self, door_cls):
+        door = door_cls(
             [("127.0.0.1", _free_port())],
             probe_interval_s=3600.0, header_timeout_s=0.3,
         ).start()
@@ -810,8 +822,9 @@ class TestSlowClientHardening:
         finally:
             door.stop()
 
-    def test_oversized_body_answers_413_without_reading(self, live_backend):
-        door = FrontDoor(
+    def test_oversized_body_answers_413_without_reading(
+            self, live_backend, door_cls):
+        door = door_cls(
             [{"host": "127.0.0.1", "port": live_backend.port,
               "replica_id": "live"}], probe_interval_s=3600.0,
         ).start()

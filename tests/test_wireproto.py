@@ -1,0 +1,215 @@
+"""Wire-protocol framing contract (ISSUE 19 satellite): the batched
+chunk codec between the event-loop front door and the replica listener.
+
+The decoder is incremental and byte-exact: partial reads in any split,
+pipelined back-to-back frames sharing one buffer, a frame split across
+N recv() calls, and corruption (bad magic, truncated records, oversize
+payloads, stray trailing bytes) all have defined behaviour.  The body
+travels as an opaque byte splice — hash-checked here so no JSON
+round-trip can silently reshape it."""
+
+import hashlib
+import json
+import math
+import struct
+
+import pytest
+
+from gatekeeper_tpu.fleet import wireproto
+from gatekeeper_tpu.fleet.wireproto import (
+    KIND_REQUEST,
+    KIND_RESPONSE,
+    FrameDecoder,
+    ProtocolError,
+    RequestRecord,
+    ResponseRecord,
+    encode_request_chunk,
+    encode_response_chunk,
+)
+
+
+def _reqs(n, body=b'{"request":{}}'):
+    return [RequestRecord(i + 1, "/v1/admit", body, 250.0 + i, f"tp-{i}")
+            for i in range(n)]
+
+
+class TestRoundTrip:
+    def test_request_chunk_round_trips(self):
+        recs = _reqs(3)
+        frames = FrameDecoder().feed(encode_request_chunk(recs))
+        assert frames == [(KIND_REQUEST, recs)]
+
+    def test_response_chunk_round_trips(self):
+        recs = [ResponseRecord(7, 200, b'{"ok":1}'),
+                ResponseRecord(8, 503, b"draining"),
+                ResponseRecord(9, 200, b"")]
+        frames = FrameDecoder().feed(encode_response_chunk(recs))
+        assert frames == [(KIND_RESPONSE, recs)]
+
+    def test_none_deadline_survives_the_nan_encoding(self):
+        rec = RequestRecord(1, "/v1/admit", b"{}", None, "")
+        [(_, [got])] = FrameDecoder().feed(encode_request_chunk([rec]))
+        assert got.deadline_ms is None
+        assert got == rec
+
+    def test_deadline_is_a_float_of_remaining_ms(self):
+        [(_, [got])] = FrameDecoder().feed(
+            encode_request_chunk(
+                [RequestRecord(1, "/v1/admit", b"{}", 123.456, "")]))
+        assert got.deadline_ms == pytest.approx(123.456)
+        assert not math.isnan(got.deadline_ms)
+
+    def test_unicode_path_and_traceparent(self):
+        rec = RequestRecord(1, "/v1/admitlabel", b"{}", None,
+                            "00-aabb-ccdd-01")
+        [(_, [got])] = FrameDecoder().feed(encode_request_chunk([rec]))
+        assert got.path == "/v1/admitlabel"
+        assert got.traceparent == "00-aabb-ccdd-01"
+
+
+class TestByteSplice:
+    """The admission body is spliced through the codec verbatim —
+    byte-for-byte, hash-checked, no JSON normalisation."""
+
+    def test_body_bytes_hash_identical(self):
+        # oddly-spaced JSON with non-ASCII and escapes: any re-encode
+        # would change these bytes
+        body = ('{ "request" :\t{"uid": "u-é", '
+                '"raw": "\\u0041\\n"}  }').encode("utf-8")
+        want = hashlib.sha256(body).hexdigest()
+        [(_, [got])] = FrameDecoder().feed(
+            encode_request_chunk(
+                [RequestRecord(1, "/v1/admit", body, None, "")]))
+        assert hashlib.sha256(got.body).hexdigest() == want
+        assert json.loads(got.body)["request"]["uid"] == "u-é"
+
+    def test_binary_response_body_survives(self):
+        body = bytes(range(256)) * 3
+        [(_, [got])] = FrameDecoder().feed(
+            encode_response_chunk([ResponseRecord(1, 200, body)]))
+        assert got.body == body
+
+
+class TestIncrementalDecode:
+    def test_byte_at_a_time(self):
+        recs = _reqs(4)
+        blob = encode_request_chunk(recs)
+        dec = FrameDecoder()
+        frames = []
+        for i in range(len(blob)):
+            frames.extend(dec.feed(blob[i:i + 1]))
+            # nothing may surface before the final byte
+            assert bool(frames) == (i == len(blob) - 1)
+        assert frames == [(KIND_REQUEST, recs)]
+        assert dec.buffered == 0
+
+    def test_frame_split_across_n_recvs(self):
+        recs = _reqs(5, body=b"x" * 1000)
+        blob = encode_request_chunk(recs)
+        for n in (2, 3, 7):
+            dec = FrameDecoder()
+            frames = []
+            step = max(1, len(blob) // n)
+            for i in range(0, len(blob), step):
+                frames.extend(dec.feed(blob[i:i + step]))
+            assert frames == [(KIND_REQUEST, recs)]
+
+    def test_pipelined_frames_sharing_one_buffer(self):
+        a, b = _reqs(2), _reqs(3, body=b'{"other":1}')
+        resp = [ResponseRecord(9, 200, b"ok")]
+        blob = (encode_request_chunk(a) + encode_response_chunk(resp)
+                + encode_request_chunk(b))
+        frames = FrameDecoder().feed(blob)
+        assert frames == [(KIND_REQUEST, a), (KIND_RESPONSE, resp),
+                          (KIND_REQUEST, b)]
+
+    def test_split_straddling_a_frame_boundary(self):
+        a, b = _reqs(1), _reqs(1, body=b"second")
+        blob = encode_request_chunk(a) + encode_request_chunk(b)
+        cut = len(encode_request_chunk(a)) - 3
+        dec = FrameDecoder()
+        first = dec.feed(blob[:cut])
+        assert first == []
+        rest = dec.feed(blob[cut:])
+        assert rest == [(KIND_REQUEST, a), (KIND_REQUEST, b)]
+
+    def test_buffered_counts_pending_bytes(self):
+        blob = encode_request_chunk(_reqs(1))
+        dec = FrameDecoder()
+        dec.feed(blob[:10])
+        assert dec.buffered == 10
+        dec.feed(blob[10:])
+        assert dec.buffered == 0
+
+
+class TestCorruption:
+    def test_bad_magic_is_a_protocol_error(self):
+        blob = bytearray(encode_request_chunk(_reqs(1)))
+        blob[0] = ord("X")
+        with pytest.raises(ProtocolError):
+            FrameDecoder().feed(bytes(blob))
+
+    def test_unknown_kind_is_a_protocol_error(self):
+        blob = bytearray(encode_request_chunk(_reqs(1)))
+        blob[4] = 9
+        with pytest.raises(ProtocolError):
+            FrameDecoder().feed(bytes(blob))
+
+    def test_truncated_records_inside_payload(self):
+        # header promises more records than the payload carries
+        recs = _reqs(1)
+        blob = bytearray(encode_request_chunk(recs))
+        # bump count from 1 to 2 without adding bytes
+        magic, kind, count, plen = wireproto._HDR.unpack_from(blob, 0)
+        wireproto._HDR.pack_into(blob, 0, magic, kind, 2, plen)
+        with pytest.raises(ProtocolError):
+            FrameDecoder().feed(bytes(blob))
+
+    def test_stray_trailing_bytes_in_payload(self):
+        recs = _reqs(1)
+        blob = bytearray(encode_request_chunk(recs))
+        magic, kind, count, plen = wireproto._HDR.unpack_from(blob, 0)
+        blob += b"JUNK"
+        wireproto._HDR.pack_into(blob, 0, magic, kind, count, plen + 4)
+        with pytest.raises(ProtocolError):
+            FrameDecoder().feed(bytes(blob))
+
+    def test_oversize_payload_rejected_before_buffering(self):
+        hdr = wireproto._HDR.pack(wireproto.MAGIC, KIND_REQUEST, 1,
+                                  wireproto.MAX_PAYLOAD + 1)
+        with pytest.raises(ProtocolError):
+            FrameDecoder().feed(hdr)
+
+    def test_decoder_is_dead_after_an_error(self):
+        dec = FrameDecoder()
+        with pytest.raises(ProtocolError):
+            dec.feed(b"XXXX" + b"\x00" * 7)
+        # connection death mid-stream: a decoder that raised must not
+        # be fed again as though nothing happened
+        with pytest.raises(ProtocolError):
+            dec.feed(encode_request_chunk(_reqs(1)))
+
+
+class TestEncodeBounds:
+    def test_empty_chunk_is_refused(self):
+        with pytest.raises(ProtocolError):
+            encode_request_chunk([])
+        with pytest.raises(ProtocolError):
+            encode_response_chunk([])
+
+    def test_record_count_bound(self):
+        recs = _reqs(1) * (wireproto.MAX_RECORDS + 1)
+        with pytest.raises(ProtocolError):
+            encode_request_chunk(recs)
+
+    def test_payload_bound(self):
+        rec = RequestRecord(1, "/v1/admit",
+                            b"x" * (wireproto.MAX_PAYLOAD + 1), None, "")
+        with pytest.raises(ProtocolError):
+            encode_request_chunk([rec])
+
+    def test_header_struct_is_stable(self):
+        # the frame header is part of the door<->replica ABI: 4s magic,
+        # u8 kind, u16 count, u32 payload length
+        assert wireproto._HDR.size == struct.calcsize("!4sBHI")
+        assert wireproto.MAGIC == b"GKW1"

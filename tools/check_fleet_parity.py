@@ -16,10 +16,14 @@ tests/test_fleet_parity_tool.py; also runnable standalone):
 3. Oracle parity: allow/deny and the rendered violation text (sans the
    webhook's "[denied by ...]" prefix) must match a freshly loaded
    interpreter oracle evaluating the same requests byte-for-byte.
+4. Event-edge fidelity (ISSUE 19): the same corpus through the
+   selectors-based front door — persistent connections, batched wire
+   protocol to the replicas' wire listeners — must answer byte-identical
+   bodies too.  The door is a byte splice on both edges or it is wrong.
 
-Run: python tools/check_fleet_parity.py  (exit 0 clean, 1 with
-findings).  Spawns 3 replica subprocesses; where process spawn is
-unavailable the tier-1 wrapper skips cleanly.
+Run: python tools/check_fleet_parity.py [--edge threaded|evloop|both]
+(exit 0 clean, 1 with findings).  Spawns 3 replica subprocesses; where
+process spawn is unavailable the tier-1 wrapper skips cleanly.
 """
 
 from __future__ import annotations
@@ -124,10 +128,15 @@ def diff_verdicts(raw_bodies, oracle_verdicts) -> list:
     return problems
 
 
-def run_checks() -> list:
+def run_checks(edge: str = "both") -> list:
     import shutil
 
-    from gatekeeper_tpu.fleet import FrontDoor, spawn_fleet, spawn_replica
+    from gatekeeper_tpu.fleet import (
+        EventFrontDoor,
+        FrontDoor,
+        spawn_fleet,
+        spawn_replica,
+    )
     from gatekeeper_tpu.snapshot import Snapshotter
     from gatekeeper_tpu.util.synthetic import build_driver
 
@@ -162,7 +171,8 @@ def run_checks() -> list:
                 )
         if problems:
             return problems
-        door = FrontDoor([h.backend() for h in fleet]).start()
+        if edge in ("threaded", "both"):
+            door = FrontDoor([h.backend() for h in fleet]).start()
 
         raw: dict = {h.replica_id: [] for h in [solo] + fleet}
         door_bodies = []
@@ -176,6 +186,8 @@ def run_checks() -> list:
                         f"answered {st}"
                     )
                 raw[h.replica_id].append(data)
+            if door is None:
+                continue
             st, hd, data = _post(door.port, body)
             if st != 200:
                 problems.append(f"request {i}: front door answered {st}")
@@ -198,6 +210,44 @@ def run_checks() -> list:
                     f"replica answer (door {len(data)}B, "
                     f"replica {len(raw['solo'][i])}B)"
                 )
+
+        # event-loop edge (ISSUE 19): the same corpus through the
+        # selectors door + batched wire protocol.  The replica parses
+        # the AdmissionReview once at its wire listener and the door
+        # splices bytes both ways, so the body must STILL be identical
+        # to what the HTTP listener answers for the same request.
+        if edge in ("evloop", "both"):
+            missing = [h.replica_id for h in fleet if not h.wire_port]
+            if missing:
+                return problems + [
+                    f"replicas {missing} announced no wire_port — the "
+                    "event edge cannot be driven"
+                ]
+            evdoor = EventFrontDoor(
+                [h.wire_backend() for h in fleet]).start()
+            try:
+                for i, req in enumerate(reqs):
+                    body = json.dumps({"request": req}).encode()
+                    st, hd, data = _post(evdoor.port, body)
+                    if st != 200:
+                        problems.append(
+                            f"request {i}: event-loop door answered {st}"
+                        )
+                        continue
+                    rid = hd.get("X-GK-Replica", "")
+                    if rid not in raw:
+                        problems.append(
+                            f"request {i}: event-loop door attributed "
+                            f"to unknown replica {rid!r}"
+                        )
+                    if data != raw["solo"][i]:
+                        problems.append(
+                            f"request {i}: event-edge body differs from "
+                            f"the replica answer (edge {len(data)}B, "
+                            f"replica {len(raw['solo'][i])}B)"
+                        )
+            finally:
+                evdoor.stop()
         return problems
     finally:
         if door is not None:
@@ -210,7 +260,15 @@ def run_checks() -> list:
 
 
 def main() -> int:
-    problems = run_checks()
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--edge", choices=("threaded", "evloop", "both"),
+                    default="both",
+                    help="which serving edge(s) to drive the corpus "
+                         "through (default: both)")
+    args = ap.parse_args()
+    problems = run_checks(edge=args.edge)
     if problems:
         print("fleet parity check FAILED:")
         for p in problems:
@@ -218,8 +276,8 @@ def main() -> int:
         return 1
     print(
         f"fleet parity ok: {N_REQUESTS} requests byte-identical across "
-        f"solo + 2 fleet replicas, front-door fidelity verified, "
-        f"verdicts match the interpreter oracle"
+        f"solo + 2 fleet replicas, front-door fidelity verified on the "
+        f"{args.edge} edge(s), verdicts match the interpreter oracle"
     )
     return 0
 

@@ -22,9 +22,13 @@ the check asserts the overload contract of docs/failure-modes.md:
 4. **nothing unexplained** — no 502s, no connection errors, no
    responses outside the (accepted | shed | expired) taxonomy.
 
-Run: python tools/check_overload.py  (exit 0 clean, 1 with findings).
-Spawns replica subprocesses; where spawn is unavailable the tier-1
-wrapper skips cleanly (same contract as check_self_heal).
+Run: python tools/check_overload.py [--edge threaded|evloop|both]
+(exit 0 clean, 1 with findings).  ``--edge evloop`` drives the same
+burst through the ISSUE 19 selectors-based front door and the replicas'
+wire listeners — the overload contract is edge-independent and tier-1
+proves it on both via ``--edge both`` (one fleet, both doors back to
+back).  Spawns replica subprocesses; where spawn is unavailable the
+tier-1 wrapper skips cleanly (same contract as check_self_heal).
 """
 
 from __future__ import annotations
@@ -50,7 +54,7 @@ N_TEMPLATES = 2
 N_RESOURCES = 64
 N_CORPUS = 48
 N_CLIENTS = 10          # closed-loop threads, far past a 1-inflight door
-BURST_S = 4.0
+BURST_S = 3.0
 MAX_PENDING = 8         # replica-side batcher bound
 MAX_INFLIGHT = 1        # door-side per-backend bound
 BUDGET_S = 2.0          # door admission budget
@@ -95,40 +99,9 @@ classify = classify_response
 _verdict_matches = verdict_matches
 
 
-def run_checks() -> list:
-    import shutil
-
-    from gatekeeper_tpu.fleet import FrontDoor, spawn_fleet
-    from gatekeeper_tpu.snapshot import Snapshotter
-    from gatekeeper_tpu.util.synthetic import build_driver
-
+def _drive_door(door, edge: str, reqs, bodies, oracle_verdicts) -> list:
     problems: list = []
-    root = tempfile.mkdtemp(prefix="gk-overload-")
-    snap_dir = os.path.join(root, "snap")
-    cache_dir = os.path.join(root, "cache")
-    os.makedirs(snap_dir)
-    os.makedirs(cache_dir)
-    handles: list = []
-    door = None
     try:
-        client = build_driver(N_TEMPLATES, N_RESOURCES)
-        client.audit_capped(50)
-        if Snapshotter(client, snap_dir, interval_s=0.0).write_once() is None:
-            return ["snapshot write failed; cannot stage the fleet"]
-        reqs = _requests()
-        oracle_verdicts = _oracle_verdicts(reqs)
-        bodies = [json.dumps({"request": r}).encode() for r in reqs]
-
-        handles = spawn_fleet(
-            2, snapshot_dir=snap_dir, cache_dir=cache_dir,
-            env={"JAX_PLATFORMS": "cpu"},
-            extra_flags=["--webhook-max-pending", str(MAX_PENDING)],
-        )
-        door = FrontDoor(
-            [h.backend() for h in handles], probe_interval_s=0.1,
-            max_inflight=MAX_INFLIGHT, admission_budget_s=BUDGET_S,
-        ).start()
-
         results: list = []  # (kind, dur_s, status, out, corpus_idx)
         lock = threading.Lock()
         stop = time.monotonic() + BURST_S
@@ -221,32 +194,102 @@ def run_checks() -> list:
             )
 
         print(
-            f"overload: {len(results)} responses in {BURST_S:.0f}s — "
-            f"{by_kind}; door sheds {len(door_sheds)} "
+            f"overload [{edge}]: {len(results)} responses in "
+            f"{BURST_S:.0f}s — {by_kind}; door sheds {len(door_sheds)} "
             f"(p99 {door_sheds[-1] * 1e3:.1f}ms max) ; door stats "
             f"{json.dumps(door.stats()['retry_budget'])}",
             file=sys.stderr,
         )
         return problems
     finally:
-        if door is not None:
-            door.stop()
+        door.stop()
+
+
+def run_checks(edge: str = "threaded") -> list:
+    """Drive the saturation burst through the requested serving edge(s).
+
+    ``edge="both"`` stages ONE snapshot + replica fleet and drives the
+    threaded door and the event-loop door against it back to back —
+    the fleet spawn dominates the tool's runtime, and the contract
+    being asserted is a property of the doors, not of the replicas.
+    """
+    import shutil
+
+    from gatekeeper_tpu.fleet import EventFrontDoor, FrontDoor, spawn_fleet
+    from gatekeeper_tpu.snapshot import Snapshotter
+    from gatekeeper_tpu.util.synthetic import build_driver
+
+    problems: list = []
+    root = tempfile.mkdtemp(prefix="gk-overload-")
+    snap_dir = os.path.join(root, "snap")
+    cache_dir = os.path.join(root, "cache")
+    os.makedirs(snap_dir)
+    os.makedirs(cache_dir)
+    handles: list = []
+    try:
+        client = build_driver(N_TEMPLATES, N_RESOURCES)
+        client.audit_capped(50)
+        if Snapshotter(client, snap_dir, interval_s=0.0).write_once() is None:
+            return ["snapshot write failed; cannot stage the fleet"]
+        reqs = _requests()
+        oracle_verdicts = _oracle_verdicts(reqs)
+        bodies = [json.dumps({"request": r}).encode() for r in reqs]
+
+        handles = spawn_fleet(
+            2, snapshot_dir=snap_dir, cache_dir=cache_dir,
+            env={"JAX_PLATFORMS": "cpu"},
+            extra_flags=["--webhook-max-pending", str(MAX_PENDING)],
+        )
+        edges = ("threaded", "evloop") if edge == "both" else (edge,)
+        for e in edges:
+            if e == "evloop":
+                missing = [h.replica_id for h in handles if not h.wire_port]
+                if missing:
+                    problems.append(
+                        f"replicas {missing} announced no wire_port — "
+                        "the event edge cannot be driven")
+                    continue
+                door = EventFrontDoor(
+                    [h.wire_backend() for h in handles],
+                    probe_interval_s=0.1, max_inflight=MAX_INFLIGHT,
+                    admission_budget_s=BUDGET_S,
+                ).start()
+            else:
+                door = FrontDoor(
+                    [h.backend() for h in handles], probe_interval_s=0.1,
+                    max_inflight=MAX_INFLIGHT, admission_budget_s=BUDGET_S,
+                ).start()
+            problems.extend(
+                f"[{e}] {p}"
+                for p in _drive_door(door, e, reqs, bodies, oracle_verdicts))
+        return problems
+    finally:
         for h in handles:
             h.stop()
         shutil.rmtree(root, ignore_errors=True)
 
 
 def main() -> int:
-    problems = run_checks()
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--edge", choices=("threaded", "evloop", "both"),
+                    default="threaded",
+                    help="which serving edge to saturate (evloop = the "
+                         "ISSUE 19 event-loop door + wire listeners; "
+                         "both = one fleet, both doors back to back)")
+    args = ap.parse_args()
+    problems = run_checks(edge=args.edge)
     if problems:
         print("overload check FAILED:")
         for p in problems:
             print(f"  - {p}")
         return 1
     print(
-        "overload ok: the saturation burst shed fast with explicit "
-        "fail-open/closed verdicts, kept goodput, and accepted "
-        "requests matched the interpreter oracle with zero divergence"
+        f"overload ok ({args.edge} edge): the saturation burst shed "
+        "fast with explicit fail-open/closed verdicts, kept goodput, "
+        "and accepted requests matched the interpreter oracle with "
+        "zero divergence"
     )
     return 0
 
