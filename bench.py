@@ -2131,6 +2131,139 @@ def bench_synthetic() -> dict:
     }
 
 
+def _pipelined_drive(port: int, req_b: bytes, n_total: int,
+                     n_clients: int = 2, window: int = 256,
+                     timeout: float = 300.0):
+    """Closed-loop persistent PIPELINED clients (EDGE_r19 satellite 1,
+    shared with the edge-observability config): each keeps ``window``
+    requests in flight on one connection and counts fixed-length
+    responses by byte arithmetic, so the client side stays cheap enough
+    not to mask the door.  Requires every response to be
+    byte-length-identical (one fixed request body; trace ids and
+    replica ids are fixed-width)."""
+    import socket
+    import threading
+
+    done: dict = {}
+
+    def _c(tid: int, n: int) -> None:
+        s = socket.create_connection(("127.0.0.1", port),
+                                     timeout=timeout)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        s.settimeout(timeout)
+        batch = req_b * 16
+        sent = got_b = recv = 0
+        rlen = None
+        buf = b""
+        try:
+            while recv < n:
+                while sent - recv < window and sent < n:
+                    s.sendall(batch)
+                    sent += 16
+                data = s.recv(1 << 20)
+                if not data:
+                    break
+                if rlen is None:
+                    buf += data
+                    i = buf.find(b"\r\n\r\n")
+                    if i < 0:
+                        continue
+                    m = re.search(
+                        r"content-length:\s*(\d+)",
+                        buf[:i].decode("latin-1").lower())
+                    rlen = i + 4 + int(m.group(1))
+                    got_b = len(buf)
+                    buf = b""
+                else:
+                    got_b += len(data)
+                recv = got_b // rlen
+        finally:
+            done[tid] = min(recv, n)
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    per = n_total // n_clients
+    ts = [threading.Thread(target=_c, args=(i, per))
+          for i in range(n_clients)]
+    t0 = time.perf_counter()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout + 60.0)
+        if t.is_alive():
+            raise RuntimeError("edge pipelined client wedged "
+                               "(no completion in time)")
+    return sum(done.values()), time.perf_counter() - t0
+
+
+def _stub_wire_responder(canned: bytes):
+    """In-process GKW1 stub: answers every request record of every
+    chunk with ``canned`` (a real AdmissionReview body), parsing only
+    the frame skeleton — the EDGE_r19 door-capacity recipe, isolating
+    the door's data plane from engine throughput.  Returns the bound
+    listening socket (close it to stop the accept thread)."""
+    import socket
+    import struct
+    import threading
+
+    from gatekeeper_tpu.fleet import wireproto as _wp
+
+    _hdrS = _wp._HDR
+    _reqS = _wp._REQ
+    resp_mid = struct.pack("!HI", 200, len(canned)) + canned
+    resp_rec = 10 + len(canned)
+    rid_pack = struct.Struct("!I").pack
+
+    lsock = socket.socket()
+    lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(8)
+
+    def _conn(sk):
+        sk.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        rbuf = bytearray()
+        try:
+            while True:
+                d = sk.recv(1 << 20)
+                if not d:
+                    return
+                rbuf += d
+                out: list = []
+                while len(rbuf) >= _hdrS.size:
+                    _m, _k, count, plen = _hdrS.unpack_from(rbuf, 0)
+                    if len(rbuf) < _hdrS.size + plen:
+                        break
+                    off = _hdrS.size
+                    for _ in range(count):
+                        rid, _dl, pl, tl, bl = _reqS.unpack_from(
+                            rbuf, off)
+                        off += _reqS.size + pl + tl + bl
+                        out.append(rid_pack(rid))
+                        out.append(resp_mid)
+                    del rbuf[:_hdrS.size + plen]
+                if out:
+                    n_recs = len(out) // 2
+                    sk.sendall(_hdrS.pack(
+                        _wp.MAGIC, _wp.KIND_RESPONSE, n_recs,
+                        n_recs * resp_rec) + b"".join(out))
+        except OSError:
+            return
+
+    def _accept():
+        while True:
+            try:
+                sk, _addr = lsock.accept()
+            except OSError:
+                return
+            threading.Thread(target=_conn, args=(sk,),
+                             daemon=True).start()
+
+    threading.Thread(target=_accept, daemon=True).start()
+    return lsock
+
+
 def bench_fleet() -> dict:
     """Fleet serving (docs/fleet.md, ISSUE 7): N webhook-only replica
     processes restore ONE shared sealed snapshot + AOT cache, sit behind
@@ -2148,13 +2281,20 @@ def bench_fleet() -> dict:
         restored corpus through review_batch concurrently (the batch1m
         chunk shape, in-process per replica so the HTTP framing cost —
         measured separately above — does not mask engine throughput).
+
+    BENCH_EDGE selects the front door serving the fleet sections:
+    "evloop" (default — the selectors reactor over the replicas' wire
+    listeners) or "threaded" (the deprecated thread-per-request
+    FrontDoor, kept measurable behind this explicit opt-in; see
+    docs/fleet.md).  The dedicated event-edge rounds (EDGE_r19) run in
+    either mode.
     """
     import http.client as _httpc
     import shutil
     import tempfile
     import threading
 
-    from gatekeeper_tpu.fleet import FrontDoor, spawn_fleet
+    from gatekeeper_tpu.fleet import EventFrontDoor, FrontDoor, spawn_fleet
     from gatekeeper_tpu.snapshot import Snapshotter
     from gatekeeper_tpu.util.synthetic import (
         build_driver,
@@ -2169,6 +2309,10 @@ def bench_fleet() -> dict:
     chunk = int(os.environ.get("BENCH_FLEET_CHUNK", "16384"))
     n_latency = int(os.environ.get("BENCH_FLEET_LATENCY_N", "400"))
     n_parity = int(os.environ.get("BENCH_FLEET_PARITY_N", "64"))
+    edge_kind = os.environ.get("BENCH_EDGE", "evloop")
+    if edge_kind not in ("evloop", "threaded"):
+        raise RuntimeError(f"BENCH_EDGE={edge_kind!r}: expected "
+                           "'evloop' or 'threaded'")
 
     root = tempfile.mkdtemp(prefix="gk-fleet-bench-")
     snap_dir = os.path.join(root, "snap")
@@ -2254,7 +2398,20 @@ def bench_fleet() -> dict:
             for h in handles
         ))
 
-        door = FrontDoor([h.backend() for h in handles]).start()
+        # the event door is the default serving edge (satellite of
+        # ISSUE 20: the threaded FrontDoor is deprecated and must be
+        # asked for explicitly with BENCH_EDGE=threaded)
+        if edge_kind == "threaded":
+            door = FrontDoor([h.backend() for h in handles]).start()
+        else:
+            no_wire = [h.replica_id for h in handles if not h.wire_port]
+            if no_wire:
+                raise RuntimeError(
+                    f"replicas {no_wire} announced no wire port — the "
+                    "default evloop edge cannot serve (BENCH_EDGE="
+                    "threaded to force the deprecated door)")
+            door = EventFrontDoor(
+                [h.wire_backend() for h in handles]).start()
 
         # ---- parity: byte-identical across replicas, verdicts vs oracle --
         parity = True
@@ -2615,8 +2772,8 @@ def bench_fleet() -> dict:
         # ---- event-loop edge (ISSUE 19, recorded EDGE_r19) ---------------
         # The selectors-based serving edge over the SAME warm replicas:
         #   (a) persistent-connection latency with per-stage p50s from
-        #       the ring traces (sample 1.0), against the threaded-door
-        #       stage numbers measured above;
+        #       the ring traces (sample 1.0), against the front
+        #       section's stage numbers above (the BENCH_EDGE door);
         #   (b) the door-capacity headline against an in-process stub
         #       wire responder — the front door's own data plane
         #       (accept/parse/route/splice/write), isolated from engine
@@ -2630,11 +2787,7 @@ def bench_fleet() -> dict:
         #       tight-bounded door, 10x closed-loop saturation, shed
         #       p99 and zero verdict divergence vs the oracle.
         import gc
-        import socket
-        import struct
 
-        from gatekeeper_tpu.fleet import wireproto as _wp
-        from gatekeeper_tpu.fleet.evdoor import EventFrontDoor
         from gatekeeper_tpu.util.overloadcheck import (
             ACCEPTED,
             PROBLEM,
@@ -2665,75 +2818,12 @@ def bench_fleet() -> dict:
         #   - the paired profiler rounds above END with the replicas'
         #     sampling profiler armed (the last pair's second arm is
         #     "on"), so every replica would keep waking at DEFAULT_HZ;
-        #   - the threaded door is done serving: its prober re-probes
-        #     the fleet every 250ms.  stats() below reads counters,
-        #     which survive stop().
+        #   - the front-section door is done serving: its prober
+        #     re-probes the fleet every 250ms.  stats() below reads
+        #     counters, which survive stop().
         for h in handles:
             h.command({"cmd": "profiler", "hz": 0.0})
         door.stop()
-
-        def _pipelined_drive(port: int, req_b: bytes, n_total: int,
-                             n_clients: int = 2, window: int = 256,
-                             timeout: float = 300.0):
-            """Closed-loop persistent PIPELINED clients (satellite 1):
-            each keeps ``window`` requests in flight on one connection
-            and counts fixed-length responses by byte arithmetic, so
-            the client side stays cheap enough not to mask the door.
-            Requires every response to be byte-length-identical (one
-            fixed request body; trace ids and replica ids are
-            fixed-width)."""
-            done: dict = {}
-
-            def _c(tid: int, n: int) -> None:
-                s = socket.create_connection(("127.0.0.1", port),
-                                             timeout=timeout)
-                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                s.settimeout(timeout)
-                batch = req_b * 16
-                sent = got_b = recv = 0
-                rlen = None
-                buf = b""
-                try:
-                    while recv < n:
-                        while sent - recv < window and sent < n:
-                            s.sendall(batch)
-                            sent += 16
-                        data = s.recv(1 << 20)
-                        if not data:
-                            break
-                        if rlen is None:
-                            buf += data
-                            i = buf.find(b"\r\n\r\n")
-                            if i < 0:
-                                continue
-                            m = re.search(
-                                r"content-length:\s*(\d+)",
-                                buf[:i].decode("latin-1").lower())
-                            rlen = i + 4 + int(m.group(1))
-                            got_b = len(buf)
-                            buf = b""
-                        else:
-                            got_b += len(data)
-                        recv = got_b // rlen
-                finally:
-                    done[tid] = min(recv, n)
-                    try:
-                        s.close()
-                    except OSError:
-                        pass
-
-            per = n_total // n_clients
-            ts = [threading.Thread(target=_c, args=(i, per))
-                  for i in range(n_clients)]
-            t0 = time.perf_counter()
-            for t in ts:
-                t.start()
-            for t in ts:
-                t.join(timeout + 60.0)
-                if t.is_alive():
-                    raise RuntimeError("edge pipelined client wedged "
-                                       "(no completion in time)")
-            return sum(done.values()), time.perf_counter() - t0
 
         edoor = EventFrontDoor([h.wire_backend() for h in handles]).start()
         odoor = None
@@ -2780,13 +2870,13 @@ def bench_fleet() -> dict:
                            for s, xs in e_per_stage.items()}
             e_stage_p99 = {s: pct(sorted(xs), 0.99)
                            for s, xs in e_per_stage.items()}
-            stage_p50_vs_threaded = {
-                s: {"threaded_ms": stage_p50.get(s),
+            stage_p50_vs_front = {
+                s: {f"{edge_kind}_ms": stage_p50.get(s),
                     "evloop_ms": e_stage_p50.get(s)}
                 for s in WIRE_STAGES
             }
             log(f"fleet: event edge wire p50={pct(e_durs, 0.50)}ms over "
-                f"{len(e_wire)} traces; stage p50 vs threaded: "
+                f"{len(e_wire)} traces; stage p50 vs {edge_kind} front: "
                 + ", ".join(
                     f"{s} {e_stage_p50.get(s)}/{stage_p50.get(s)}"
                     for s in ("accept", "proxy_connect", "write_back")))
@@ -2797,59 +2887,7 @@ def bench_fleet() -> dict:
             # bytes, parsing only the frame skeleton (req ids) so the
             # responder does not tax the core the door is measured on.
             canned = last_body or b"{}"
-            _hdrS = _wp._HDR
-            _reqS = _wp._REQ
-            resp_mid = (struct.pack("!HI", 200, len(canned)) + canned)
-            resp_rec = 10 + len(canned)
-            rid_pack = struct.Struct("!I").pack
-
-            cap_lsock = socket.socket()
-            cap_lsock.setsockopt(socket.SOL_SOCKET,
-                                 socket.SO_REUSEADDR, 1)
-            cap_lsock.bind(("127.0.0.1", 0))
-            cap_lsock.listen(8)
-
-            def _stub_accept():
-                while True:
-                    try:
-                        sk, _addr = cap_lsock.accept()
-                    except OSError:
-                        return
-                    threading.Thread(target=_stub_conn, args=(sk,),
-                                     daemon=True).start()
-
-            def _stub_conn(sk):
-                sk.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                rbuf = bytearray()
-                try:
-                    while True:
-                        d = sk.recv(1 << 20)
-                        if not d:
-                            return
-                        rbuf += d
-                        out: list = []
-                        while len(rbuf) >= _hdrS.size:
-                            _m, _k, count, plen = _hdrS.unpack_from(
-                                rbuf, 0)
-                            if len(rbuf) < _hdrS.size + plen:
-                                break
-                            off = _hdrS.size
-                            for _ in range(count):
-                                rid, _dl, pl, tl, bl = _reqS.unpack_from(
-                                    rbuf, off)
-                                off += _reqS.size + pl + tl + bl
-                                out.append(rid_pack(rid))
-                                out.append(resp_mid)
-                            del rbuf[:_hdrS.size + plen]
-                        if out:
-                            n_recs = len(out) // 2
-                            sk.sendall(_hdrS.pack(
-                                _wp.MAGIC, _wp.KIND_RESPONSE, n_recs,
-                                n_recs * resp_rec) + b"".join(out))
-                except OSError:
-                    return
-
-            threading.Thread(target=_stub_accept, daemon=True).start()
+            cap_lsock = _stub_wire_responder(canned)
             cap_door = EventFrontDoor(
                 [{"host": "127.0.0.1",
                   "port": cap_lsock.getsockname()[1],
@@ -3007,7 +3045,8 @@ def bench_fleet() -> dict:
                 "wire_traces": len(e_wire),
                 "stage_p50_ms": e_stage_p50,
                 "stage_p99_ms": e_stage_p99,
-                "stage_p50_vs_threaded": stage_p50_vs_threaded,
+                "front_door_edge": edge_kind,
+                "stage_p50_vs_front_door": stage_p50_vs_front,
                 "overload": {
                     "counts": o_counts,
                     "shed_p99_ms": shed_p99,
@@ -3101,6 +3140,257 @@ def bench_fleet() -> dict:
         for h in handles:
             h.stop()
         shutil.rmtree(root, ignore_errors=True)
+
+
+def bench_edge_obs() -> dict:
+    """Reactor flight deck (ISSUE 20, recorded EDGEOBS_r20): the event
+    edge's observability plane measured on the door's own data plane.
+
+      (a) steady-state telemetry overhead: the EDGE_r19 door-capacity
+          recipe (event door vs an in-process stub wire responder
+          answering real AdmissionReview bytes) run as PAIRED rounds —
+          reactor telemetry detached (the loop's pre-ISSUE-20 dispatch:
+          ``_telem is None``, one untaken branch per site) vs attached
+          (the shipped default), arm order alternated per pair,
+          median-of-arms estimator (the profiler-overhead methodology:
+          co-tenant drift hits both arms of a pair almost equally);
+      (b) the door-capacity headline with telemetry ON — the number a
+          deployment actually gets — against EDGE_r19's recorded
+          capacity (acceptance: within 5%);
+      (c) a seeded 250ms ``evloop.slow_callback`` stall (latency rule
+          on the heartbeat's registered fault point) caught END TO END:
+          the culprit table and the flight-recorder ``evloop_stall``
+          event name the heartbeat callback, the cross-thread watchdog
+          captures the reactor stack MID-stall within one scan period
+          of the budget and dumps an incident, the next heartbeat's
+          skew surfaces in ``evloop_lag_seconds``, and the
+          force-sampled tick lands in the tick histogram.
+    """
+    import gc
+    import tempfile
+
+    from gatekeeper_tpu import faults
+    from gatekeeper_tpu.fleet.evdoor import EventFrontDoor
+    from gatekeeper_tpu.fleet.wirelistener import _envelope
+    from gatekeeper_tpu.metrics.exporter import render_prometheus
+    from gatekeeper_tpu.obs import flightrec, reactorobs
+    from gatekeeper_tpu.obs import trace as obstrace
+    from gatekeeper_tpu.util.synthetic import make_pods
+    from gatekeeper_tpu.webhook.policy import AdmissionResponse
+
+    n_cap = int(os.environ.get("BENCH_EDGEOBS_CAP_REVIEWS", "40000"))
+    n_pairs = int(os.environ.get("BENCH_EDGEOBS_PAIRS", "8"))
+    stall_s = float(os.environ.get("BENCH_EDGEOBS_STALL_S", "0.25"))
+    # the watchdog samples the breadcrumb every WATCHDOG_TICK_S, so the
+    # drill budget must undercut the stall by at least one scan period
+    # or only an exact-boundary scan could catch it mid-flight; the
+    # production default (STALL_BUDGET_S) is unchanged
+    budget_s = float(os.environ.get("BENCH_EDGEOBS_BUDGET_S", "0.15"))
+
+    # one fixed request; the stub answers every record with one fixed
+    # realistic AdmissionReview allow body, so the pipelined clients
+    # count responses by byte arithmetic (the EDGE_r19 recipe)
+    pod = make_pods(1, seed=99, violation_rate=0.3)[0]
+    req_json = json.dumps({"request": {
+        "uid": "edge-obs-0",
+        "kind": {"group": "", "version": "v1", "kind": "Pod"},
+        "name": pod["metadata"]["name"],
+        "namespace": pod["metadata"]["namespace"],
+        "operation": "CREATE",
+        "userInfo": {"username": "edge-obs"},
+        "object": pod,
+    }}).encode()
+    cap_req = (
+        b"POST /v1/admit HTTP/1.1\r\nHost: bench\r\n"
+        b"Content-Type: application/json\r\n"
+        b"Content-Length: %d\r\n\r\n" % len(req_json)
+    ) + req_json
+    canned = _envelope(AdmissionResponse(True).to_dict(uid="edge-obs-0"))
+
+    lsock = _stub_wire_responder(canned)
+    door = EventFrontDoor(
+        [{"host": "127.0.0.1", "port": lsock.getsockname()[1],
+          "probe_port": 0, "replica_id": "stub"}],
+        probe_interval_s=3600.0,
+    ).start()
+    loop = door._loop
+    out: dict = {"edge": "evloop (selectors reactor, batched wire "
+                         "protocol) vs in-process stub wire responder"}
+    try:
+        # ---- (a)+(b) paired capacity rounds ---------------------------
+        obstrace.get_tracer().configure(sample_rate=0.02)
+        gc.collect()
+        gc.freeze()
+        gc.disable()
+        try:
+            _pipelined_drive(door.port, cap_req, max(2000, n_cap // 8))
+
+            def _cap_round(telemetry_on: bool) -> float:
+                if telemetry_on:
+                    reactorobs.attach(loop, "evdoor")
+                else:
+                    reactorobs.detach(loop)
+                got, wall = _pipelined_drive(door.port, cap_req, n_cap)
+                return round(got / wall, 1) if wall else 0.0
+
+            rates_off, rates_on = [], []
+            for i in range(n_pairs):
+                if i % 2 == 0:
+                    off = _cap_round(False)
+                    on = _cap_round(True)
+                else:
+                    on = _cap_round(True)
+                    off = _cap_round(False)
+                rates_off.append(off)
+                rates_on.append(on)
+                log(f"edge_obs: pair {i}: off={off}/s on={on}/s")
+        finally:
+            gc.unfreeze()
+            gc.enable()
+            obstrace.get_tracer().configure(sample_rate=1.0)
+            reactorobs.attach(loop, "evdoor")  # shipped default state
+        med_off = sorted(rates_off)[len(rates_off) // 2]
+        med_on = sorted(rates_on)[len(rates_on) // 2]
+        overhead_pct = round((1.0 - med_on / med_off) * 100.0, 2)
+        cap_best = max(rates_on)
+
+        prior = None
+        try:
+            with open(os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "EDGE_r19.json")) as f:
+                prior = json.load(f).get("door_capacity_rps")
+        except OSError:
+            pass
+        vs_prior = (round(cap_best / prior, 4)
+                    if prior else None)
+        out.update({
+            "telemetry_overhead_pct": overhead_pct,
+            "rates_off_rps": rates_off,
+            "rates_on_rps": rates_on,
+            "overhead_note": (
+                "paired off/on rounds, arm order alternated per pair, "
+                "median-of-arms; off = reactor telemetry detached "
+                "(the pre-ISSUE-20 loop)"),
+            "door_capacity_rps": cap_best,
+            "door_capacity_off_rps": max(rates_off),
+            "capacity_on_vs_off": round(cap_best / max(rates_off), 4),
+            "capacity_control_note": (
+                "the off-arm best is a SAME-RUN control: this box is "
+                "one shared core and run-to-run host steal swings "
+                "rates ±30% (EDGE_r19 documents 27k..63k for identical "
+                "code), so on-vs-off within one run isolates telemetry "
+                "cost from host drift"),
+            "door_capacity_reviews": n_cap,
+            "door_capacity_sample_rate": 0.02,
+            "edge_r19_capacity_rps": prior,
+            "capacity_vs_edge_r19": vs_prior,
+            "capacity_within_5pct": (vs_prior is not None
+                                     and vs_prior >= 0.95),
+        })
+        log(f"edge_obs: overhead {overhead_pct}% (median off={med_off} "
+            f"on={med_on}); capacity {cap_best}/s vs EDGE_r19 {prior}/s")
+
+        # ---- (c) the seeded stall, end to end -------------------------
+        ddir = tempfile.mkdtemp(prefix="gk-edgeobs-flightrec-")
+        flightrec.get_recorder().configure(dump_dir=ddir)
+        flightrec.get_recorder().clear()
+        reactorobs.detach(loop)
+        telem = reactorobs.attach(loop, "evdoor", stall_budget_s=budget_s)
+
+        def _tick_sum() -> float:
+            m = re.search(
+                r'gatekeeper_evloop_tick_seconds_sum\{[^}]*'
+                r'loop="evdoor"[^}]*\}\s+([0-9.eE+-]+)',
+                render_prometheus())
+            return float(m.group(1)) if m else 0.0
+
+        tick_sum0 = _tick_sum()
+        plane = faults.install(seed=20)
+        plane.add(faults.EVLOOP_SLOW_CALLBACK,
+                  faults.FaultRule(mode=faults.LATENCY,
+                                   latency_s=stall_s, count=1))
+        lag_max = 0.0
+        slow_ev = wd_ev = None
+        deadline = time.monotonic() + 5.0
+        try:
+            while time.monotonic() < deadline:
+                if telem.lag > lag_max:
+                    lag_max = telem.lag
+                for ev in flightrec.get_recorder().events():
+                    if ev.get("type") != flightrec.EVLOOP_STALL:
+                        continue
+                    if ev.get("via") == "slow_callback":
+                        slow_ev = ev
+                    elif ev.get("via") == "watchdog":
+                        wd_ev = ev
+                if slow_ev and wd_ev and lag_max > 0.05:
+                    break
+                time.sleep(0.005)
+        finally:
+            faults.uninstall()
+        culprits = telem.culprits()
+        culprit = culprits[0]["callback"] if culprits else None
+
+        # the force-sampled stalled tick must surface in the histogram
+        # once the 0.5s flush cadence passes
+        tick_delta = 0.0
+        hist_deadline = time.monotonic() + 3.0
+        while time.monotonic() < hist_deadline:
+            tick_delta = _tick_sum() - tick_sum0
+            if tick_delta >= stall_s * 0.8:
+                break
+            time.sleep(0.05)
+
+        held_ms = (wd_ev or {}).get("held_ms")
+        excess_ms = (round(held_ms - budget_s * 1e3, 1)
+                     if held_ms is not None else None)
+        stack = (wd_ev or {}).get("stack") or []
+        out["stall"] = {
+            "seeded_latency_ms": round(stall_s * 1e3, 1),
+            "watchdog_budget_ms": round(budget_s * 1e3, 1),
+            "watchdog_tick_ms": round(
+                reactorobs.WATCHDOG_TICK_S * 1e3, 1),
+            "culprit": culprit,
+            "culprit_named_ok": bool(culprit and "_beat" in culprit),
+            "slow_callback_event": (
+                {k: slow_ev[k] for k in
+                 ("callback", "kind", "duration_ms") if k in slow_ev}
+                if slow_ev else None),
+            "watchdog_held_ms": held_ms,
+            "watchdog_excess_ms": excess_ms,
+            "within_one_watchdog_period": (
+                excess_ms is not None and excess_ms
+                <= reactorobs.WATCHDOG_TICK_S * 1e3 + 25.0),
+            "stack_names_culprit": any("_beat" in fr for fr in stack),
+            "stack_depth": len(stack),
+            "lag_seconds_max": round(lag_max, 4),
+            "lag_visible": lag_max >= 0.1,
+            "tick_hist_sum_delta_s": round(tick_delta, 4),
+            "tick_hist_saw_stall": tick_delta >= stall_s * 0.8,
+            "incident_dumps": sorted(os.listdir(ddir)),
+        }
+        log(f"edge_obs: stall drill: culprit={culprit} "
+            f"lag_max={lag_max * 1e3:.1f}ms held={held_ms}ms "
+            f"dumps={out['stall']['incident_dumps']}")
+    finally:
+        door.stop()
+        try:
+            lsock.close()
+        except OSError:
+            pass
+
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "EDGEOBS_r20.json"), "w") as f:
+        json.dump(out, f, indent=2, sort_keys=True)
+    return {
+        "metric": ("reactor telemetry overhead on the event-edge door "
+                   "capacity (paired off/on rounds)"),
+        "value": out.get("telemetry_overhead_pct"),
+        "unit": "%",
+        "vs_baseline": 0,
+        **out,
+    }
 
 
 def bench_chaos_fleet() -> dict:
@@ -4355,6 +4645,7 @@ CONFIGS = {
     "multihost": bench_multihost,
     "referential": bench_referential,
     "fleet": bench_fleet,
+    "edge_obs": bench_edge_obs,
     "chaos_fleet": bench_chaos_fleet,
     "overload": bench_overload,
     "obs_engine": bench_obs_engine,

@@ -56,12 +56,24 @@ SLOW_CLIENT = "frontdoor.slow_client"   # fleet/frontdoor.py inbound body
 #                                        its body holds an accept thread —
 #                                        bounded by the inbound socket
 #                                        timeout)
+# reactor observability plane (ISSUE 20)
+EVLOOP_SLOW_CALLBACK = "evloop.slow_callback"  # obs/reactorobs.py heartbeat
+#                                        callback (latency = ONE reactor
+#                                        callback runs long -> the slow-
+#                                        callback attribution must name it)
+EVLOOP_STALL = "evloop.stall"          # obs/reactorobs.py heartbeat
+#                                        callback (latency past the
+#                                        watchdog budget = the whole loop
+#                                        stalls -> the cross-thread
+#                                        watchdog must dump the reactor
+#                                        stack)
 
 ALL_POINTS = (
     KUBE_SEND, KUBE_RECV, WATCH_DELIVER, TPU_COMPILE, TPU_DISPATCH,
     WEBHOOK_ENQUEUE, SNAPSHOT_WRITE, SNAPSHOT_LOAD, SNAPSHOT_RESYNC,
     SNAPSHOT_CORRUPT, REPLICA_CRASH, REPLICA_WEDGE, MESH_DISPATCH_STALL,
     SCRAPE_FAIL, PROFILER_STALL, OVERLOAD_STORM, SLOW_CLIENT,
+    EVLOOP_SLOW_CALLBACK, EVLOOP_STALL,
 )
 
 # ---- the process-global plane ----------------------------------------------
@@ -124,6 +136,8 @@ __all__ = [
     "ALL_POINTS",
     "ENABLED",
     "ERROR",
+    "EVLOOP_SLOW_CALLBACK",
+    "EVLOOP_STALL",
     "FaultError",
     "FaultPlane",
     "FaultRule",

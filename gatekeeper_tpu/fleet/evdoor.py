@@ -53,6 +53,9 @@ from ..metrics.catalog import (
     record_frontdoor_requests,
     record_frontdoor_stages,
     record_shed,
+    record_wire_backlog_stall,
+    record_wire_flush,
+    record_wire_reconnect,
 )
 from ..obs import trace as obstrace
 from .evloop import Conn, EventLoop, HttpError, HttpRequestParser, \
@@ -239,6 +242,9 @@ class _WireClient(Conn):
         self.backend = backend
         self.decoder = wireproto.FrameDecoder()
         self.pending: Dict[int, _EdgeRequest] = {}
+        # write-backlog stall episode start (None = the socket is
+        # keeping up); closed by on_writable when the backlog drains
+        self._stall_t0: Optional[float] = None
         # gklint: disable=unbounded-queue -- drained every loop tick;
         # admission to it is bounded upstream by the door's per-backend
         # inflight reservation (_choose), the same cap the old edge had
@@ -294,14 +300,44 @@ class _WireClient(Conn):
         for req in live:
             req.clock.mark(STAGE_PROXY_CONNECT, backend=rid)
             req.pending_stage = STAGE_REPLICA_WAIT
+        self.door._wire_note("request_chunks", 1)
+        self.door._wire_note("bytes_out", len(chunk))
+        self.door._wire_sample("request", len(records))
         self.write(chunk)
+        if self._wlen > 0 and self._stall_t0 is None:
+            # the chunk did not leave in one send: a backlog-stall
+            # episode opens; on_writable closes it when the kernel
+            # buffer catches up
+            self._stall_t0 = time.monotonic()
 
     def on_bytes(self, data: bytes) -> None:
-        for kind, records in self.decoder.feed(data):
+        self.door._wire_note("bytes_in", len(data))
+        try:
+            chunks = self.decoder.feed(data)
+        except wireproto.ProtocolError:
+            # Conn closes us right after this raise; the counter is the
+            # only trace a corrupt stream leaves once the bytes are gone
+            self.door._wire_note("decode_errors", 1)
+            raise
+        for kind, records in chunks:
             if kind == wireproto.KIND_RESPONSE:
+                self.door._wire_note("response_chunks", 1)
+                self.door._wire_sample("response", len(records))
                 self.door._complete_chunk(self, records)
 
+    def on_writable(self) -> None:
+        t0 = self._stall_t0
+        if t0 is not None:
+            self._stall_t0 = None
+            record_wire_backlog_stall(self.backend.replica_id,
+                                      time.monotonic() - t0)
+
     def on_closed(self, exc) -> None:
+        if self._stall_t0 is not None:
+            # the episode ends with the connection: charge what we saw
+            record_wire_backlog_stall(self.backend.replica_id,
+                                      time.monotonic() - self._stall_t0)
+            self._stall_t0 = None
         self.door._wire_client_lost(self, exc)
 
 
@@ -315,9 +351,24 @@ class EventFrontDoor(FrontDoor):
     # clients stalled mid-request are swept on this cadence (bounded by
     # header_timeout_s, so a tight test timeout still sweeps in time)
     SWEEP_INTERVAL_S = 0.05
+    # GKW1 wire-telemetry flush cadence: tick-batched counts leave for
+    # the registry on this gate, not per tick — the registry lock must
+    # not inflate with tick rate
+    WIRE_FLUSH_S = 0.25
+    # chunk-batch-size histogram samples kept per flush window
+    WIRE_SAMPLE_CAP = 256
 
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
+        # GKW1 wire telemetry (loop thread only): plain dict increments
+        # on the hot path, flushed through record_wire_flush on the
+        # WIRE_FLUSH_S gate inside _flush_dirty
+        self._wstats: Dict[str, int] = {}
+        self._wrecs: list = []
+        self._wflush_t = time.monotonic()
+        # backends that have had a wire conn at least once: a rebuild
+        # for one of these counts as a reconnect (loop thread only)
+        self._wire_seen: Set[str] = set()
         self._loop: Optional[EventLoop] = None
         self._lsock: Optional[socket.socket] = None
         self._clients: Set[_ClientConn] = set()
@@ -363,6 +414,15 @@ class EventFrontDoor(FrontDoor):
         self._loop.add_tick_hook(self._flush_dirty)
         self._loop.start()
         self._loop.call_soon_threadsafe(self._schedule_sweep)
+        # reactor flight deck: loop-lag heartbeat, slow-callback
+        # attribution, the stall watchdog, and /debug/connz rows
+        try:
+            from ..obs import reactorobs
+
+            reactorobs.attach(self._loop, "evdoor")
+            reactorobs.register_door(self)
+        except Exception:
+            log.exception("reactor telemetry attach failed")
         self._prober_stop.clear()
         self._prober = threading.Thread(
             target=self._probe_loop, name="evdoor-probe", daemon=True
@@ -376,6 +436,13 @@ class EventFrontDoor(FrontDoor):
             self._prober.join(timeout=5.0)
             self._prober = None
         if self._loop is not None:
+            try:
+                from ..obs import reactorobs
+
+                reactorobs.unregister_door(self)
+                reactorobs.detach(self._loop)
+            except Exception:
+                log.exception("reactor telemetry detach failed")
             self._loop.stop()
             self._loop = None
         for c in list(self._clients):
@@ -394,6 +461,10 @@ class EventFrontDoor(FrontDoor):
         if self._outcomes:  # loop is stopped; drain the last tick's counts
             counts, self._outcomes = self._outcomes, {}
             record_frontdoor_requests(counts)
+        if self._wstats or self._wrecs:  # and the last wire window
+            wstats, self._wstats = self._wstats, {}
+            wrecs, self._wrecs = self._wrecs, []
+            record_wire_flush("door", wstats, wrecs)
         if self._lsock is not None:
             try:
                 self._lsock.close()
@@ -417,6 +488,13 @@ class EventFrontDoor(FrontDoor):
         if self._outcomes:
             counts, self._outcomes = self._outcomes, {}
             record_frontdoor_requests(counts)
+        if self._wstats or self._wrecs:
+            now = time.monotonic()
+            if now - self._wflush_t >= self.WIRE_FLUSH_S:
+                self._wflush_t = now
+                wstats, self._wstats = self._wstats, {}
+                wrecs, self._wrecs = self._wrecs, []
+                record_wire_flush("door", wstats, wrecs)
         if not self._dirty:
             return
         dirty, self._dirty = self._dirty, set()
@@ -426,6 +504,13 @@ class EventFrontDoor(FrontDoor):
     def _count_outcome(self, outcome: str, backend: str = "") -> None:
         key = (outcome, backend)
         self._outcomes[key] = self._outcomes.get(key, 0) + 1
+
+    def _wire_note(self, key: str, n: int) -> None:
+        self._wstats[key] = self._wstats.get(key, 0) + n
+
+    def _wire_sample(self, kind: str, n_records: int) -> None:
+        if len(self._wrecs) < self.WIRE_SAMPLE_CAP:
+            self._wrecs.append((kind, n_records))
 
     def _schedule_sweep(self) -> None:
         interval = min(self.SWEEP_INTERVAL_S,
@@ -591,10 +676,18 @@ class EventFrontDoor(FrontDoor):
         try:
             if faults.ENABLED:
                 faults.fire(faults.OVERLOAD_STORM)
-            wc = self._wire.get(backend.replica_id)
+            rid = backend.replica_id
+            wc = self._wire.get(rid)
             if wc is None or wc.closed:
+                if rid in self._wire_seen:
+                    # a PREVIOUS persistent conn to this backend died
+                    # (lost entries are popped, so wc is None here):
+                    # this build is a reconnect, not first contact
+                    record_wire_reconnect(rid)
+                else:
+                    self._wire_seen.add(rid)
                 wc = _WireClient(self, self._loop, backend)
-                self._wire[backend.replica_id] = wc
+                self._wire[rid] = wc
             req.req_id = self._next_req_id()
             wc.enqueue(req)
         except Exception as e:
@@ -823,6 +916,56 @@ class EventFrontDoor(FrontDoor):
             event_type="frontdoor_no_backend", last_backend=rid,
         )
         self._respond(req, 502, "text/plain", msg.encode(), replica=rid)
+
+    # ---- introspection ----------------------------------------------------
+
+    def stats(self) -> dict:
+        s = super().stats()
+        try:
+            from ..obs import reactorobs
+
+            s["reactor"] = reactorobs.snapshot()
+        except Exception:
+            # introspection must never fail the /fleetz payload
+            log.debug("reactor stats failed", exc_info=True)
+        return s
+
+    def connz(self) -> list:
+        """Per-connection rows for /debug/connz (obs/reactorobs.py).
+        Called from arbitrary threads; every read is a single attribute
+        load of loop-thread-owned state — momentarily stale is fine,
+        torn is impossible."""
+        now = time.monotonic()
+        rows = []
+        for c in list(self._clients):
+            if c.closed:
+                continue
+            p = c.parser
+            state = ("errored" if c.errored
+                     else "mid_body" if p.mid_body
+                     else "idle" if p.idle
+                     else "mid_headers")
+            rows.append({
+                "edge": "evdoor", "kind": "client",
+                "age_s": round(now - c.created, 3),
+                "idle_s": round(now - c.last_activity, 3),
+                "bytes_in": c.bytes_in, "bytes_out": c.bytes_out,
+                "write_backlog": c.write_backlog,
+                "pipeline_depth": len(c.slots),
+                "parser": state,
+            })
+        for rid, wc in list(self._wire.items()):
+            if wc.closed:
+                continue
+            rows.append({
+                "edge": "evdoor", "kind": "wire", "backend": rid,
+                "age_s": round(now - wc.created, 3),
+                "idle_s": round(now - wc.last_activity, 3),
+                "bytes_in": wc.bytes_in, "bytes_out": wc.bytes_out,
+                "write_backlog": wc.write_backlog,
+                "pending_requests": len(wc.pending),
+            })
+        return rows
 
     # ---- GET endpoints (rare, served off-loop) ----------------------------
 

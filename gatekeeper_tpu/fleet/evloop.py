@@ -32,6 +32,7 @@ from collections import deque
 from typing import Callable, Dict, List, Optional, Tuple
 
 from .. import logging as gklog
+from ..util import join_thread
 
 log = gklog.get("fleet.evloop")
 
@@ -65,6 +66,10 @@ class EventLoop:
         self._stop_flag = False
         self._thread: Optional[threading.Thread] = None
         self._woken = False
+        # optional reactor telemetry sink (obs/reactorobs.py): when
+        # None, the loop body pays only `is not None` branches — a bare
+        # EventLoop stays as cheap as before the flight deck existed
+        self._telem = None
 
     # -- lifecycle ---------------------------------------------------
     def start(self) -> None:
@@ -79,8 +84,33 @@ class EventLoop:
             return
         self._stop_flag = True
         self._wake()
-        self._thread.join(timeout=timeout)
+        join_thread(self._thread, timeout, f"event loop {self._name}")
         self._thread = None
+        telem = self._telem
+        if telem is not None:
+            # the final tick's partially-batched observes must reach the
+            # registry — a shutdown that silently drops them understates
+            # exactly the last (often most interesting) window
+            telem.flush()
+
+    def set_telemetry(self, sink) -> None:
+        """Attach a reactor telemetry sink (obs/reactorobs.py
+        ReactorTelemetry, or anything with its ``slow_s`` / ``cur`` /
+        ``note_drift`` / ``slow`` / ``tick`` / ``flush`` surface).
+        Pass None to detach.  The sink's methods run ON the loop
+        thread and must never block or raise."""
+        self._telem = sink
+
+    @property
+    def telemetry(self):
+        return self._telem
+
+    @property
+    def thread_ident(self) -> Optional[int]:
+        """The reactor thread's ident while running (the watchdog's
+        sys._current_frames key), else None."""
+        t = self._thread
+        return t.ident if t is not None else None
 
     @property
     def running(self) -> bool:
@@ -130,40 +160,98 @@ class EventLoop:
 
     # -- the reactor -------------------------------------------------
     def _run(self) -> None:
+        # When a telemetry sink is attached, each tick splits into
+        # select-wait vs. callback-work, every callback dispatch sets
+        # the sink's `cur` breadcrumb (the cross-thread watchdog reads
+        # it to name what the loop is stuck inside), over-threshold
+        # callbacks go to slow-callback attribution, and timer pops
+        # report their wheel drift.  Sink methods are internally
+        # guarded; only tick() (which flushes to the registry) gets a
+        # loop-side net.  Without a sink every added line is an
+        # `is not None` branch.
         sel = self._sel
+        perf = time.perf_counter
         try:
             while not self._stop_flag:
+                telem = self._telem
                 timeout = None
                 if self._timers:
                     timeout = max(0.0, self._timers[0][0] - time.monotonic())
-                for key, mask in sel.select(timeout):
+                t0 = perf() if telem is not None else 0.0
+                events = sel.select(timeout)
+                t1 = perf() if telem is not None else 0.0
+                ncb = 0
+                for key, mask in events:
+                    cb = key.data
+                    if telem is not None:
+                        c0 = perf()
+                        telem.cur = (cb, "io", c0)
                     try:
-                        key.data(mask)
+                        cb(mask)
                     except Exception:
                         # a dead conn must not kill the loop; the conn's
                         # own close/error path answers the client
                         log.exception("event-loop I/O callback failed")
+                    if telem is not None:
+                        telem.cur = None
+                        c1 = perf()
+                        ncb += 1
+                        if c1 - c0 >= telem.slow_s:
+                            telem.slow(cb, "io", c1 - c0)
                 now = time.monotonic()
                 while self._timers and self._timers[0][0] <= now:
-                    _, _, fn = heapq.heappop(self._timers)
+                    due, _, fn = heapq.heappop(self._timers)
+                    if telem is not None:
+                        telem.note_drift(now - due)
+                        c0 = perf()
+                        telem.cur = (fn, "timer", c0)
                     try:
                         fn()
                     except Exception:
                         log.exception("event-loop timer callback failed")
+                    if telem is not None:
+                        telem.cur = None
+                        c1 = perf()
+                        ncb += 1
+                        if c1 - c0 >= telem.slow_s:
+                            telem.slow(fn, "timer", c1 - c0)
                 if self._pending:
                     with self._plock:
                         todo, self._pending = self._pending, deque()
                     for fn in todo:
+                        if telem is not None:
+                            c0 = perf()
+                            telem.cur = (fn, "posted", c0)
                         try:
                             fn()
                         except Exception:
                             log.exception("event-loop posted callback "
                                           "failed")
+                        if telem is not None:
+                            telem.cur = None
+                            c1 = perf()
+                            ncb += 1
+                            if c1 - c0 >= telem.slow_s:
+                                telem.slow(fn, "posted", c1 - c0)
                 for hook in self._tick_hooks:
+                    if telem is not None:
+                        c0 = perf()
+                        telem.cur = (hook, "tick_hook", c0)
                     try:
                         hook()
                     except Exception:
                         log.exception("event-loop tick hook failed")
+                    if telem is not None:
+                        telem.cur = None
+                        c1 = perf()
+                        if c1 - c0 >= telem.slow_s:
+                            telem.slow(hook, "tick_hook", c1 - c0)
+                if telem is not None:
+                    t2 = perf()
+                    try:
+                        telem.tick(t1 - t0, t2 - t0, ncb, t2)
+                    except Exception:
+                        log.exception("event-loop telemetry tick failed")
         finally:
             for key in list(sel.get_map().values()):
                 try:
@@ -199,7 +287,10 @@ class Conn:
         self._wlen = 0
         self._want_write = False
         self.closed = False
-        self.last_activity = time.monotonic()
+        self.created = time.monotonic()
+        self.last_activity = self.created
+        self.bytes_in = 0
+        self.bytes_out = 0
         loop.register(sock, selectors.EVENT_READ, self._on_event)
 
     # -- subclass interface ------------------------------------------
@@ -234,6 +325,7 @@ class Conn:
         if not data:
             self.close(None)
             return
+        self.bytes_in += len(data)
         self.last_activity = time.monotonic()
         try:
             self.on_bytes(data)
@@ -251,6 +343,7 @@ class Conn:
             except OSError as e:
                 self.close(e)
                 return
+            self.bytes_out += n
             if n == len(data):
                 return
             data = data[n:]
@@ -268,6 +361,7 @@ class Conn:
             except OSError as e:
                 self.close(e)
                 return
+            self.bytes_out += n
             self._wlen -= n
             if n < len(head):
                 self._wbuf[0] = head[n:]

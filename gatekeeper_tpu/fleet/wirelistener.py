@@ -36,8 +36,9 @@ from typing import List, Optional
 
 from .. import deadline as _deadline
 from .. import logging as gklog
-from ..metrics.catalog import record_shed
+from ..metrics.catalog import record_shed, record_wire_flush
 from ..obs import trace as obstrace
+from ..util import join_thread
 from .evloop import Conn, EventLoop
 from .frontdoor import _UID_RE
 from . import wireproto
@@ -62,8 +63,18 @@ class _DoorConn(Conn):
         super().__init__(loop, sock)
 
     def on_bytes(self, data: bytes) -> None:
-        for kind, records in self.decoder.feed(data):
+        self.listener._wire_note("bytes_in", len(data))
+        try:
+            chunks = self.decoder.feed(data)
+        except wireproto.ProtocolError:
+            # Conn closes us right after this raise; the counter is the
+            # only trace a corrupt stream leaves once the bytes are gone
+            self.listener._wire_note("decode_errors", 1)
+            raise
+        for kind, records in chunks:
             if kind == wireproto.KIND_REQUEST:
+                self.listener._wire_note("request_chunks", 1)
+                self.listener._wire_sample("request", len(records))
                 self.listener._submit(self, records)
 
     def on_closed(self, exc) -> None:
@@ -80,6 +91,11 @@ class WireListener:
     both listeners of a replica refuse in lockstep during a drain."""
 
     QUEUE_CHUNKS = 256
+    # GKW1 wire-telemetry flush cadence (tick-gated, same reasoning as
+    # EventFrontDoor.WIRE_FLUSH_S: registry traffic must not scale with
+    # tick rate)
+    WIRE_FLUSH_S = 0.25
+    WIRE_SAMPLE_CAP = 256
 
     def __init__(self, handler, label_handler=None, server=None,
                  deadline_budget_s: Optional[float] = None,
@@ -104,6 +120,12 @@ class WireListener:
         self._q: "queue.Queue" = queue.Queue(maxsize=self.QUEUE_CHUNKS)
         self._threads: List[threading.Thread] = []
         self._stop = threading.Event()
+        # GKW1 wire telemetry: fed from the loop thread AND the worker
+        # pool (responses are framed off-loop), so increments take the
+        # listener lock; flushed on the WIRE_FLUSH_S gate by a tick hook
+        self._wstats: dict = {}
+        self._wrecs: list = []
+        self._wflush_t = time.monotonic()
 
     # ---- lifecycle -------------------------------------------------------
 
@@ -118,7 +140,17 @@ class WireListener:
         self.port = lsock.getsockname()[1]
         self._lsock = lsock
         self._loop.register(lsock, selectors.EVENT_READ, self._accept)
+        self._loop.add_tick_hook(self._flush_wire)
         self._loop.start()
+        # reactor flight deck: loop-lag heartbeat, stall watchdog, and
+        # /debug/connz rows for the replica-side edge
+        try:
+            from ..obs import reactorobs
+
+            reactorobs.attach(self._loop, "wirelistener")
+            reactorobs.register_door(self)
+        except Exception:
+            log.exception("reactor telemetry attach failed")
         for i in range(self.workers):
             t = threading.Thread(target=self._worker,
                                  name=f"wirelistener-{i}", daemon=True)
@@ -134,6 +166,13 @@ class WireListener:
             except queue.Full:
                 break
         if self._loop is not None:
+            try:
+                from ..obs import reactorobs
+
+                reactorobs.unregister_door(self)
+                reactorobs.detach(self._loop)
+            except Exception:
+                log.exception("reactor telemetry detach failed")
             self._loop.stop()
             self._loop = None
         for c in list(self._conns):
@@ -149,8 +188,50 @@ class WireListener:
                 pass
             self._lsock = None
         for t in self._threads:
-            t.join(timeout=2.0)
+            join_thread(t, 2.0, "wirelistener worker")
         self._threads = []
+        self._flush_wire(force=True)  # the final window must not vanish
+
+    # ---- wire telemetry --------------------------------------------------
+
+    def _wire_note(self, key: str, n: int) -> None:
+        with self._mu:
+            self._wstats[key] = self._wstats.get(key, 0) + n
+
+    def _wire_sample(self, kind: str, n_records: int) -> None:
+        with self._mu:
+            if len(self._wrecs) < self.WIRE_SAMPLE_CAP:
+                self._wrecs.append((kind, n_records))
+
+    def _flush_wire(self, force: bool = False) -> None:
+        now = time.monotonic()
+        with self._mu:
+            if not self._wstats and not self._wrecs:
+                return
+            if not force and now - self._wflush_t < self.WIRE_FLUSH_S:
+                return
+            self._wflush_t = now
+            wstats, self._wstats = self._wstats, {}
+            wrecs, self._wrecs = self._wrecs, []
+        record_wire_flush("replica", wstats, wrecs)
+
+    def connz(self) -> list:
+        """Per-connection rows for /debug/connz (obs/reactorobs.py):
+        the front-door conns this replica is serving."""
+        now = time.monotonic()
+        rows = []
+        for c in list(self._conns):
+            if c.closed:
+                continue
+            rows.append({
+                "edge": "wirelistener", "kind": "door",
+                "age_s": round(now - c.created, 3),
+                "idle_s": round(now - c.last_activity, 3),
+                "bytes_in": c.bytes_in, "bytes_out": c.bytes_out,
+                "write_backlog": c.write_backlog,
+                "queued_chunks": self._q.qsize(),
+            })
+        return rows
 
     # ---- loop side -------------------------------------------------------
 
@@ -178,7 +259,11 @@ class WireListener:
             out = [wireproto.ResponseRecord(r.req_id, 200,
                                             self._shed_body(r.body))
                    for r in records]
-            conn.write(wireproto.encode_response_chunk(out))
+            data = wireproto.encode_response_chunk(out)
+            self._wire_note("response_chunks", 1)
+            self._wire_note("bytes_out", len(data))
+            self._wire_sample("response", len(out))
+            conn.write(data)
 
     def _shed_body(self, body: bytes) -> bytes:
         from ..webhook.policy import (
@@ -220,6 +305,10 @@ class WireListener:
                 # expiry — forever with no admission budget configured
                 log.exception("wire chunk processing failed")
                 data = self._failure_chunk(records)
+            if data is not None:
+                self._wire_note("response_chunks", 1)
+                self._wire_note("bytes_out", len(data))
+                self._wire_sample("response", len(records))
             loop = self._loop
             if loop is not None and not conn.closed:
                 if data is None:

@@ -428,6 +428,95 @@ PROFILER_OVERFLOW_M = Measure(
     "memory bound (max_stacks); the profile is still valid, its tail "
     "is just truncated",
 )
+# ---- reactor observability plane (ISSUE 20) ---------------------------------
+# Runtime health of the serving-edge event loops (fleet/evloop.py via
+# obs/reactorobs.py): every series carries the `loop` tag (evdoor,
+# wirelistener) because the door and the replica listener each run their
+# own reactor.  The per-tick series are tick-batched and flush-sampled —
+# the reactor thread pays plain arithmetic per tick, never a registry
+# lock per tick.
+EVLOOP_LAG_M = Measure(
+    "evloop_lag_seconds",
+    "Scheduling skew of the reactor's self-scheduled heartbeat timer: "
+    "how late the loop fired a timer it armed for a known instant — "
+    "THE loop-health gauge (a slow callback anywhere delays every "
+    "connection by at least this much)",
+    unit="s",
+)
+EVLOOP_TICK_M = Measure(
+    "evloop_tick_seconds",
+    "Duration of one reactor tick (select wait + I/O callbacks + "
+    "timers + posted callbacks + tick hooks), flush-sampled",
+    unit="s",
+)
+EVLOOP_UTIL_M = Measure(
+    "evloop_utilization",
+    "Fraction of reactor wall time spent running callbacks rather than "
+    "waiting in select() over the last telemetry flush window (1.0 = "
+    "the loop thread is saturated and queueing work)",
+)
+EVLOOP_CBS_M = Measure(
+    "evloop_callbacks_per_tick",
+    "Callbacks (I/O + timer + posted) dispatched in one reactor tick, "
+    "flush-sampled",
+)
+EVLOOP_DRIFT_M = Measure(
+    "evloop_timer_drift_seconds",
+    "Timer-wheel drift: how far past its due instant a timer actually "
+    "fired (sweep, heartbeat, deadline-expiry timers all ride the same "
+    "monotonic heap)",
+    unit="s",
+)
+EVLOOP_SLOW_M = Measure(
+    "evloop_slow_callbacks",
+    "Reactor callbacks that ran past the slow-callback threshold and "
+    "landed in the top-K culprit table (each also emits an "
+    "evloop_stall flight-recorder event, rate-bounded per culprit)",
+)
+EVLOOP_STALLS_M = Measure(
+    "evloop_stalls",
+    "Reactor stalls past the watchdog budget caught by the cross-"
+    "thread watchdog (each dumps a flight-recorder incident carrying "
+    "the reactor thread's folded stack)",
+)
+# GKW1 wire telemetry, both ends: `end` is door (fleet/evdoor.py) or
+# replica (fleet/wirelistener.py), `kind` the frame kind.  Chunk/byte
+# counts are tick-batched on the reactor threads and flushed on the
+# reactorobs cadence.
+WIRE_CHUNKS_M = Measure(
+    "wire_chunks",
+    "GKW1 chunk frames moved on the door<->replica wire, by end (door, "
+    "replica) and frame kind (request, response)",
+)
+WIRE_RECORDS_M = Measure(
+    "wire_chunk_records",
+    "Records batched into one GKW1 chunk frame (the tick-coalescing "
+    "win the batched protocol exists for), by end and kind",
+)
+WIRE_BYTES_M = Measure(
+    "wire_bytes",
+    "Bytes moved on the door<->replica wire, by end and direction "
+    "(in, out)",
+    unit="By",
+)
+WIRE_DECODE_ERRORS_M = Measure(
+    "wire_decode_errors",
+    "GKW1 frame streams abandoned as undecodable (wireproto."
+    "ProtocolError; the carrying connection closes — there is no "
+    "resync point in a length-prefixed stream that lied)",
+)
+WIRE_RECONNECTS_M = Measure(
+    "wire_reconnects",
+    "Door-side wire-connection rebuilds to a backend whose previous "
+    "persistent connection was lost, by backend replica id",
+)
+WIRE_BACKLOG_STALL_M = Measure(
+    "wire_backlog_stall_seconds",
+    "Duration of one door-side wire-connection backlog episode: the "
+    "span from a chunk write leaving bytes buffered (the kernel socket "
+    "buffer filled) until the backlog fully drained, by backend",
+    unit="s",
+)
 
 # bucket boundaries copied from the reference's view.Distribution calls
 _INGEST_BUCKETS = (
@@ -591,6 +680,33 @@ def catalog_views():
         View("decision_log_segments_total", DECISION_SEGMENTS_M,
              AGG_COUNT),
         View("decision_log_bytes_total", DECISION_BYTES_M, AGG_COUNT),
+        View("evloop_lag_seconds", EVLOOP_LAG_M, AGG_LAST_VALUE,
+             tag_keys=("loop",)),
+        View("evloop_tick_seconds", EVLOOP_TICK_M, AGG_DISTRIBUTION,
+             tag_keys=("loop",), buckets=_STAGE_BUCKETS),
+        View("evloop_utilization", EVLOOP_UTIL_M, AGG_LAST_VALUE,
+             tag_keys=("loop",)),
+        View("evloop_callbacks_per_tick", EVLOOP_CBS_M, AGG_DISTRIBUTION,
+             tag_keys=("loop",), buckets=_BATCH_SIZE_BUCKETS),
+        View("evloop_timer_drift_seconds", EVLOOP_DRIFT_M,
+             AGG_DISTRIBUTION, tag_keys=("loop",), buckets=_STAGE_BUCKETS),
+        View("evloop_slow_callbacks_total", EVLOOP_SLOW_M, AGG_COUNT,
+             tag_keys=("loop",)),
+        View("evloop_stalls_total", EVLOOP_STALLS_M, AGG_COUNT,
+             tag_keys=("loop",)),
+        View("wire_chunks_total", WIRE_CHUNKS_M, AGG_COUNT,
+             tag_keys=("end", "kind")),
+        View("wire_chunk_records", WIRE_RECORDS_M, AGG_DISTRIBUTION,
+             tag_keys=("end", "kind"), buckets=_BATCH_SIZE_BUCKETS),
+        View("wire_bytes_total", WIRE_BYTES_M, AGG_COUNT,
+             tag_keys=("end", "direction")),
+        View("wire_decode_errors_total", WIRE_DECODE_ERRORS_M, AGG_COUNT,
+             tag_keys=("end",)),
+        View("wire_reconnects_total", WIRE_RECONNECTS_M, AGG_COUNT,
+             tag_keys=("backend",)),
+        View("wire_backlog_stall_seconds", WIRE_BACKLOG_STALL_M,
+             AGG_DISTRIBUTION, tag_keys=("backend",),
+             buckets=_STAGE_BUCKETS),
     ]
 
 
@@ -1221,3 +1337,125 @@ def record_cache(cache: str, hit: bool, n: int = 1):
         )
     except Exception:  # telemetry never blocks eval
         record_dropped("record_cache")
+
+
+# ---- reactor observability plane (ISSUE 20) ---------------------------------
+
+_EVLOOP_TICK_OBS = None
+_EVLOOP_CBS_OBS = None
+_EVLOOP_DRIFT_OBS = None
+
+
+def record_evloop_flush(loop: str, utilization: float,
+                        tick_samples, cb_samples, drift_samples):
+    """One reactor telemetry flush window (obs/reactorobs.py, every
+    FLUSH_S): the utilization gauge plus the window's sampled tick /
+    callbacks-per-tick / timer-drift observes, each batch through a
+    prebound single-tag observer so the reactor thread pays a handful
+    of lock holds per window, never one per tick.  Guarded like
+    record_stage."""
+    global _EVLOOP_TICK_OBS, _EVLOOP_CBS_OBS, _EVLOOP_DRIFT_OBS
+    try:
+        reg = _global()
+        reg.record(EVLOOP_UTIL_M, float(utilization), {"loop": loop})
+        if tick_samples:
+            obs = _EVLOOP_TICK_OBS
+            if obs is None:
+                obs = _EVLOOP_TICK_OBS = reg.observer(EVLOOP_TICK_M,
+                                                      "loop")
+            obs([(loop, s) for s in tick_samples])
+        if cb_samples:
+            obs = _EVLOOP_CBS_OBS
+            if obs is None:
+                obs = _EVLOOP_CBS_OBS = reg.observer(EVLOOP_CBS_M, "loop")
+            obs([(loop, float(s)) for s in cb_samples])
+        if drift_samples:
+            obs = _EVLOOP_DRIFT_OBS
+            if obs is None:
+                obs = _EVLOOP_DRIFT_OBS = reg.observer(EVLOOP_DRIFT_M,
+                                                       "loop")
+            obs([(loop, s) for s in drift_samples])
+    except Exception:  # telemetry never blocks the reactor
+        record_dropped("record_evloop_flush")
+
+
+def record_evloop_lag(loop: str, lag_s: float):
+    """One heartbeat skew sample — THE loop-lag gauge (at most a few
+    per second per loop, so it records directly).  Guarded like
+    record_stage."""
+    try:
+        _global().record(EVLOOP_LAG_M, float(lag_s), {"loop": loop})
+    except Exception:  # telemetry never blocks the reactor
+        record_dropped("record_evloop_lag")
+
+
+def record_evloop_slow_callback(loop: str, n: int = 1):
+    """n reactor callbacks over the slow-callback threshold."""
+    if n <= 0:
+        return
+    try:
+        _global().record(EVLOOP_SLOW_M, float(n), {"loop": loop},
+                         count=n)
+    except Exception:  # telemetry never blocks the reactor
+        record_dropped("record_evloop_slow_callback")
+
+
+def record_evloop_stall(loop: str):
+    """One watchdog-caught reactor stall (the incident counter; the
+    watchdog thread also dumps the flight recorder)."""
+    try:
+        _global().record(EVLOOP_STALLS_M, 1.0, {"loop": loop})
+    except Exception:  # telemetry never blocks the watchdog
+        record_dropped("record_evloop_stall")
+
+
+def record_wire_flush(end: str, counts: Dict[str, int],
+                      record_samples=None):
+    """One end's GKW1 wire-telemetry window (tick-batched on the
+    reactor threads, flushed on the reactorobs cadence).  ``counts``
+    keys: request_chunks, response_chunks, bytes_in, bytes_out,
+    decode_errors (absent/zero keys skip); ``record_samples`` is
+    [(kind, n_records)] feeding the chunk-batch-size histogram.
+    Guarded like record_stage."""
+    try:
+        reg = _global()
+        for key, kind in (("request_chunks", "request"),
+                          ("response_chunks", "response")):
+            n = int(counts.get(key, 0))
+            if n > 0:
+                reg.record(WIRE_CHUNKS_M, float(n),
+                           {"end": end, "kind": kind}, count=n)
+        for key, direction in (("bytes_in", "in"), ("bytes_out", "out")):
+            n = int(counts.get(key, 0))
+            if n > 0:
+                reg.record(WIRE_BYTES_M, float(n),
+                           {"end": end, "direction": direction}, count=n)
+        n = int(counts.get("decode_errors", 0))
+        if n > 0:
+            reg.record(WIRE_DECODE_ERRORS_M, float(n), {"end": end},
+                       count=n)
+        if record_samples:
+            for kind, nrec in record_samples:
+                reg.record(WIRE_RECORDS_M, float(nrec),
+                           {"end": end, "kind": kind})
+    except Exception:  # telemetry never blocks the wire path
+        record_dropped("record_wire_flush")
+
+
+def record_wire_reconnect(backend: str):
+    """One door-side wire-connection rebuild to a backend whose
+    previous persistent connection was lost (rare; records directly)."""
+    try:
+        _global().record(WIRE_RECONNECTS_M, 1.0, {"backend": backend})
+    except Exception:  # telemetry never blocks the wire path
+        record_dropped("record_wire_reconnect")
+
+
+def record_wire_backlog_stall(backend: str, seconds: float):
+    """One completed door-side write-backlog episode: the span from a
+    chunk write leaving bytes buffered until the backlog drained."""
+    try:
+        _global().record(WIRE_BACKLOG_STALL_M, float(seconds),
+                         {"backend": backend})
+    except Exception:  # telemetry never blocks the wire path
+        record_dropped("record_wire_backlog_stall")

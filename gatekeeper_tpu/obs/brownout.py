@@ -26,7 +26,10 @@ daemon thread (`tick_s` cadence) from injected providers:
   - **shed rate** — a decayed per-second rate of `shed_total`
     recordings (`note_shed`, fed by metrics.catalog.record_shed from
     every shed site: batcher bound, door inflight, expired deadlines);
-  - **SLO burn** — the SLO engine's fast-burn degradation flag.
+  - **SLO burn** — the SLO engine's fast-burn degradation flag;
+  - **loop lag** — the event edge's reactor heartbeat skew
+    (obs/reactorobs.py): a lagging loop means admissions queue at the
+    socket edge before any other signal can see them.
 
 Hysteresis both ways: a step UP requires the overload predicate to hold
 for `up_after_s` continuously; a step DOWN requires the *clear*
@@ -70,6 +73,8 @@ class BrownoutController:
     QUEUE_LOW = 0.25      # pending fraction that reads as clear
     SHED_HIGH = 1.0       # sheds/s that read as overload
     SHED_LOW = 0.1        # sheds/s that read as clear
+    LAG_HIGH = 0.25       # reactor loop-lag (s) that reads as overload
+    LAG_LOW = 0.05        # reactor loop-lag (s) that reads as clear
     UP_AFTER_S = 1.0      # overload must hold this long to step up
     DOWN_AFTER_S = 5.0    # clear must hold this long to step down
     TICK_S = 0.25         # sampler cadence
@@ -82,6 +87,7 @@ class BrownoutController:
         # providers (None = signal absent, reads as not-overloaded)
         self._queue_frac: Optional[Callable[[], float]] = None
         self._slo_degraded: Optional[Callable[[], bool]] = None
+        self._loop_lag: Optional[Callable[[], float]] = None
         # decayed shed rate, fed cross-thread by note_shed()
         self._shed_count = 0
         self._shed_rate = 0.0
@@ -99,12 +105,15 @@ class BrownoutController:
     # ---- wiring ------------------------------------------------------------
 
     def set_providers(self, queue_frac: Optional[Callable[[], float]] = None,
-                      slo_degraded: Optional[Callable[[], bool]] = None):
+                      slo_degraded: Optional[Callable[[], bool]] = None,
+                      loop_lag: Optional[Callable[[], float]] = None):
         with self._lock:
             if queue_frac is not None:
                 self._queue_frac = queue_frac
             if slo_degraded is not None:
                 self._slo_degraded = slo_degraded
+            if loop_lag is not None:
+                self._loop_lag = loop_lag
         return self
 
     def on_change(self, cb: Callable[[int, int], None]):
@@ -170,6 +179,7 @@ class BrownoutController:
             shed_rate = self._roll_shed_rate_locked(now)
             qf = self._queue_frac
             slo = self._slo_degraded
+            ll = self._loop_lag
         # providers run OUTSIDE the lock: they take other locks (the
         # batcher cv is NOT among them — queue_frac reads a list length
         # — but the SLO engine locks itself)
@@ -185,21 +195,31 @@ class BrownoutController:
                 slo_burn = bool(slo())
             except Exception:
                 log.debug("brownout SLO provider failed", exc_info=True)
+        loop_lag = 0.0
+        if ll is not None:
+            try:
+                loop_lag = float(ll())
+            except Exception:
+                log.debug("brownout loop-lag provider failed",
+                          exc_info=True)
         overloaded = (
             queue_frac >= self.QUEUE_HIGH
             or shed_rate >= self.SHED_HIGH
             or slo_burn
+            or loop_lag >= self.LAG_HIGH
         )
         clear = (
             queue_frac <= self.QUEUE_LOW
             and shed_rate <= self.SHED_LOW
             and not slo_burn
+            and loop_lag <= self.LAG_LOW
         )
         with self._lock:
             self.last_signals = {
                 "queue_frac": round(queue_frac, 4),
                 "shed_rate": round(shed_rate, 3),
                 "slo_burn": slo_burn,
+                "loop_lag": round(loop_lag, 4),
             }
             if overloaded:
                 self._clear_since = None

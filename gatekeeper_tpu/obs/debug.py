@@ -23,6 +23,11 @@ Endpoints (docs/tracing.md):
   /debug/decisionz?limit=&verdict= recent decision records (ring mirror)
                                  + recorder stats; verdict filters by
                                  decision class (obs/decisionlog.py)
+  /debug/connz?limit=            per-connection introspection across the
+                                 registered event edges — age, bytes
+                                 in/out, write backlog, pipelining depth,
+                                 parser state, idle time; worst backlog
+                                 first (obs/reactorobs.py)
   /debug/fleet-traces?min_ms=    assembled cross-process traces — present
                                  only where a fleet TraceCollector is
                                  installed (obs/fleetobs.py)
@@ -84,6 +89,7 @@ class DebugRouter:
             "/debug/compilez": self._compilez,
             "/debug/flightrecz": self._flightrecz,
             "/debug/decisionz": self._decisionz,
+            "/debug/connz": self._connz,
         }
 
     def endpoints(self) -> List[str]:
@@ -207,6 +213,16 @@ class DebugRouter:
         return _json(200, decisionlog.get_log().snapshot(
             limit=limit, verdict=verdict,
         ))
+
+    def _connz(self, q) -> Response:
+        from . import reactorobs
+
+        limit = _num(q, "limit", int, None)
+        if limit is not None and limit < 0:
+            raise BadParam("limit must be a non-negative integer")
+        # no registered edge (threaded-door deployment): an empty,
+        # well-formed payload — not an error
+        return _json(200, reactorobs.connz_snapshot(limit=limit))
 
 
 _ROUTER = DebugRouter()

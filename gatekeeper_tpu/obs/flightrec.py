@@ -17,6 +17,12 @@ Event sources (each site records through one guarded call):
   snapshot_restore     metrics/catalog.py record_snapshot_outcome
   route_flip           obs/routeledger.py (the evaluation router changed
                        tier, including breaker/compile-pending overrides)
+  evloop_stall         obs/reactorobs.py — a reactor callback ran past the
+                       slow-callback threshold (attribution names the
+                       culprit), or the cross-thread watchdog caught the
+                       loop stalled past budget (the event then carries
+                       the reactor thread's folded stack and also
+                       triggers an automatic dump)
 
 Every event carries a process-monotonic ``seq`` (total order within the
 process), a monotonic timestamp for interval math, a wall timestamp for
@@ -59,6 +65,7 @@ SLO_ALERT = "slo_alert"
 SHED_BURST = "shed_burst"
 SNAPSHOT_RESTORE = "snapshot_restore"
 ROUTE_FLIP = "route_flip"
+EVLOOP_STALL = "evloop_stall"
 
 #: every event type a record() site may emit — tools/check_observability.py
 #: asserts each is documented in docs/observability.md
@@ -70,6 +77,7 @@ EVENT_TYPES = (
     SHED_BURST,
     SNAPSHOT_RESTORE,
     ROUTE_FLIP,
+    EVLOOP_STALL,
 )
 
 #: shed recordings inside one window coalesce into one shed_burst event

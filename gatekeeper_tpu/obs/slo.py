@@ -54,6 +54,7 @@ _RETAIN_S = max(WINDOWS.values())
 ADMISSION_LATENCY = "admission_latency"
 FAIL_CLOSED_ERRORS = "fail_closed_errors"
 AUDIT_FRESHNESS = "audit_freshness"
+EDGE_LATENCY = "edge_latency"
 
 
 class Objective:
@@ -87,6 +88,10 @@ class SLOEngine:
         self._on_alert: List[Callable[[str, str], None]] = []
         # config consulted by the module-level observers
         self.admission_threshold_s = 0.100
+        # edge-latency good/bad split: a reactor heartbeat skew sample
+        # above this reads as the serving edge adding user-visible
+        # latency (the loop was busy when the timer was due)
+        self.edge_threshold_s = 0.050
         self.audit_max_age_s = 300.0
         # alert volume floor: a burn alert needs at least this many
         # events in the pair's SHORT window — 1 bad event out of 2 must
@@ -344,6 +349,11 @@ def default_engine(clock=time.monotonic) -> SLOEngine:
         "(fail-open/closed decisions, internal errors)",
     )
     eng.add_objective(
+        EDGE_LATENCY, 0.999,
+        "fraction of event-edge reactor heartbeat skew samples under the "
+        "edge latency threshold (loop-lag stays invisible to clients)",
+    )
+    eng.add_objective(
         AUDIT_FRESHNESS, 0.999,
         "fraction of freshness probes with the last successful audit "
         "sweep younger than --slo-audit-max-age-s",
@@ -413,6 +423,16 @@ def observe_audit_run():
         _ENGINE.observe_audit_run()
     except Exception:  # telemetry never blocks audit
         _record_dropped("slo.observe_audit_run")
+
+
+def observe_edge_latency(lag_s: float):
+    """Feed one reactor loop-lag sample (heartbeat skew, measured on the
+    loop itself by obs/reactorobs.py) into the edge-latency objective.
+    Guarded: SLO accounting must never wedge the reactor."""
+    try:
+        _ENGINE.record(EDGE_LATENCY, lag_s <= _ENGINE.edge_threshold_s)
+    except Exception:  # telemetry never blocks the loop
+        _record_dropped("slo.observe_edge_latency")
 
 
 def collect_hook(registry):

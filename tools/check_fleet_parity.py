@@ -264,9 +264,11 @@ def main() -> int:
 
     ap = argparse.ArgumentParser()
     ap.add_argument("--edge", choices=("threaded", "evloop", "both"),
-                    default="both",
+                    default="evloop",
                     help="which serving edge(s) to drive the corpus "
-                         "through (default: both)")
+                         "through (default: evloop — the threaded "
+                         "FrontDoor is deprecated and must be asked for "
+                         "explicitly, or use 'both' for back-to-back)")
     args = ap.parse_args()
     problems = run_checks(edge=args.edge)
     if problems:

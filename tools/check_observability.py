@@ -48,6 +48,14 @@ tests/test_observability_check.py; also runnable standalone):
    field outside the declared schema — the archive format is the replay
    tool's input contract.
 
+10. Reactor-observability conformance (ISSUE 20): the `evloop_stall`
+    flight-recorder event type must be declared, the `evloop.*` fault
+    points registered AND documented in docs/failure-modes.md, every
+    `evloop_*`/`wire_*` view documented in docs/metrics.md,
+    /debug/connz routed and mentioned in docs/observability.md, and the
+    reactor-health section present in docs/fleet.md — the flight deck
+    is an operator contract like every other surface here.
+
 Run: python tools/check_observability.py   (exit 0 clean, 1 with findings)
 """
 
@@ -74,9 +82,13 @@ HOT_PATH_MODULES = (
     "gatekeeper_tpu/obs/compilestats.py",
     "gatekeeper_tpu/obs/decisionlog.py",
     "gatekeeper_tpu/obs/brownout.py",
+    "gatekeeper_tpu/obs/reactorobs.py",
     "gatekeeper_tpu/ops/xlacache.py",
     "gatekeeper_tpu/ops/asynccompile.py",
     "gatekeeper_tpu/fleet/frontdoor.py",
+    "gatekeeper_tpu/fleet/evloop.py",
+    "gatekeeper_tpu/fleet/evdoor.py",
+    "gatekeeper_tpu/fleet/wirelistener.py",
     "gatekeeper_tpu/metrics/views.py",
     "gatekeeper_tpu/metrics/exporter.py",
     "gatekeeper_tpu/webhook/server.py",
@@ -447,6 +459,76 @@ def check_decisionlog_conformance() -> list:
     return problems
 
 
+def check_reactor_conformance() -> list:
+    """Reactor flight-deck contracts (ISSUE 20): event type declared,
+    fault points registered + documented, metrics + endpoint + docs
+    sections present."""
+    from gatekeeper_tpu import faults
+    from gatekeeper_tpu.metrics import catalog
+    from gatekeeper_tpu.obs import flightrec
+    from gatekeeper_tpu.obs.debug import get_router
+
+    problems = []
+    if getattr(flightrec, "EVLOOP_STALL", None) not in flightrec.EVENT_TYPES:
+        problems.append(
+            "flightrec.EVLOOP_STALL missing from EVENT_TYPES — the stall "
+            "watchdog's incidents would fail the recorder's type check"
+        )
+    fm_path = os.path.join(REPO, "docs", "failure-modes.md")
+    try:
+        with open(fm_path) as f:
+            fmdoc = f.read()
+    except OSError as e:
+        return problems + [f"docs/failure-modes.md unreadable: {e}"]
+    for point in ("evloop.slow_callback", "evloop.stall"):
+        if point not in faults.ALL_POINTS:
+            problems.append(
+                f"fault point {point!r} is not registered in "
+                "faults.ALL_POINTS — gklint's unknown-fault-point rule "
+                "would reject its fire site"
+            )
+        if f"`{point}`" not in fmdoc:
+            problems.append(
+                f"fault point {point!r} is not documented in "
+                "docs/failure-modes.md (the fault-point table)"
+            )
+    if "watchdog" not in fmdoc:
+        problems.append(
+            "docs/failure-modes.md has no stall-watchdog row — the "
+            "evloop.stall recovery story is an operator contract"
+        )
+    view_names = {v.name for v in catalog.catalog_views()}
+    expected = {
+        "evloop_lag_seconds", "evloop_tick_seconds", "evloop_utilization",
+        "evloop_callbacks_per_tick", "evloop_timer_drift_seconds",
+        "evloop_slow_callbacks_total", "evloop_stalls_total",
+        "wire_chunks_total", "wire_chunk_records", "wire_bytes_total",
+        "wire_decode_errors_total", "wire_reconnects_total",
+        "wire_backlog_stall_seconds",
+    }
+    for name in sorted(expected - view_names):
+        problems.append(
+            f"reactor/wire view {name!r} is missing from catalog_views() "
+            "— the flight-deck metric set is incomplete"
+        )
+    if "/debug/connz" not in get_router().endpoints():
+        problems.append(
+            "/debug/connz is not routed on the shared debug router"
+        )
+    fleet_path = os.path.join(REPO, "docs", "fleet.md")
+    try:
+        with open(fleet_path) as f:
+            fleetdoc = f.read()
+    except OSError as e:
+        return problems + [f"docs/fleet.md unreadable: {e}"]
+    if "reactor health" not in fleetdoc.lower():
+        problems.append(
+            "docs/fleet.md has no reactor-health section — the flight "
+            "deck's operator story must live next to the edge it watches"
+        )
+    return problems
+
+
 def run_checks() -> list:
     sys.path.insert(0, REPO)
     return (
@@ -459,6 +541,7 @@ def run_checks() -> list:
         + check_federated_format()
         + check_flightrec_conformance()
         + check_decisionlog_conformance()
+        + check_reactor_conformance()
     )
 
 

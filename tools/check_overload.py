@@ -205,7 +205,7 @@ def _drive_door(door, edge: str, reqs, bodies, oracle_verdicts) -> list:
         door.stop()
 
 
-def run_checks(edge: str = "threaded") -> list:
+def run_checks(edge: str = "evloop") -> list:
     """Drive the saturation burst through the requested serving edge(s).
 
     ``edge="both"`` stages ONE snapshot + replica fleet and drives the
@@ -274,10 +274,12 @@ def main() -> int:
 
     ap = argparse.ArgumentParser()
     ap.add_argument("--edge", choices=("threaded", "evloop", "both"),
-                    default="threaded",
-                    help="which serving edge to saturate (evloop = the "
-                         "ISSUE 19 event-loop door + wire listeners; "
-                         "both = one fleet, both doors back to back)")
+                    default="evloop",
+                    help="which serving edge to saturate (default: the "
+                         "event-loop door + wire listeners; the threaded "
+                         "FrontDoor is deprecated and must be asked for "
+                         "explicitly; both = one fleet, both doors back "
+                         "to back)")
     args = ap.parse_args()
     problems = run_checks(edge=args.edge)
     if problems:
